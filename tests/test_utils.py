@@ -1,0 +1,126 @@
+"""Unit tests for the plumbing layer: RWLock, timeout engine, sampler.
+
+Mirrors reference test coverage: torchft/checkpointing/rwlock_test.py,
+torchft/futures_test.py:18-97, torchft/data_test.py:26.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.utils import RWLock, context_timeout, future_timeout, future_wait
+
+
+class TestRWLock:
+    def test_multiple_readers(self):
+        lock = RWLock(timeout=1.0)
+        lock.acquire_read()
+        lock.acquire_read()
+        lock.release_read()
+        lock.release_read()
+
+    def test_writer_excludes_readers(self):
+        lock = RWLock(timeout=0.1)
+        lock.acquire_write()
+        with pytest.raises(TimeoutError):
+            lock.acquire_read()
+        lock.release_write()
+        lock.acquire_read()
+        lock.release_read()
+
+    def test_reader_excludes_writer(self):
+        lock = RWLock(timeout=0.1)
+        with lock.r_lock():
+            with pytest.raises(TimeoutError):
+                lock.acquire_write()
+        with lock.w_lock():
+            pass
+
+    def test_concurrent_handoff(self):
+        lock = RWLock(timeout=5.0)
+        results = []
+
+        def writer():
+            with lock.w_lock():
+                results.append("w")
+
+        with lock.r_lock():
+            t = threading.Thread(target=writer)
+            t.start()
+            time.sleep(0.05)
+            assert results == []
+        t.join(timeout=2)
+        assert results == ["w"]
+
+
+class TestTimeouts:
+    def test_future_timeout_fires(self):
+        fut: Future = Future()
+        wrapped = future_timeout(fut, 0.05)
+        with pytest.raises(TimeoutError):
+            wrapped.result(timeout=2)
+
+    def test_future_timeout_success(self):
+        fut: Future = Future()
+        wrapped = future_timeout(fut, 5.0)
+        fut.set_result(42)
+        assert wrapped.result(timeout=2) == 42
+
+    def test_future_timeout_exception(self):
+        fut: Future = Future()
+        wrapped = future_timeout(fut, 5.0)
+        fut.set_exception(ValueError("boom"))
+        with pytest.raises(ValueError):
+            wrapped.result(timeout=2)
+
+    def test_future_wait(self):
+        fut: Future = Future()
+        fut.set_result("ok")
+        assert future_wait(fut, 1.0) == "ok"
+        with pytest.raises(TimeoutError):
+            future_wait(Future(), 0.05)
+
+    def test_context_timeout_fires(self):
+        fired = threading.Event()
+        with context_timeout(fired.set, 0.05):
+            time.sleep(0.2)
+        assert fired.is_set()
+
+    def test_context_timeout_cancelled(self):
+        fired = threading.Event()
+        with context_timeout(fired.set, 0.5):
+            pass
+        time.sleep(0.7)
+        assert not fired.is_set()
+
+
+class TestDistributedSampler:
+    def test_shard_math(self):
+        # reference torchft/data_test.py: rank 1 of 2, group 2 of 4
+        s = DistributedSampler(100, replica_rank=2, num_replica_groups=4, rank=1, num_replicas=2)
+        assert s.global_rank == 1 + 2 * 2
+        assert s.global_world_size == 8
+        idx = list(iter(s))
+        assert len(idx) == len(s) == 13
+        assert idx[0] == s.global_rank
+
+    def test_disjoint_and_complete(self):
+        n, groups, ranks = 64, 4, 2
+        seen = []
+        for g in range(groups):
+            for r in range(ranks):
+                s = DistributedSampler(n, g, groups, r, ranks)
+                seen.extend(iter(s))
+        assert sorted(seen) == list(range(n))
+
+    def test_shuffle_deterministic(self):
+        a = DistributedSampler(50, 0, 2, shuffle=True, seed=7)
+        b = DistributedSampler(50, 0, 2, shuffle=True, seed=7)
+        a.set_epoch(3)
+        b.set_epoch(3)
+        assert list(iter(a)) == list(iter(b))
+        b.set_epoch(4)
+        assert list(iter(a)) != list(iter(b))

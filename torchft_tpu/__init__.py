@@ -1,0 +1,14 @@
+"""torchft_tpu — TPU-native per-step fault tolerance for replicated JAX training.
+
+A ground-up rebuild of the capabilities of torchft (zhengchenyu/torchft) for
+TPU: a C++ coordination core (Lighthouse quorum server + per-replica-group
+Manager), a reconfigurable dynamic-membership collective layer over DCN,
+live peer-to-peer checkpoint healing of pytree state, and training-loop
+adapters (FT-DDP, LocalSGD, DiLoCo) — designed JAX-first: inner parallelism
+(FSDP/TP/SP within a slice) is pjit sharding over ICI and stays static; the
+elastic replica dimension lives above jit so membership changes never re-jit.
+
+Public API surface mirrors reference torchft/__init__.py:7-34.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Structured event logging for quorums / commits / errors.
+
+Analog of the reference's structured-event pipeline (reference:
+torchft/otel.py:42-86 and manager.py:659-669,848-858): three well-known
+loggers receive one record per protocol event, each carrying
+``extra={job_id, replica_id, rank, quorum_id, step, ...}``.  OTLP export is
+out of scope for this environment (zero egress); the pipeline here writes
+structured records to stdlib logging with the extras rendered inline, and an
+in-memory ring of recent events that the lighthouse dashboard and tests can
+inspect.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Any, Deque, Dict
+
+_EVENT_RING_SIZE = 256
+
+_quorum_logger = logging.getLogger("torchft_quorums")
+_commit_logger = logging.getLogger("torchft_commits")
+_error_logger = logging.getLogger("torchft_errors")
+
+_lock = threading.Lock()
+_recent_events: Deque[Dict[str, Any]] = collections.deque(maxlen=_EVENT_RING_SIZE)
+
+
+def log_event(kind: str, message: str, **extra: Any) -> None:
+    """Record a structured protocol event (kind in {quorum, commit, error})."""
+    record = {"kind": kind, "message": message, **extra}
+    with _lock:
+        _recent_events.append(record)
+    logger = {
+        "quorum": _quorum_logger,
+        "commit": _commit_logger,
+        "error": _error_logger,
+    }.get(kind, _error_logger)
+    rendered = " ".join(f"{k}={v}" for k, v in extra.items())
+    if kind == "error":
+        logger.error("%s %s", message, rendered)
+    else:
+        logger.info("%s %s", message, rendered)
+
+
+def recent_events() -> "list[Dict[str, Any]]":
+    with _lock:
+        return list(_recent_events)
+
+
+class ReplicaLogger:
+    """Prefixes log lines with ``[replica_id/rank - step N]``.
+
+    Analog of reference torchft/manager.py:991-1008.
+    """
+
+    def __init__(self, manager: Any, replica_id: str, rank: int) -> None:
+        self._logger = logging.getLogger("torchft_tpu.manager")
+        self._manager = manager
+        self._replica_id = replica_id
+        self._rank = rank
+
+    def _prefix(self) -> str:
+        return f"[{self._replica_id}/{self._rank} - step {self._manager.current_step()}]"
+
+    def info(self, msg: str) -> None:
+        self._logger.info("%s %s", self._prefix(), msg)
+
+    def warning(self, msg: str) -> None:
+        self._logger.warning("%s %s", self._prefix(), msg)
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception("%s %s", self._prefix(), msg)
