@@ -1,0 +1,160 @@
+// C API exposing the native coordination servers to Python via ctypes.
+//
+// Analog of the reference's PyO3 binding layer (reference: src/lib.rs:742-758
+// registers ManagerServer/LighthouseServer/... as Python classes). Here the
+// Python side (torchft_tpu/_native.py + coordination.py) owns the client
+// protocol (framed JSON over TCP) directly; the C API only manages server
+// lifecycles plus a pure-function entry for quorum-result math so tests can
+// exercise it natively.
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "store.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+struct ServerHandle {
+  enum class Kind { Lighthouse, Manager, Store } kind;
+  std::unique_ptr<tft::RpcServer> server;
+};
+
+std::mutex g_mu;
+std::map<int64_t, ServerHandle> g_servers;
+int64_t g_next_handle = 1;
+
+int64_t register_server(ServerHandle h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t id = g_next_handle++;
+  g_servers[id] = std::move(h);
+  return id;
+}
+
+tft::RpcServer* find_server(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? nullptr : it->second.server.get();
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tft_last_error() { return g_last_error.c_str(); }
+
+void tft_free(char* p) { free(p); }
+
+int64_t tft_lighthouse_create(const char* bind_host, int port,
+                              int64_t min_replicas, int64_t join_timeout_ms,
+                              int64_t quorum_tick_ms,
+                              int64_t heartbeat_timeout_ms) {
+  try {
+    tft::LighthouseOpt opt;
+    opt.bind_host = bind_host ? bind_host : "";
+    opt.port = port;
+    opt.min_replicas = min_replicas;
+    opt.join_timeout_ms = join_timeout_ms;
+    opt.quorum_tick_ms = quorum_tick_ms;
+    opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    auto server = std::make_unique<tft::LighthouseServer>(opt);
+    server->start_serving();
+    return register_server(
+        {ServerHandle::Kind::Lighthouse, std::move(server)});
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int64_t tft_manager_create(const char* replica_id, const char* lighthouse_addr,
+                           const char* bind_host, int port,
+                           const char* store_address, int64_t world_size,
+                           int64_t heartbeat_interval_ms,
+                           int64_t connect_timeout_ms,
+                           int64_t quorum_retries) {
+  try {
+    tft::ManagerOpt opt;
+    opt.replica_id = replica_id ? replica_id : "";
+    opt.lighthouse_addr = lighthouse_addr ? lighthouse_addr : "";
+    opt.bind_host = bind_host ? bind_host : "";
+    opt.port = port;
+    opt.store_address = store_address ? store_address : "";
+    opt.world_size = world_size;
+    opt.heartbeat_interval_ms = heartbeat_interval_ms;
+    opt.connect_timeout_ms = connect_timeout_ms;
+    opt.quorum_retries = quorum_retries;
+    auto server = std::make_unique<tft::ManagerServer>(opt);
+    server->start_serving();
+    return register_server({ServerHandle::Kind::Manager, std::move(server)});
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int64_t tft_store_create(const char* bind_host, int port) {
+  try {
+    auto server = std::make_unique<tft::StoreServer>(
+        bind_host ? bind_host : "", port);
+    server->start();
+    return register_server({ServerHandle::Kind::Store, std::move(server)});
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+char* tft_server_address(int64_t h) {
+  tft::RpcServer* s = find_server(h);
+  if (!s) {
+    g_last_error = "bad server handle";
+    return nullptr;
+  }
+  return dup_string(s->address());
+}
+
+int tft_server_shutdown(int64_t h) {
+  std::unique_ptr<tft::RpcServer> server;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) {
+      g_last_error = "bad server handle";
+      return -1;
+    }
+    server = std::move(it->second.server);
+    g_servers.erase(it);
+  }
+  // Destructor runs stop()/shutdown() for each server type.
+  server.reset();
+  return 0;
+}
+
+// Pure quorum-result math, exposed for unit tests: input/output JSON.
+char* tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
+                                 const char* quorum_json, int init_sync) {
+  try {
+    tft::Quorum quorum =
+        tft::Quorum::from_json(tft::Json::parse(quorum_json));
+    tft::QuorumResult result = tft::compute_quorum_results(
+        replica_id ? replica_id : "", group_rank, quorum, init_sync != 0);
+    return dup_string(result.to_json().dump());
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+}  // extern "C"

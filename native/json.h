@@ -1,0 +1,337 @@
+// Minimal JSON value + parser + serializer for the coordination protocol.
+//
+// The coordination wire format (analog of the reference's gRPC protobufs,
+// reference: proto/torchft.proto) is length-prefixed JSON objects; this is the
+// only JSON implementation the native core depends on. Supports
+// null/bool/int64/double/string/array/object, UTF-8 passthrough, \uXXXX
+// escapes on parse.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tft {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), double_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Int || type_ == Type::Double; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    return dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    if (type_ == Type::Double) return double_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  JsonArray& mutable_array() {
+    if (type_ != Type::Array) throw std::runtime_error("json: not an array");
+    return arr_;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  // Object access. get() returns Null json for missing keys.
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+  Json& operator[](const std::string& key) {
+    if (type_ == Type::Null) type_ = Type::Object;
+    if (type_ != Type::Object) throw std::runtime_error("json: not an object");
+    return obj_[key];
+  }
+  void push_back(Json v) {
+    if (type_ == Type::Null) type_ = Type::Array;
+    if (type_ != Type::Array) throw std::runtime_error("json: not an array");
+    arr_.push_back(std::move(v));
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Int: os << int_; break;
+      case Type::Double: {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%.17g", double_);
+        os << buf;
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) os << ',';
+          first = false;
+          v.write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& pos) {
+    while (pos < t.size() &&
+           (t[pos] == ' ' || t[pos] == '\t' || t[pos] == '\n' || t[pos] == '\r'))
+      pos++;
+  }
+
+  static Json parse_value(const std::string& t, size_t& pos) {
+    skip_ws(t, pos);
+    if (pos >= t.size()) throw std::runtime_error("json: unexpected end");
+    char c = t[pos];
+    if (c == '{') return parse_object(t, pos);
+    if (c == '[') return parse_array(t, pos);
+    if (c == '"') return Json(parse_string(t, pos));
+    if (c == 't') { expect(t, pos, "true"); return Json(true); }
+    if (c == 'f') { expect(t, pos, "false"); return Json(false); }
+    if (c == 'n') { expect(t, pos, "null"); return Json(nullptr); }
+    return parse_number(t, pos);
+  }
+
+  static void expect(const std::string& t, size_t& pos, const char* lit) {
+    size_t n = strlen(lit);
+    if (t.compare(pos, n, lit) != 0)
+      throw std::runtime_error("json: bad literal");
+    pos += n;
+  }
+
+  static Json parse_object(const std::string& t, size_t& pos) {
+    Json out = Json::object();
+    pos++;  // '{'
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == '}') { pos++; return out; }
+    while (true) {
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != '"')
+        throw std::runtime_error("json: expected key");
+      std::string key = parse_string(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != ':')
+        throw std::runtime_error("json: expected ':'");
+      pos++;
+      out[key] = parse_value(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("json: unexpected end");
+      if (t[pos] == ',') { pos++; continue; }
+      if (t[pos] == '}') { pos++; return out; }
+      throw std::runtime_error("json: expected ',' or '}'");
+    }
+  }
+
+  static Json parse_array(const std::string& t, size_t& pos) {
+    Json out = Json::array();
+    pos++;  // '['
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == ']') { pos++; return out; }
+    while (true) {
+      out.push_back(parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("json: unexpected end");
+      if (t[pos] == ',') { pos++; continue; }
+      if (t[pos] == ']') { pos++; return out; }
+      throw std::runtime_error("json: expected ',' or ']'");
+    }
+  }
+
+  static std::string parse_string(const std::string& t, size_t& pos) {
+    pos++;  // '"'
+    std::string out;
+    while (pos < t.size()) {
+      char c = t[pos];
+      if (c == '"') { pos++; return out; }
+      if (c == '\\') {
+        pos++;
+        if (pos >= t.size()) break;
+        char e = t[pos];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (pos + 4 >= t.size()) throw std::runtime_error("json: bad \\u");
+            unsigned int cp = std::stoul(t.substr(pos + 1, 4), nullptr, 16);
+            pos += 4;
+            // Encode BMP codepoint as UTF-8 (surrogate pairs combined).
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos + 6 < t.size() &&
+                t[pos + 1] == '\\' && t[pos + 2] == 'u') {
+              unsigned int lo = std::stoul(t.substr(pos + 3, 4), nullptr, 16);
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              pos += 6;
+            }
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            throw std::runtime_error("json: bad escape");
+        }
+        pos++;
+      } else {
+        out += c;
+        pos++;
+      }
+    }
+    throw std::runtime_error("json: unterminated string");
+  }
+
+  static Json parse_number(const std::string& t, size_t& pos) {
+    size_t start = pos;
+    bool is_double = false;
+    if (pos < t.size() && (t[pos] == '-' || t[pos] == '+')) pos++;
+    while (pos < t.size()) {
+      char c = t[pos];
+      if (c >= '0' && c <= '9') { pos++; continue; }
+      if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+        if (c == '.' || c == 'e' || c == 'E') is_double = true;
+        pos++;
+        continue;
+      }
+      break;
+    }
+    std::string num = t.substr(start, pos - start);
+    if (num.empty()) throw std::runtime_error("json: bad number");
+    try {
+      if (is_double) return Json(std::stod(num));
+      return Json(static_cast<int64_t>(std::stoll(num)));
+    } catch (const std::exception&) {
+      throw std::runtime_error("json: bad number: " + num);
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace tft

@@ -1,0 +1,376 @@
+#include "lighthouse.h"
+
+#include <unistd.h>
+#include <string.h>
+
+#include <algorithm>
+#include <chrono>
+#include <set>
+#include <sstream>
+
+namespace tft {
+
+namespace {
+int64_t wall_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+Json QuorumMember::to_json() const {
+  Json j = Json::object();
+  j["replica_id"] = replica_id;
+  j["address"] = address;
+  j["store_address"] = store_address;
+  j["step"] = step;
+  j["world_size"] = world_size;
+  j["shrink_only"] = shrink_only;
+  j["commit_failures"] = commit_failures;
+  j["data"] = data;
+  return j;
+}
+
+QuorumMember QuorumMember::from_json(const Json& j) {
+  QuorumMember m;
+  m.replica_id = j.get("replica_id").as_string();
+  m.address = j.get("address").as_string();
+  m.store_address = j.get("store_address").as_string();
+  m.step = j.get("step").as_int();
+  m.world_size = j.get("world_size").as_int(1);
+  m.shrink_only = j.get("shrink_only").as_bool();
+  m.commit_failures = j.get("commit_failures").as_int();
+  m.data = j.get("data").as_string();
+  return m;
+}
+
+Json Quorum::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = quorum_id;
+  Json parts = Json::array();
+  for (const auto& p : participants) parts.push_back(p.to_json());
+  j["participants"] = parts;
+  j["created_ms"] = created_ms;
+  return j;
+}
+
+Quorum Quorum::from_json(const Json& j) {
+  Quorum q;
+  q.quorum_id = j.get("quorum_id").as_int();
+  for (const auto& p : j.get("participants").as_array())
+    q.participants.push_back(QuorumMember::from_json(p));
+  q.created_ms = j.get("created_ms").as_int();
+  return q;
+}
+
+LighthouseServer::LighthouseServer(const LighthouseOpt& opt)
+    : RpcServer(opt.bind_host, opt.port), opt_(opt) {}
+
+LighthouseServer::~LighthouseServer() { stop(); }
+
+void LighthouseServer::start_serving() {
+  start();
+  tick_thread_ = std::thread([this] { tick_loop(); });
+}
+
+void LighthouseServer::stop() {
+  shutdown();  // idempotent; closes conns and calls wake_blocked()
+  if (tick_thread_.joinable()) tick_thread_.join();
+}
+
+void LighthouseServer::wake_blocked() {
+  std::lock_guard<std::mutex> g(mu_);
+  quorum_cv_.notify_all();
+}
+
+void LighthouseServer::tick_loop() {
+  while (!stopping_.load()) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      tick_locked(now_ms());
+    }
+    usleep(static_cast<useconds_t>(opt_.quorum_tick_ms * 1000));
+  }
+}
+
+std::optional<std::vector<QuorumMember>> LighthouseServer::quorum_compute(
+    int64_t now, std::string* reason) {
+  // Healthy = heartbeat seen within the timeout window.
+  std::set<std::string> healthy_replicas;
+  for (const auto& [rid, last] : heartbeats_)
+    if (now - last < opt_.heartbeat_timeout_ms) healthy_replicas.insert(rid);
+
+  std::vector<const ParticipantDetails*> healthy_participants;
+  for (const auto& [rid, det] : participants_)
+    if (healthy_replicas.count(rid)) healthy_participants.push_back(&det);
+
+  std::vector<QuorumMember> candidates;
+  for (const auto* det : healthy_participants)
+    candidates.push_back(det->member);
+  std::sort(candidates.begin(), candidates.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  bool shrink_only = std::any_of(
+      healthy_participants.begin(), healthy_participants.end(),
+      [](const ParticipantDetails* d) { return d->member.shrink_only; });
+
+  std::ostringstream meta;
+  meta << "[" << healthy_participants.size() << "/" << participants_.size()
+       << " participants healthy][" << healthy_replicas.size()
+       << " heartbeating][shrink_only=" << (shrink_only ? "true" : "false")
+       << "]";
+
+  if (prev_quorum_.has_value()) {
+    std::set<std::string> prev_ids;
+    for (const auto& p : prev_quorum_->participants)
+      prev_ids.insert(p.replica_id);
+
+    if (shrink_only) {
+      std::vector<QuorumMember> filtered;
+      for (auto& c : candidates)
+        if (prev_ids.count(c.replica_id)) filtered.push_back(c);
+      candidates = std::move(filtered);
+    }
+
+    // Fast quorum: every member of the previous quorum is again a healthy
+    // participant — no need to wait for join timeout.
+    std::set<std::string> participating;
+    for (const auto* d : healthy_participants)
+      participating.insert(d->member.replica_id);
+    bool fast = std::all_of(
+        prev_ids.begin(), prev_ids.end(),
+        [&](const std::string& id) { return participating.count(id) > 0; });
+    if (fast) {
+      *reason = "Fast quorum found! " + meta.str();
+      return candidates;
+    }
+  }
+
+  if (static_cast<int64_t>(healthy_participants.size()) < opt_.min_replicas) {
+    *reason = "New quorum not ready, only have " +
+              std::to_string(healthy_participants.size()) +
+              " participants, need min_replicas " +
+              std::to_string(opt_.min_replicas) + " " + meta.str();
+    return std::nullopt;
+  }
+
+  // Split-brain guard: strictly more than half of all healthy replicas must
+  // be participating.
+  if (healthy_participants.size() <= healthy_replicas.size() / 2) {
+    *reason = "New quorum not ready, only have " +
+              std::to_string(healthy_participants.size()) +
+              " participants, need at least half of " +
+              std::to_string(healthy_replicas.size()) + " healthy workers " +
+              meta.str();
+    return std::nullopt;
+  }
+
+  bool all_healthy_joined =
+      healthy_participants.size() == healthy_replicas.size();
+  int64_t first_joined = now;
+  for (const auto* d : healthy_participants)
+    first_joined = std::min(first_joined, d->joined_ms);
+  if (!all_healthy_joined && now - first_joined < opt_.join_timeout_ms) {
+    *reason = "Valid quorum with " +
+              std::to_string(healthy_participants.size()) +
+              " participants, waiting for " +
+              std::to_string(healthy_replicas.size() -
+                             healthy_participants.size()) +
+              " healthy but not participating stragglers due to join timeout " +
+              meta.str();
+    return std::nullopt;
+  }
+
+  *reason = "Valid quorum found " + meta.str();
+  return candidates;
+}
+
+void LighthouseServer::tick_locked(int64_t now) {
+  std::string reason;
+  auto maybe = quorum_compute(now, &reason);
+  last_reason_ = reason;
+  if (!maybe.has_value()) return;
+
+  std::vector<QuorumMember>& parts = *maybe;
+
+  bool membership_changed = true;
+  if (prev_quorum_.has_value()) {
+    std::vector<std::string> a, b;
+    for (const auto& p : parts) a.push_back(p.replica_id);
+    for (const auto& p : prev_quorum_->participants) b.push_back(p.replica_id);
+    membership_changed = a != b;
+  }
+  bool commit_failure = std::any_of(
+      parts.begin(), parts.end(),
+      [](const QuorumMember& p) { return p.commit_failures > 0; });
+  if (membership_changed || commit_failure) quorum_id_ += 1;
+
+  Quorum q;
+  q.quorum_id = quorum_id_;
+  q.participants = parts;
+  q.created_ms = wall_ms();
+
+  prev_quorum_ = q;
+  participants_.clear();
+  latest_quorum_ = q;
+  quorum_seq_ += 1;
+  quorum_cv_.notify_all();
+}
+
+bool LighthouseServer::tick_for_test() {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t seq = quorum_seq_;
+  tick_locked(now_ms());
+  return quorum_seq_ != seq;
+}
+
+Json LighthouseServer::handle(const std::string& method, const Json& params,
+                              int64_t timeout_ms) {
+  if (method == "quorum") return rpc_quorum(params, timeout_ms);
+  if (method == "heartbeat") return rpc_heartbeat(params);
+  if (method == "status") {
+    std::lock_guard<std::mutex> g(mu_);
+    Json out = Json::object();
+    out["quorum_id"] = quorum_id_;
+    out["reason"] = last_reason_;
+    out["num_participants"] = static_cast<int64_t>(participants_.size());
+    if (prev_quorum_.has_value()) out["prev_quorum"] = prev_quorum_->to_json();
+    return out;
+  }
+  throw std::runtime_error("lighthouse: unknown method " + method);
+}
+
+Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
+  QuorumMember requester = QuorumMember::from_json(params.get("member"));
+  if (requester.replica_id.empty())
+    throw std::runtime_error("missing requester replica_id");
+
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t now = now_ms();
+  // Implicit heartbeat + registration.
+  heartbeats_[requester.replica_id] = now;
+  participants_[requester.replica_id] = {requester, now};
+  int64_t seen_seq = quorum_seq_;
+  // Proactive tick so a completing quorum doesn't wait for the next tick.
+  tick_locked(now);
+
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  // While blocked, keep our own heartbeat fresh in wait slices: a waiter is
+  // by definition alive, and letting it age out would wedge quorum formation
+  // for clients without a background heartbeat thread.
+  auto wait_slice = std::chrono::milliseconds(
+      std::max<int64_t>(1, std::min<int64_t>(opt_.heartbeat_timeout_ms / 2,
+                                             1000)));
+  while (true) {
+    if (quorum_seq_ != seen_seq) {
+      seen_seq = quorum_seq_;
+      const Quorum& q = latest_quorum_;
+      bool included = std::any_of(
+          q.participants.begin(), q.participants.end(),
+          [&](const QuorumMember& p) {
+            return p.replica_id == requester.replica_id;
+          });
+      if (included) {
+        Json out = Json::object();
+        out["quorum"] = q.to_json();
+        return out;
+      }
+      // A quorum formed without us (e.g. we registered right after a tick
+      // cleared participants) — re-register and keep waiting.
+      participants_[requester.replica_id] = {requester, now_ms()};
+    }
+    if (stopping_.load())
+      throw std::runtime_error("lighthouse shutting down");
+    heartbeats_[requester.replica_id] = now_ms();
+    if (std::chrono::steady_clock::now() >= deadline)
+      throw TimeoutError("timeout waiting for quorum");
+    quorum_cv_.wait_for(lk, wait_slice);
+  }
+}
+
+Json LighthouseServer::rpc_heartbeat(const Json& params) {
+  std::lock_guard<std::mutex> g(mu_);
+  heartbeats_[params.get("replica_id").as_string()] = now_ms();
+  return Json::object();
+}
+
+void LighthouseServer::handle_http(int fd, const std::string& request_head) {
+  // First line: "METHOD /path HTTP/1.1"
+  std::istringstream is(request_head);
+  std::string method, path;
+  is >> method >> path;
+
+  if (method == "POST" && path.rfind("/replica/", 0) == 0) {
+    // /replica/{id}/kill — forward a kill RPC to that replica's manager.
+    std::string rest = path.substr(strlen("/replica/"));
+    size_t slash = rest.find('/');
+    if (slash != std::string::npos && rest.substr(slash) == "/kill") {
+      std::string replica_id = rest.substr(0, slash);
+      std::string addr;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (prev_quorum_.has_value())
+          for (const auto& p : prev_quorum_->participants)
+            if (p.replica_id == replica_id) addr = p.address;
+      }
+      if (addr.empty()) {
+        http_reply(fd, 404, "text/plain", "replica not found\n");
+        return;
+      }
+      Json params = Json::object();
+      params["msg"] = "killed from dashboard";
+      Json result;
+      std::string err;
+      // Kill exits the remote process mid-RPC, so failure to read a reply is
+      // expected; fire and report accepted.
+      call_rpc(addr, "kill", params, 5000, &result, &err);
+      http_reply(fd, 200, "text/plain", "kill sent to " + replica_id + "\n");
+      return;
+    }
+  }
+  if (method == "GET" && (path == "/" || path == "/status")) {
+    http_reply(fd, 200, "text/html", render_status_html());
+    return;
+  }
+  http_reply(fd, 404, "text/plain", "not found\n");
+}
+
+std::string LighthouseServer::render_status_html() {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  std::ostringstream os;
+  os << "<!doctype html><html><head><title>torchft_tpu lighthouse</title>"
+     << "<style>body{font-family:monospace;margin:2em}table{border-collapse:"
+        "collapse}td,th{border:1px solid #888;padding:4px 8px}</style>"
+     << "</head><body><h1>torchft_tpu lighthouse</h1>"
+     << "<p>quorum_id: " << quorum_id_ << "</p>"
+     << "<p>status: " << last_reason_ << "</p>";
+  if (prev_quorum_.has_value()) {
+    os << "<h2>previous quorum (id " << prev_quorum_->quorum_id << ")</h2>"
+       << "<table><tr><th>replica</th><th>step</th><th>address</th>"
+       << "<th>heartbeat age (ms)</th><th>state</th><th></th></tr>";
+    int64_t max_step = 0;
+    for (const auto& p : prev_quorum_->participants)
+      max_step = std::max(max_step, p.step);
+    for (const auto& p : prev_quorum_->participants) {
+      auto hb = heartbeats_.find(p.replica_id);
+      int64_t age = hb == heartbeats_.end() ? -1 : now - hb->second;
+      os << "<tr><td>" << p.replica_id << "</td><td>" << p.step << "</td><td>"
+         << p.address << "</td><td>" << age << "</td><td>"
+         << (p.step < max_step ? "recovering" : "healthy") << "</td>"
+         << "<td><form method=post action=\"/replica/" << p.replica_id
+         << "/kill\"><button>kill</button></form></td></tr>";
+    }
+    os << "</table>";
+  }
+  os << "<h2>pending participants (" << participants_.size() << ")</h2><ul>";
+  for (const auto& [rid, det] : participants_)
+    os << "<li>" << rid << " (step " << det.member.step << ")</li>";
+  os << "</ul></body></html>";
+  return os.str();
+}
+
+}  // namespace tft

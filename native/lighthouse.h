@@ -1,0 +1,118 @@
+// Lighthouse: the cluster-wide membership & quorum authority.
+//
+// TPU-native C++ rebuild of the reference's Rust lighthouse
+// (reference: src/lighthouse.rs). One lighthouse process (or in-process
+// server) per job; replica-group managers call quorum() (blocking until a
+// quorum containing them forms) and heartbeat(). Serves framed-JSON RPC and
+// an HTML status dashboard on the same port (protocol sniffed per
+// connection).
+//
+// Quorum decision rules (parity with reference src/lighthouse.rs:141-269):
+//   - healthy = heartbeat within heartbeat_timeout_ms (joining counts).
+//   - shrink_only: candidates filtered to previous-quorum members.
+//   - fast quorum: all previous-quorum members healthy & participating.
+//   - else: >= min_replicas healthy participants, AND strictly more than
+//     half of all healthy replicas participating (split-brain guard), AND
+//     either all healthy replicas joined or join_timeout_ms elapsed since
+//     the first joiner (straggler wait).
+//   - quorum_id bumps when membership changed vs previous quorum, or any
+//     member reported commit_failures > 0.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+
+namespace tft {
+
+struct QuorumMember {
+  std::string replica_id;
+  std::string address;        // manager RPC address
+  std::string store_address;  // rendezvous store address
+  int64_t step = 0;
+  int64_t world_size = 1;
+  bool shrink_only = false;
+  int64_t commit_failures = 0;
+  std::string data;  // opaque JSON passthrough
+
+  Json to_json() const;
+  static QuorumMember from_json(const Json& j);
+};
+
+struct Quorum {
+  int64_t quorum_id = 0;
+  std::vector<QuorumMember> participants;
+  int64_t created_ms = 0;  // wall-clock ms since unix epoch
+
+  Json to_json() const;
+  static Quorum from_json(const Json& j);
+};
+
+struct LighthouseOpt {
+  std::string bind_host;  // advertise host; empty = machine hostname
+  int port = 0;
+  int64_t min_replicas = 1;
+  int64_t join_timeout_ms = 100;
+  int64_t quorum_tick_ms = 100;
+  int64_t heartbeat_timeout_ms = 5000;
+};
+
+class LighthouseServer : public RpcServer {
+ public:
+  explicit LighthouseServer(const LighthouseOpt& opt);
+  ~LighthouseServer() override;
+
+  void start_serving();
+  void stop();
+
+  // Exposed for unit tests: run one quorum decision against current state.
+  // Returns the quorum participants if a quorum formed (state updated).
+  bool tick_for_test();
+
+ protected:
+  Json handle(const std::string& method, const Json& params,
+              int64_t timeout_ms) override;
+  void handle_http(int fd, const std::string& request_head) override;
+  void wake_blocked() override;
+
+ private:
+  struct ParticipantDetails {
+    QuorumMember member;
+    int64_t joined_ms = 0;
+  };
+
+  // Pure decision function over current state; returns participants if a
+  // quorum can form now, plus a human-readable reason either way.
+  std::optional<std::vector<QuorumMember>> quorum_compute(int64_t now,
+                                                          std::string* reason);
+  // Runs one tick under mu_: compute, bump quorum_id, broadcast.
+  void tick_locked(int64_t now);
+  void tick_loop();
+
+  Json rpc_quorum(const Json& params, int64_t timeout_ms);
+  Json rpc_heartbeat(const Json& params);
+  std::string render_status_html();
+
+  LighthouseOpt opt_;
+
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;
+  std::map<std::string, ParticipantDetails> participants_;
+  std::map<std::string, int64_t> heartbeats_;
+  std::optional<Quorum> prev_quorum_;
+  int64_t quorum_id_ = 0;
+  // Broadcast: monotonically increasing sequence of formed quorums.
+  int64_t quorum_seq_ = 0;
+  Quorum latest_quorum_;
+  std::string last_reason_;
+
+  std::thread tick_thread_;
+};
+
+}  // namespace tft

@@ -1,0 +1,306 @@
+#include "manager.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+
+namespace tft {
+
+Json QuorumResult::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = quorum_id;
+  j["recover_src_manager_address"] = recover_src_manager_address;
+  if (recover_src_replica_rank.has_value())
+    j["recover_src_replica_rank"] = *recover_src_replica_rank;
+  else
+    j["recover_src_replica_rank"] = nullptr;
+  Json dsts = Json::array();
+  for (int64_t r : recover_dst_replica_ranks) dsts.push_back(r);
+  j["recover_dst_replica_ranks"] = dsts;
+  j["store_address"] = store_address;
+  j["max_step"] = max_step;
+  if (max_replica_rank.has_value())
+    j["max_replica_rank"] = *max_replica_rank;
+  else
+    j["max_replica_rank"] = nullptr;
+  j["max_world_size"] = max_world_size;
+  j["replica_rank"] = replica_rank;
+  j["replica_world_size"] = replica_world_size;
+  j["heal"] = heal;
+  j["commit_failures"] = commit_failures;
+  return j;
+}
+
+QuorumResult compute_quorum_results(const std::string& replica_id,
+                                    int64_t group_rank, const Quorum& quorum,
+                                    bool init_sync) {
+  std::vector<QuorumMember> participants = quorum.participants;
+  std::sort(participants.begin(), participants.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  // This replica's rank within the sorted quorum.
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); i++)
+    if (participants[i].replica_id == replica_id)
+      replica_rank = static_cast<int64_t>(i);
+  if (replica_rank < 0)
+    throw std::runtime_error("replica " + replica_id +
+                             " not participating in returned quorum");
+
+  // The cohort at max step defines who is up to date.
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step);
+  std::vector<int64_t> max_ranks;  // indices into participants
+  for (size_t i = 0; i < participants.size(); i++)
+    if (participants[i].step == max_step)
+      max_ranks.push_back(static_cast<int64_t>(i));
+
+  std::optional<int64_t> max_replica_rank;
+  for (size_t i = 0; i < max_ranks.size(); i++)
+    if (participants[max_ranks[i]].replica_id == replica_id)
+      max_replica_rank = static_cast<int64_t>(i);
+
+  // Primary rendezvous store owner for this local rank: spread local ranks
+  // across the up-to-date replicas.
+  const QuorumMember& primary =
+      participants[max_ranks[group_rank % static_cast<int64_t>(
+                                 max_ranks.size())]];
+
+  // Recovery destinations: behind max step, or (init_sync at step 0) every
+  // non-primary replica so all start from identical weights.
+  bool force_recover = init_sync && max_step == 0;
+  std::vector<int64_t> recover_dsts;
+  for (size_t i = 0; i < participants.size(); i++) {
+    const auto& p = participants[i];
+    if (p.step != max_step ||
+        (force_recover && primary.replica_id != p.replica_id))
+      recover_dsts.push_back(static_cast<int64_t>(i));
+  }
+  std::vector<int64_t> up_to_date;
+  for (size_t i = 0; i < participants.size(); i++)
+    if (std::find(recover_dsts.begin(), recover_dsts.end(),
+                  static_cast<int64_t>(i)) == recover_dsts.end())
+      up_to_date.push_back(static_cast<int64_t>(i));
+
+  // Round-robin recovery sources, offset by group_rank so different local
+  // ranks of the same dst replica pull from different sources.
+  std::map<int64_t, std::vector<int64_t>> assignments;  // src -> [dst...]
+  std::optional<int64_t> recover_src_replica_rank;
+  for (size_t i = 0; i < recover_dsts.size(); i++) {
+    int64_t src = up_to_date[(static_cast<int64_t>(i) + group_rank) %
+                             static_cast<int64_t>(up_to_date.size())];
+    assignments[src].push_back(recover_dsts[i]);
+    if (recover_dsts[i] == replica_rank) recover_src_replica_rank = src;
+  }
+
+  QuorumResult out;
+  out.quorum_id = quorum.quorum_id;
+  out.recover_src_replica_rank = recover_src_replica_rank;
+  if (recover_src_replica_rank.has_value())
+    out.recover_src_manager_address =
+        participants[*recover_src_replica_rank].address;
+  if (assignments.count(replica_rank))
+    out.recover_dst_replica_ranks = assignments[replica_rank];
+  out.store_address = primary.store_address;
+  out.max_step = max_step;
+  out.max_replica_rank = max_replica_rank;
+  out.max_world_size = static_cast<int64_t>(max_ranks.size());
+  out.replica_rank = replica_rank;
+  out.replica_world_size = static_cast<int64_t>(participants.size());
+  out.heal = recover_src_replica_rank.has_value();
+  for (const auto& p : participants)
+    out.commit_failures = std::max(out.commit_failures, p.commit_failures);
+  return out;
+}
+
+ManagerServer::ManagerServer(const ManagerOpt& opt)
+    : RpcServer(opt.bind_host, opt.port), opt_(opt) {}
+
+ManagerServer::~ManagerServer() { stop(); }
+
+void ManagerServer::start_serving() {
+  start();
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+void ManagerServer::stop() {
+  shutdown();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  // Detached quorum threads finish within their request timeout.
+  while (inflight_quorums_.load() > 0) usleep(10 * 1000);
+}
+
+void ManagerServer::wake_blocked() {
+  std::lock_guard<std::mutex> g(mu_);
+  cv_.notify_all();
+}
+
+void ManagerServer::heartbeat_loop() {
+  RpcClient client(opt_.lighthouse_addr);
+  while (!stopping_.load()) {
+    Json params = Json::object();
+    params["replica_id"] = opt_.replica_id;
+    try {
+      client.call("heartbeat", params, opt_.connect_timeout_ms);
+    } catch (const std::exception&) {
+      // Lighthouse unreachable: keep trying; quorum path surfaces errors.
+      client.close();
+    }
+    usleep(static_cast<useconds_t>(opt_.heartbeat_interval_ms * 1000));
+  }
+}
+
+Json ManagerServer::handle(const std::string& method, const Json& params,
+                           int64_t timeout_ms) {
+  if (method == "quorum") return rpc_quorum(params, timeout_ms);
+  if (method == "should_commit") return rpc_should_commit(params, timeout_ms);
+  if (method == "checkpoint_metadata") {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t rank = params.get("rank").as_int();
+    auto it = checkpoint_metadata_.find(rank);
+    if (it == checkpoint_metadata_.end())
+      throw std::runtime_error("rank not found");
+    Json out = Json::object();
+    out["checkpoint_metadata"] = it->second;
+    return out;
+  }
+  if (method == "kill") {
+    fprintf(stderr, "torchft_tpu manager: got kill request: %s\n",
+            params.get("msg").as_string().c_str());
+    fflush(stderr);
+    _exit(1);
+  }
+  throw std::runtime_error("manager: unknown method " + method);
+}
+
+Json ManagerServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
+  int64_t group_rank = params.get("group_rank").as_int();
+  bool init_sync = params.get("init_sync").as_bool(true);
+
+  int64_t round;
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    checkpoint_metadata_[group_rank] =
+        params.get("checkpoint_metadata").as_string();
+
+    QuorumMember member;
+    member.replica_id = opt_.replica_id;
+    member.address = address();
+    member.store_address = opt_.store_address;
+    member.step = params.get("step").as_int();
+    member.world_size = opt_.world_size;
+    member.shrink_only = params.get("shrink_only").as_bool();
+    member.commit_failures = params.get("commit_failures").as_int();
+
+    quorum_participants_.insert(group_rank);
+    round = quorum_round_seq_;
+
+    if (static_cast<int64_t>(quorum_participants_.size()) ==
+        opt_.world_size) {
+      quorum_participants_.clear();
+      latest_quorum_.reset();
+      quorum_error_.clear();
+      // The last-arriving rank's request parameters drive the cluster call
+      // (parity with reference src/manager.rs:365-383).
+      inflight_quorums_.fetch_add(1);
+      std::thread([this, member, timeout_ms] {
+        run_quorum(member, timeout_ms);
+        inflight_quorums_.fetch_sub(1);
+      }).detach();
+    }
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    if (quorum_round_seq_ > round) {
+      if (!quorum_error_.empty()) throw std::runtime_error(quorum_error_);
+      if (!latest_quorum_.has_value())
+        // A newer round's last arrival reset the result before this stale
+        // waiter woke (its client likely already timed out and retried).
+        throw std::runtime_error("quorum round superseded; retry");
+      QuorumResult result = compute_quorum_results(
+          opt_.replica_id, group_rank, *latest_quorum_, init_sync);
+      return result.to_json();
+    }
+    if (stopping_.load()) throw std::runtime_error("manager shutting down");
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+      throw TimeoutError("timeout waiting for quorum");
+  }
+}
+
+void ManagerServer::run_quorum(QuorumMember member, int64_t timeout_ms) {
+  Json params = Json::object();
+  params["member"] = member.to_json();
+
+  std::string error;
+  std::optional<Quorum> quorum;
+  int64_t retries = std::max<int64_t>(opt_.quorum_retries, 0);
+  for (int64_t attempt = 0; attempt <= retries && !stopping_.load();
+       attempt++) {
+    try {
+      // Fresh client per attempt: the lighthouse may have restarted
+      // (reference resets its channel on retry, src/manager.rs:303-306).
+      RpcClient client(opt_.lighthouse_addr);
+      Json result = client.call("quorum", params, timeout_ms);
+      quorum = Quorum::from_json(result.get("quorum"));
+      error.clear();
+      break;
+    } catch (const std::exception& e) {
+      error = e.what();
+      if (attempt < retries) {
+        int64_t sleep_ms =
+            std::max<int64_t>(100, timeout_ms / (retries + 1));
+        usleep(static_cast<useconds_t>(sleep_ms * 1000));
+      }
+    }
+  }
+
+  std::lock_guard<std::mutex> g(mu_);
+  if (quorum.has_value()) {
+    latest_quorum_ = quorum;
+    quorum_error_.clear();
+  } else {
+    quorum_error_ = "lighthouse quorum failed after " +
+                    std::to_string(retries) + " retries: " + error;
+  }
+  quorum_round_seq_ += 1;
+  cv_.notify_all();
+}
+
+Json ManagerServer::rpc_should_commit(const Json& params, int64_t timeout_ms) {
+  int64_t group_rank = params.get("group_rank").as_int();
+  bool vote = params.get("should_commit").as_bool();
+
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t round = commit_round_seq_;
+  if (!vote) commit_failures_.insert(group_rank);
+  commit_votes_.insert(group_rank);
+
+  if (static_cast<int64_t>(commit_votes_.size()) == opt_.world_size) {
+    commit_decision_ = commit_failures_.empty();
+    commit_votes_.clear();
+    commit_failures_.clear();
+    commit_round_seq_ += 1;
+    cv_.notify_all();
+  }
+
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (commit_round_seq_ == round) {
+    if (stopping_.load()) throw std::runtime_error("manager shutting down");
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+      throw TimeoutError("timeout waiting for should_commit barrier");
+  }
+  Json out = Json::object();
+  out["should_commit"] = commit_decision_;
+  return out;
+}
+
+}  // namespace tft

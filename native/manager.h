@@ -1,0 +1,110 @@
+// Manager: per-replica-group coordination server.
+//
+// TPU-native C++ rebuild of the reference's Rust manager
+// (reference: src/manager.rs). Runs on the group's rank-0 host, embedded in
+// the trainer process. Aggregates the group's local ranks:
+//   - quorum(): collects all world_size ranks' requests (storing each rank's
+//     checkpoint transport metadata), then the last-arriving rank triggers
+//     one Lighthouse quorum RPC; the resulting cluster quorum is turned into
+//     per-rank instructions by compute_quorum_results and broadcast to all
+//     blocked local waiters. Lighthouse failures retried quorum_retries
+//     times with client re-creation (reference: src/manager.rs:250-327).
+//   - should_commit(): barriers all local ranks, ANDs their votes
+//     (reference: src/manager.rs:423-479).
+//   - checkpoint_metadata(rank): serves the stored transport metadata.
+//   - kill(): exits the process (chaos/dashboard endpoint).
+// A background thread heartbeats the Lighthouse every heartbeat_interval_ms.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "lighthouse.h"
+#include "net.h"
+
+namespace tft {
+
+struct QuorumResult {
+  int64_t quorum_id = 0;
+  std::string recover_src_manager_address;
+  std::optional<int64_t> recover_src_replica_rank;
+  std::vector<int64_t> recover_dst_replica_ranks;
+  std::string store_address;
+  int64_t max_step = 0;
+  std::optional<int64_t> max_replica_rank;
+  int64_t max_world_size = 0;
+  int64_t replica_rank = 0;
+  int64_t replica_world_size = 0;
+  bool heal = false;
+  int64_t commit_failures = 0;
+
+  Json to_json() const;
+};
+
+// Pure function: turn a cluster Quorum into per-replica instructions.
+// Parity with reference src/manager.rs:489-624. Throws if replica_id is not
+// in the quorum.
+QuorumResult compute_quorum_results(const std::string& replica_id,
+                                    int64_t group_rank, const Quorum& quorum,
+                                    bool init_sync);
+
+struct ManagerOpt {
+  std::string replica_id;
+  std::string lighthouse_addr;
+  std::string bind_host;  // advertise host for this manager server
+  int port = 0;
+  std::string store_address;  // the group's rendezvous store
+  int64_t world_size = 1;     // local ranks in this replica group
+  int64_t heartbeat_interval_ms = 100;
+  int64_t connect_timeout_ms = 10000;
+  int64_t quorum_retries = 0;
+};
+
+class ManagerServer : public RpcServer {
+ public:
+  explicit ManagerServer(const ManagerOpt& opt);
+  ~ManagerServer() override;
+
+  void start_serving();
+  void stop();
+
+ protected:
+  Json handle(const std::string& method, const Json& params,
+              int64_t timeout_ms) override;
+  void wake_blocked() override;
+
+ private:
+  Json rpc_quorum(const Json& params, int64_t timeout_ms);
+  Json rpc_should_commit(const Json& params, int64_t timeout_ms);
+  void run_quorum(QuorumMember member, int64_t timeout_ms);
+  void heartbeat_loop();
+
+  ManagerOpt opt_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  // quorum round state
+  std::map<int64_t, std::string> checkpoint_metadata_;  // rank -> metadata
+  std::set<int64_t> quorum_participants_;
+  int64_t quorum_round_seq_ = 0;
+  std::optional<Quorum> latest_quorum_;    // result of round quorum_round_seq_
+  std::string quorum_error_;               // non-empty if round failed
+  // should_commit round state
+  std::set<int64_t> commit_votes_;
+  std::set<int64_t> commit_failures_;
+  int64_t commit_round_seq_ = 0;
+  bool commit_decision_ = false;
+
+  std::thread heartbeat_thread_;
+  // Lighthouse quorum calls run on detached threads (bounded by the request
+  // timeout); stop() waits for this to reach zero before destruction.
+  std::atomic<int> inflight_quorums_{0};
+};
+
+}  // namespace tft

@@ -1,0 +1,482 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
+#include <mutex>
+#include <random>
+
+namespace tft {
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+namespace {
+
+bool wait_fd(int fd, short events, int64_t deadline_ms) {
+  while (true) {
+    int64_t remain = deadline_ms - now_ms();
+    if (remain <= 0) return false;
+    struct pollfd pfd = {fd, events, 0};
+    int rc = poll(&pfd, 1, static_cast<int>(std::min<int64_t>(remain, 1000)));
+    if (rc > 0) return true;
+    if (rc < 0 && errno != EINTR) return false;
+  }
+}
+
+void set_nonblocking(int fd, bool nb) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (nb)
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  else
+    fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+}
+
+bool split_addr(const std::string& addr, std::string* host, std::string* port) {
+  // Accept host:port and [v6::addr]:port forms.
+  if (!addr.empty() && addr[0] == '[') {
+    size_t close = addr.find(']');
+    if (close == std::string::npos || close + 1 >= addr.size() ||
+        addr[close + 1] != ':')
+      return false;
+    *host = addr.substr(1, close - 1);
+    *port = addr.substr(close + 2);
+    return true;
+  }
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = addr.substr(0, colon);
+  *port = addr.substr(colon + 1);
+  return true;
+}
+
+}  // namespace
+
+bool read_exact(int fd, char* buf, size_t n, int64_t deadline_ms,
+                std::string* err) {
+  size_t got = 0;
+  while (got < n) {
+    if (!wait_fd(fd, POLLIN, deadline_ms)) {
+      if (err) *err = "timeout: read deadline exceeded";
+      return false;
+    }
+    ssize_t rc = ::recv(fd, buf + got, n - got, 0);
+    if (rc == 0) {
+      if (err) *err = "connection closed by peer";
+      return false;
+    }
+    if (rc < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      if (err) *err = std::string("recv: ") + strerror(errno);
+      return false;
+    }
+    got += static_cast<size_t>(rc);
+  }
+  return true;
+}
+
+bool write_all(int fd, const char* buf, size_t n, int64_t deadline_ms,
+               std::string* err) {
+  size_t sent = 0;
+  while (sent < n) {
+    if (!wait_fd(fd, POLLOUT, deadline_ms)) {
+      if (err) *err = "timeout: write deadline exceeded";
+      return false;
+    }
+    ssize_t rc = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (rc < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      if (err) *err = std::string("send: ") + strerror(errno);
+      return false;
+    }
+    sent += static_cast<size_t>(rc);
+  }
+  return true;
+}
+
+bool peek_bytes(int fd, char* buf, size_t n, int64_t deadline_ms) {
+  size_t got = 0;
+  while (got < n) {
+    if (!wait_fd(fd, POLLIN, deadline_ms)) return false;
+    ssize_t rc = ::recv(fd, buf, n, MSG_PEEK);
+    if (rc <= 0) {
+      if (rc < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    got = static_cast<size_t>(rc);
+    if (got >= n) return true;
+  }
+  return true;
+}
+
+bool send_frame(int fd, const std::string& payload, int64_t deadline_ms,
+                std::string* err) {
+  if (payload.size() > kMaxFrameBytes) {
+    if (err) *err = "frame too large";
+    return false;
+  }
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  char hdr[4];
+  memcpy(hdr, &len, 4);
+  std::string buf;
+  buf.reserve(payload.size() + 4);
+  buf.append(hdr, 4);
+  buf.append(payload);
+  return write_all(fd, buf.data(), buf.size(), deadline_ms, err);
+}
+
+bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
+                std::string* err) {
+  char hdr[4];
+  if (!read_exact(fd, hdr, 4, deadline_ms, err)) return false;
+  uint32_t len;
+  memcpy(&len, hdr, 4);
+  len = ntohl(len);
+  if (len > kMaxFrameBytes) {
+    if (err) *err = "frame too large";
+    return false;
+  }
+  payload->resize(len);
+  if (len == 0) return true;
+  return read_exact(fd, payload->data(), len, deadline_ms, err);
+}
+
+int connect_once(const std::string& addr, int64_t timeout_ms,
+                 std::string* err) {
+  std::string host, port;
+  if (!split_addr(addr, &host, &port)) {
+    if (err) *err = "bad address: " + addr;
+    return -1;
+  }
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = getaddrinfo(host.empty() ? "127.0.0.1" : host.c_str(), port.c_str(),
+                       &hints, &res);
+  if (rc != 0) {
+    if (err) *err = std::string("getaddrinfo: ") + gai_strerror(rc);
+    return -1;
+  }
+  int64_t deadline = now_ms() + timeout_ms;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    set_nonblocking(fd, true);
+    rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc == 0 || (rc < 0 && errno == EINPROGRESS)) {
+      if (wait_fd(fd, POLLOUT, deadline)) {
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+        if (soerr == 0) {
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+          freeaddrinfo(res);
+          return fd;
+        }
+        if (err) *err = std::string("connect: ") + strerror(soerr);
+      } else if (err) {
+        *err = "timeout: connect deadline exceeded";
+      }
+    } else if (err) {
+      *err = std::string("connect: ") + strerror(errno);
+    }
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0 && err && err->empty()) *err = "connect failed";
+  return -1;
+}
+
+int connect_with_retry(const std::string& addr, int64_t timeout_ms,
+                       std::string* err) {
+  int64_t deadline = now_ms() + timeout_ms;
+  int64_t backoff = 100;
+  static thread_local std::mt19937 rng(std::random_device{}());
+  std::string last_err;
+  while (true) {
+    int64_t remain = deadline - now_ms();
+    if (remain <= 0) break;
+    int fd = connect_once(addr, std::min<int64_t>(remain, 5000), &last_err);
+    if (fd >= 0) return fd;
+    remain = deadline - now_ms();
+    if (remain <= 0) break;
+    std::uniform_int_distribution<int64_t> jitter(0, backoff / 2);
+    int64_t sleep_ms = std::min<int64_t>(backoff + jitter(rng), remain);
+    usleep(static_cast<useconds_t>(sleep_ms * 1000));
+    backoff = std::min<int64_t>(static_cast<int64_t>(backoff * 1.5), 10000);
+  }
+  if (err) *err = "timeout: connect to " + addr + " failed: " + last_err;
+  return -1;
+}
+
+bool call_rpc(const std::string& addr, const std::string& method,
+              const Json& params, int64_t timeout_ms, Json* result,
+              std::string* err) {
+  int64_t deadline = now_ms() + timeout_ms;
+  int fd = connect_with_retry(addr, timeout_ms, err);
+  if (fd < 0) return false;
+  Json req = Json::object();
+  req["method"] = method;
+  req["params"] = params;
+  req["timeout_ms"] = timeout_ms;
+  bool ok = send_frame(fd, req.dump(), deadline, err);
+  std::string reply;
+  if (ok) ok = recv_frame(fd, &reply, deadline, err);
+  ::close(fd);
+  if (!ok) return false;
+  Json resp;
+  try {
+    resp = Json::parse(reply);
+  } catch (const std::exception& e) {
+    if (err) *err = std::string("bad reply: ") + e.what();
+    return false;
+  }
+  if (!resp.get("ok").as_bool()) {
+    if (err) *err = resp.get("error").as_string();
+    return false;
+  }
+  if (result) *result = resp.get("result");
+  return true;
+}
+
+RpcClient::~RpcClient() { close(); }
+
+void RpcClient::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Json RpcClient::call(const std::string& method, const Json& params,
+                     int64_t timeout_ms) {
+  int64_t deadline = now_ms() + timeout_ms;
+  std::string err;
+  for (int attempt = 0; attempt < 2; attempt++) {
+    if (fd_ < 0) {
+      fd_ = connect_with_retry(addr_, deadline - now_ms(), &err);
+      if (fd_ < 0) throw TimeoutError(err);
+    }
+    Json req = Json::object();
+    req["method"] = method;
+    req["params"] = params;
+    req["timeout_ms"] = std::max<int64_t>(deadline - now_ms(), 1);
+    std::string reply;
+    if (send_frame(fd_, req.dump(), deadline, &err) &&
+        recv_frame(fd_, &reply, deadline, &err)) {
+      Json resp = Json::parse(reply);
+      if (!resp.get("ok").as_bool()) {
+        std::string msg = resp.get("error").as_string();
+        if (resp.get("code").as_string() == "timeout")
+          throw TimeoutError(msg);
+        throw std::runtime_error(msg);
+      }
+      return resp.get("result");
+    }
+    // Connection-level failure: drop the socket; retry once if it broke
+    // mid-call (e.g. server restarted) and we still have budget.
+    close();
+    if (err.rfind("timeout:", 0) == 0) throw TimeoutError(err);
+  }
+  throw std::runtime_error("rpc " + method + " to " + addr_ + " failed: " + err);
+}
+
+RpcServer::RpcServer(std::string bind_host, int port)
+    : bind_host_(std::move(bind_host)), port_(port) {}
+
+RpcServer::~RpcServer() { shutdown(); }
+
+void RpcServer::start() {
+  struct sockaddr_in6 sa = {};
+  sa.sin6_family = AF_INET6;
+  sa.sin6_port = htons(static_cast<uint16_t>(port_));
+  sa.sin6_addr = in6addr_any;
+
+  bool v6 = true;
+  listen_fd_ = ::socket(AF_INET6, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) {
+    // Host without IPv6 (e.g. ipv6.disable=1 containers): fall back to v4.
+    v6 = false;
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket failed");
+  }
+  int zero = 0, one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (v6) {
+    // Dual-stack: accept v4-mapped connections too.
+    setsockopt(listen_fd_, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+    if (::bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&sa),
+               sizeof(sa)) < 0)
+      throw std::runtime_error(std::string("bind: ") + strerror(errno));
+  } else {
+    struct sockaddr_in sa4 = {};
+    sa4.sin_family = AF_INET;
+    sa4.sin_port = htons(static_cast<uint16_t>(port_));
+    sa4.sin_addr.s_addr = INADDR_ANY;
+    if (::bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&sa4),
+               sizeof(sa4)) < 0)
+      throw std::runtime_error(std::string("bind: ") + strerror(errno));
+  }
+  if (::listen(listen_fd_, 128) < 0)
+    throw std::runtime_error(std::string("listen: ") + strerror(errno));
+
+  struct sockaddr_storage bound = {};
+  socklen_t slen = sizeof(bound);
+  getsockname(listen_fd_, reinterpret_cast<struct sockaddr*>(&bound), &slen);
+  if (bound.ss_family == AF_INET6)
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in6*>(&bound)->sin6_port);
+  else
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in*>(&bound)->sin_port);
+
+  std::string host = bind_host_;
+  if (host.empty() || host == "::" || host == "0.0.0.0") {
+    char name[256];
+    if (gethostname(name, sizeof(name)) == 0)
+      host = name;
+    else
+      host = "127.0.0.1";
+  }
+  address_ = host + ":" + std::to_string(port_);
+
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+void RpcServer::shutdown() {
+  bool expected = false;
+  if (!stopping_.compare_exchange_strong(expected, true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    // Force blocked reads to return (peer-closed) so threads can exit. The
+    // owning connection thread still does the close(), so the fd number
+    // cannot be reused out from under us.
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  wake_blocked();
+  // Handlers are bounded by request timeouts; wait for them to drain.
+  while (active_conns_.load() > 0) usleep(5 * 1000);
+}
+
+void RpcServer::accept_loop() {
+  while (!stopping_.load()) {
+    struct sockaddr_storage peer;
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(listen_fd_, reinterpret_cast<struct sockaddr*>(&peer),
+                      &plen);
+    if (fd < 0) {
+      if (stopping_.load()) return;
+      if (errno == EINTR) continue;
+      return;  // listener closed
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> g(conn_mu_);
+    if (stopping_.load()) {
+      ::close(fd);
+      return;
+    }
+    conn_fds_.insert(fd);
+    active_conns_.fetch_add(1);
+    std::thread([this, fd] {
+      serve_conn(fd);
+      {
+        std::lock_guard<std::mutex> g2(conn_mu_);
+        conn_fds_.erase(fd);
+        ::close(fd);
+      }
+      active_conns_.fetch_sub(1);
+    }).detach();
+  }
+}
+
+void RpcServer::serve_conn(int fd) {
+  set_nonblocking(fd, true);
+  // Sniff: HTTP request lines start with an ASCII method verb.
+  char head[4] = {0};
+  if (peek_bytes(fd, head, 4, now_ms() + 10000)) {
+    if (memcmp(head, "GET ", 4) == 0 || memcmp(head, "POST", 4) == 0 ||
+        memcmp(head, "HEAD", 4) == 0) {
+      // Read the request head (up to blank line) and dispatch.
+      std::string req;
+      char c;
+      int64_t deadline = now_ms() + 10000;
+      while (req.size() < 64 * 1024 &&
+             read_exact(fd, &c, 1, deadline, nullptr)) {
+        req += c;
+        if (req.size() >= 4 && req.compare(req.size() - 4, 4, "\r\n\r\n") == 0)
+          break;
+      }
+      try {
+        handle_http(fd, req);
+      } catch (...) {
+      }
+      return;
+    }
+  }
+  while (!stopping_.load()) {
+    std::string payload;
+    std::string err;
+    // Idle connections are fine: wait in 1-day slices for the next request.
+    if (!recv_frame(fd, &payload, now_ms() + 86400000, &err)) break;
+    Json reply = Json::object();
+    try {
+      Json req = Json::parse(payload);
+      int64_t timeout_ms = req.get("timeout_ms").as_int(60000);
+      Json result =
+          handle(req.get("method").as_string(), req.get("params"), timeout_ms);
+      reply["ok"] = true;
+      reply["result"] = result;
+    } catch (const TimeoutError& e) {
+      reply["ok"] = false;
+      reply["error"] = std::string(e.what());
+      reply["code"] = "timeout";
+    } catch (const std::exception& e) {
+      reply["ok"] = false;
+      reply["error"] = std::string(e.what());
+    }
+    std::string out = reply.dump();
+    if (!send_frame(fd, out, now_ms() + 60000, nullptr)) break;
+  }
+}
+
+void RpcServer::handle_http(int fd, const std::string&) {
+  http_reply(fd, 404, "text/plain", "not found\n");
+}
+
+void RpcServer::http_reply(int fd, int status, const std::string& content_type,
+                           const std::string& body) {
+  const char* reason = status == 200 ? "OK" : status == 404 ? "Not Found"
+                                                            : "Error";
+  std::ostringstream os;
+  os << "HTTP/1.1 " << status << " " << reason << "\r\n"
+     << "Content-Type: " << content_type << "\r\n"
+     << "Content-Length: " << body.size() << "\r\n"
+     << "Connection: close\r\n\r\n"
+     << body;
+  std::string s = os.str();
+  write_all(fd, s.data(), s.size(), now_ms() + 10000, nullptr);
+}
+
+}  // namespace tft

@@ -1,0 +1,135 @@
+// TCP plumbing for the coordination protocol: framed JSON messages with
+// deadlines, exponential-backoff connect, and a generic accept-loop server.
+//
+// Analog of the reference's net/retry layer (reference: src/net.rs:10-36,
+// src/retry.rs:8-42): connect retries back off 100ms -> 10s (x1.5 + jitter);
+// every read/write takes an absolute deadline so a dead peer can never wedge
+// a protocol thread.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+
+namespace tft {
+
+// Milliseconds since an arbitrary monotonic epoch.
+int64_t now_ms();
+
+// ---- framed message I/O --------------------------------------------------
+// Wire format: 4-byte big-endian length, then that many bytes of UTF-8 JSON.
+
+constexpr uint32_t kMaxFrameBytes = 512u * 1024u * 1024u;
+
+// All return false on error/timeout (errno-style detail in *err if non-null).
+bool send_frame(int fd, const std::string& payload, int64_t deadline_ms,
+                std::string* err = nullptr);
+bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
+                std::string* err = nullptr);
+// Peek up to n bytes without consuming (used to sniff HTTP vs framed proto).
+bool peek_bytes(int fd, char* buf, size_t n, int64_t deadline_ms);
+bool read_exact(int fd, char* buf, size_t n, int64_t deadline_ms,
+                std::string* err = nullptr);
+bool write_all(int fd, const char* buf, size_t n, int64_t deadline_ms,
+               std::string* err = nullptr);
+
+// ---- client --------------------------------------------------------------
+
+// Connect to "host:port" with exponential backoff until deadline. Returns fd
+// or -1 (err filled).
+int connect_with_retry(const std::string& addr, int64_t timeout_ms,
+                       std::string* err = nullptr);
+int connect_once(const std::string& addr, int64_t timeout_ms,
+                 std::string* err = nullptr);
+
+// One-shot RPC: connect, send {method, params, timeout_ms}, read reply.
+// Returns true and fills *result on {"ok":true}; false with *err otherwise.
+bool call_rpc(const std::string& addr, const std::string& method,
+              const Json& params, int64_t timeout_ms, Json* result,
+              std::string* err);
+
+// Persistent-connection RPC client (one in-flight request at a time).
+class RpcClient {
+ public:
+  explicit RpcClient(std::string addr) : addr_(std::move(addr)) {}
+  ~RpcClient();
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  // Connects lazily (with retry/backoff up to connect_timeout). Throws
+  // std::runtime_error on failure; TimeoutExpired-style errors carry the
+  // "timeout:" prefix so callers can map them.
+  Json call(const std::string& method, const Json& params, int64_t timeout_ms);
+  void close();
+
+ private:
+  std::string addr_;
+  int fd_ = -1;
+};
+
+// ---- server --------------------------------------------------------------
+
+// A TCP server running an accept loop; each connection gets a thread that
+// reads framed requests {method, params, timeout_ms} and writes replies.
+// Subclass hooks: handle(method, params, timeout_ms) -> reply Json, or throw.
+// If the first bytes look like HTTP, handle_http is called instead with the
+// raw request head; default 404s.
+class RpcServer {
+ public:
+  // bind_host may be "" (all interfaces); port 0 picks a free port.
+  RpcServer(std::string bind_host, int port);
+  virtual ~RpcServer();
+
+  void start();
+  void shutdown();
+  // "host:port" with the resolved port. Host is the advertise host
+  // (bind host, or the machine hostname when bound to all interfaces).
+  std::string address() const { return address_; }
+  int port() const { return port_; }
+
+ protected:
+  // Returns the reply value for {"ok":true,"result":...}. Throwing
+  // std::runtime_error produces {"ok":false,"error":what}. Throwing
+  // TimeoutError produces code "timeout".
+  virtual Json handle(const std::string& method, const Json& params,
+                      int64_t timeout_ms) = 0;
+  virtual void handle_http(int fd, const std::string& request_head);
+  // Called during shutdown after stopping_ is set and connection fds are
+  // closed, before joining connection threads: wake any handler blocked on
+  // an internal condition variable.
+  virtual void wake_blocked() {}
+  void http_reply(int fd, int status, const std::string& content_type,
+                  const std::string& body);
+
+  std::atomic<bool> stopping_{false};
+
+ private:
+  void accept_loop();
+  void serve_conn(int fd);
+
+  std::string bind_host_;
+  std::string address_;
+  int port_ = 0;
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  // Connection threads are detached; shutdown() closes their fds, calls
+  // wake_blocked(), and waits for active_conns_ to drain (handlers are
+  // bounded by request timeouts).
+  std::atomic<int> active_conns_{0};
+  std::set<int> conn_fds_;
+  std::mutex conn_mu_;
+};
+
+class TimeoutError : public std::runtime_error {
+ public:
+  explicit TimeoutError(const std::string& what) : std::runtime_error(what) {}
+};
+
+}  // namespace tft

@@ -1,0 +1,61 @@
+#include "store.h"
+
+#include <chrono>
+
+namespace tft {
+
+void StoreServer::wake_blocked() {
+  std::lock_guard<std::mutex> g(mu_);
+  cv_.notify_all();
+}
+
+Json StoreServer::handle(const std::string& method, const Json& params,
+                         int64_t timeout_ms) {
+  if (method == "set") {
+    std::lock_guard<std::mutex> g(mu_);
+    kv_[params.get("key").as_string()] = params.get("value").as_string();
+    cv_.notify_all();
+    return Json::object();
+  }
+  if (method == "get") {
+    const std::string key = params.get("key").as_string();
+    bool wait = params.get("wait").as_bool(true);
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      auto it = kv_.find(key);
+      if (it != kv_.end()) {
+        Json out = Json::object();
+        out["value"] = it->second;
+        return out;
+      }
+      if (!wait) throw std::runtime_error("key not found: " + key);
+      if (stopping_.load()) throw std::runtime_error("store shutting down");
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        throw TimeoutError("timeout waiting for key: " + key);
+    }
+  }
+  if (method == "delete_prefix") {
+    const std::string prefix = params.get("prefix").as_string();
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t removed = 0;
+    for (auto it = kv_.lower_bound(prefix); it != kv_.end();) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      it = kv_.erase(it);
+      removed++;
+    }
+    Json out = Json::object();
+    out["removed"] = removed;
+    return out;
+  }
+  if (method == "num_keys") {
+    std::lock_guard<std::mutex> g(mu_);
+    Json out = Json::object();
+    out["count"] = static_cast<int64_t>(kv_.size());
+    return out;
+  }
+  throw std::runtime_error("store: unknown method " + method);
+}
+
+}  // namespace tft

@@ -1,0 +1,34 @@
+// In-memory TCP key-value store used for rendezvous.
+//
+// TPU-native replacement for the reference's reliance on torch's TCPStore
+// (reference: torchft/manager.py:277-325 and process_group.py:111-130 use a
+// TCPStore for manager-address hand-off and per-quorum process-group
+// rendezvous). Methods: set / get(wait) / delete_prefix / num_keys.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "net.h"
+
+namespace tft {
+
+class StoreServer : public RpcServer {
+ public:
+  StoreServer(std::string bind_host, int port)
+      : RpcServer(std::move(bind_host), port) {}
+
+ protected:
+  Json handle(const std::string& method, const Json& params,
+              int64_t timeout_ms) override;
+  void wake_blocked() override;
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+}  // namespace tft

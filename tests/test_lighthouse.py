@@ -1,0 +1,217 @@
+"""Lighthouse server behavior tests (live C++ server, port 0).
+
+Scenario parity with reference src/lighthouse.rs:612-1298 tests: join
+timeout, heartbeat expiry, fast quorum, shrink_only, split brain,
+commit-failure quorum bump — plus the HTTP dashboard.
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    Quorum,
+)
+
+
+def _concurrent_quorums(addr, requests, timeout=10.0):
+    """Issue quorum requests concurrently; returns {replica_id: Quorum|Exception}."""
+    results = {}
+
+    def call(kwargs):
+        client = LighthouseClient(addr)
+        try:
+            results[kwargs["replica_id"]] = client.quorum(timeout=timeout, **kwargs)
+        except Exception as e:  # noqa: BLE001 - collected for assertions
+            results[kwargs["replica_id"]] = e
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=call, args=(r,)) for r in requests]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 5)
+    return results
+
+
+class TestLighthouse:
+    def test_two_replica_quorum(self):
+        with LighthouseServer(min_replicas=2) as server:
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "a", "step": 1}, {"replica_id": "b", "step": 1}],
+            )
+            qa, qb = results["a"], results["b"]
+            assert isinstance(qa, Quorum) and isinstance(qb, Quorum)
+            assert qa.quorum_id == qb.quorum_id == 1
+            assert [p.replica_id for p in qa.participants] == ["a", "b"]
+
+    def test_quorum_id_stable_when_membership_unchanged(self):
+        with LighthouseServer(min_replicas=2) as server:
+            reqs = [{"replica_id": "a"}, {"replica_id": "b"}]
+            r1 = _concurrent_quorums(server.address(), reqs)
+            r2 = _concurrent_quorums(server.address(), reqs)
+            assert r1["a"].quorum_id == 1
+            # same members again -> fast quorum, no id bump
+            assert r2["a"].quorum_id == 1
+
+    def test_quorum_id_bumps_on_commit_failures(self):
+        with LighthouseServer(min_replicas=2) as server:
+            reqs = [{"replica_id": "a"}, {"replica_id": "b"}]
+            r1 = _concurrent_quorums(server.address(), reqs)
+            assert r1["a"].quorum_id == 1
+            reqs_fail = [
+                {"replica_id": "a", "commit_failures": 1},
+                {"replica_id": "b"},
+            ]
+            r2 = _concurrent_quorums(server.address(), reqs_fail)
+            assert r2["a"].quorum_id == 2
+
+    def test_quorum_timeout_when_not_enough_replicas(self):
+        with LighthouseServer(min_replicas=2) as server:
+            client = LighthouseClient(server.address())
+            with pytest.raises(TimeoutError):
+                client.quorum(replica_id="lonely", timeout=0.5)
+            client.close()
+
+    def test_join_timeout_admits_straggler(self):
+        # b heartbeats (known-healthy) but doesn't join; a joins. With
+        # min_replicas=1 the quorum must wait join_timeout for b, and b
+        # joining within the window lands both in one quorum.
+        with LighthouseServer(min_replicas=1, join_timeout_ms=2000) as server:
+            client = LighthouseClient(server.address())
+            client.heartbeat("b")
+
+            results = {}
+
+            def join_a():
+                c = LighthouseClient(server.address())
+                results["a"] = c.quorum(replica_id="a", timeout=10.0)
+                c.close()
+
+            t = threading.Thread(target=join_a)
+            t.start()
+            time.sleep(0.5)  # a is waiting on the straggler window
+            assert "a" not in results
+            results["b"] = LighthouseClient(server.address()).quorum(
+                replica_id="b", timeout=10.0
+            )
+            t.join(timeout=10)
+            assert [p.replica_id for p in results["a"].participants] == ["a", "b"]
+
+    def test_join_timeout_expires_without_straggler(self):
+        # a and c join; b heartbeats but never joins. Quorum is valid (2 of 3
+        # healthy participating beats the split-brain bar) but waits
+        # join_timeout for b before forming without it.
+        with LighthouseServer(min_replicas=1, join_timeout_ms=300) as server:
+            hb = LighthouseClient(server.address())
+            hb.heartbeat("b")
+            start = time.monotonic()
+            results = _concurrent_quorums(
+                server.address(), [{"replica_id": "a"}, {"replica_id": "c"}]
+            )
+            elapsed = time.monotonic() - start
+            assert [p.replica_id for p in results["a"].participants] == ["a", "c"]
+            assert elapsed >= 0.25
+            hb.close()
+
+    def test_split_brain_guard(self):
+        # 3 healthy replicas known; only 1 joins; min_replicas=1. The guard
+        # (participants must exceed half the healthy replicas) blocks quorum.
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=60000
+        ) as server:
+            client = LighthouseClient(server.address())
+            client.heartbeat("b")
+            client.heartbeat("c")
+            with pytest.raises(TimeoutError):
+                client.quorum(replica_id="a", timeout=1.0)
+            client.close()
+
+    def test_heartbeat_expiry_shrinks_quorum(self):
+        # quorum {a,b}; b dies (no heartbeat); a re-requests and forms {a}
+        # after the heartbeat timeout passes.
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=500
+        ) as server:
+            # Pre-heartbeat both so the split-brain guard holds the first
+            # quorum open until both have joined (min_replicas=1 would
+            # otherwise let the first joiner form a singleton).
+            hb = LighthouseClient(server.address())
+            hb.heartbeat("a")
+            hb.heartbeat("b")
+            r1 = _concurrent_quorums(
+                server.address(), [{"replica_id": "a"}, {"replica_id": "b"}]
+            )
+            assert r1["a"].quorum_id == 1
+            time.sleep(0.8)  # b's heartbeat expires
+            client = LighthouseClient(server.address())
+            q = client.quorum(replica_id="a", timeout=10.0)
+            assert [p.replica_id for p in q.participants] == ["a"]
+            assert q.quorum_id == 2
+            client.close()
+
+    def test_shrink_only_excludes_new_member(self):
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=500
+        ) as server:
+            hb = LighthouseClient(server.address())
+            hb.heartbeat("a")
+            hb.heartbeat("b")
+            r1 = _concurrent_quorums(
+                server.address(), [{"replica_id": "a"}, {"replica_id": "b"}]
+            )
+            assert [p.replica_id for p in r1["a"].participants] == ["a", "b"]
+            time.sleep(0.8)  # b expires
+            # Refresh a's heartbeat so a concurrent join by newcomer c can't
+            # form a singleton {c} quorum before a registers.
+            hb.heartbeat("a")
+            # a requests shrink_only; newcomer c also asks to join.
+            results = {}
+
+            def join(rid, **kw):
+                c = LighthouseClient(server.address())
+                try:
+                    results[rid] = c.quorum(replica_id=rid, timeout=2.0, **kw)
+                except Exception as e:  # noqa: BLE001
+                    results[rid] = e
+                c.close()
+
+            ta = threading.Thread(target=join, args=("a",), kwargs={"shrink_only": True})
+            tc = threading.Thread(target=join, args=("c",))
+            ta.start()
+            tc.start()
+            ta.join(10)
+            tc.join(10)
+            # a's shrink-only quorum excludes the newcomer c...
+            assert [p.replica_id for p in results["a"].participants] == ["a"]
+            # ...and c is only admitted to a later quorum (after a's
+            # heartbeat lapses), never the shrink-only one.
+            assert isinstance(results["c"], Quorum)
+            assert results["c"].quorum_id > results["a"].quorum_id
+            assert "c" in [p.replica_id for p in results["c"].participants]
+
+    def test_dashboard(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as server:
+            _concurrent_quorums(server.address(), [{"replica_id": "web"}])
+            html = (
+                urllib.request.urlopen(f"http://{server.address()}/status", timeout=5)
+                .read()
+                .decode()
+            )
+            assert "torchft_tpu lighthouse" in html
+            assert "web" in html
+
+    def test_status_rpc(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as server:
+            _concurrent_quorums(server.address(), [{"replica_id": "s"}])
+            client = LighthouseClient(server.address())
+            status = client.status()
+            assert status["quorum_id"] == 1
+            assert status["prev_quorum"]["participants"][0]["replica_id"] == "s"
+            client.close()

@@ -1,0 +1,231 @@
+"""Manager server + store behavior tests (live C++ servers, port 0).
+
+Scenario parity with reference src/manager.rs:626-1218 tests: local-rank
+aggregation, should_commit AND-ing, checkpoint metadata, lighthouse retry.
+"""
+
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    StoreClient,
+    StoreServer,
+)
+
+
+class TestStore:
+    def test_set_get(self):
+        with StoreServer() as server:
+            client = StoreClient(server.address())
+            client.set("k", "v")
+            assert client.get("k") == "v"
+            assert client.num_keys() == 1
+            client.close()
+
+    def test_get_wait_blocks_until_set(self):
+        with StoreServer() as server:
+            c1 = StoreClient(server.address())
+            c2 = StoreClient(server.address())
+            result = {}
+
+            def waiter():
+                result["v"] = c1.get("later", timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.1)
+            c2.set("later", "arrived")
+            t.join(timeout=5)
+            assert result["v"] == "arrived"
+
+    def test_get_nowait_raises(self):
+        with StoreServer() as server:
+            client = StoreClient(server.address())
+            with pytest.raises(RuntimeError, match="not found"):
+                client.get("missing", wait=False)
+
+    def test_get_wait_times_out(self):
+        with StoreServer() as server:
+            client = StoreClient(server.address())
+            with pytest.raises(TimeoutError):
+                client.get("never", timeout=0.3)
+
+    def test_delete_prefix(self):
+        with StoreServer() as server:
+            client = StoreClient(server.address())
+            client.set("/q/1/a", "1")
+            client.set("/q/1/b", "2")
+            client.set("/q/2/a", "3")
+            assert client.delete_prefix("/q/1/") == 2
+            assert client.num_keys() == 1
+
+
+class TestManagerServer:
+    def _managed_pair(self, lighthouse, replica_id, world_size=2):
+        manager = ManagerServer(
+            replica_id=replica_id,
+            lighthouse_addr=lighthouse.address(),
+            store_address=f"store_{replica_id}",
+            world_size=world_size,
+        )
+        return manager
+
+    def test_local_rank_aggregation_single_group(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as lh:
+            with self._managed_pair(lh, "g0", world_size=2) as mgr:
+                results = {}
+
+                def rank_call(rank):
+                    client = ManagerClient(mgr.address())
+                    results[rank] = client._quorum(
+                        group_rank=rank,
+                        step=0,
+                        checkpoint_metadata=f"meta_rank{rank}",
+                        shrink_only=False,
+                        timeout=10.0,
+                    )
+                    client.close()
+
+                threads = [
+                    threading.Thread(target=rank_call, args=(r,)) for r in range(2)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=15)
+
+                assert results[0].quorum_id == results[1].quorum_id == 1
+                assert results[0].replica_world_size == 1
+                assert results[0].store_address == "store_g0"
+                # metadata from both ranks is retrievable
+                client = ManagerClient(mgr.address())
+                assert client._checkpoint_metadata(0, 5.0) == "meta_rank0"
+                assert client._checkpoint_metadata(1, 5.0) == "meta_rank1"
+                client.close()
+
+    def test_two_replica_groups_quorum(self):
+        with LighthouseServer(min_replicas=2, join_timeout_ms=100) as lh:
+            with self._managed_pair(lh, "g0", 1) as m0, self._managed_pair(
+                lh, "g1", 1
+            ) as m1:
+                results = {}
+
+                def call(rid, mgr):
+                    client = ManagerClient(mgr.address())
+                    results[rid] = client._quorum(
+                        group_rank=0,
+                        step=0,
+                        checkpoint_metadata="",
+                        shrink_only=False,
+                        timeout=10.0,
+                    )
+                    client.close()
+
+                threads = [
+                    threading.Thread(target=call, args=("g0", m0)),
+                    threading.Thread(target=call, args=("g1", m1)),
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=15)
+
+                assert results["g0"].replica_world_size == 2
+                assert results["g0"].replica_rank == 0
+                assert results["g1"].replica_rank == 1
+                # init_sync at step 0: non-primary heals from primary
+                assert not results["g0"].heal
+                assert results["g1"].heal
+                assert (
+                    results["g1"].recover_src_manager_address == m0.address()
+                )
+
+    def test_should_commit_and_of_votes(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as lh:
+            with self._managed_pair(lh, "g0", world_size=2) as mgr:
+
+                def vote(rank, value, out):
+                    client = ManagerClient(mgr.address())
+                    out[rank] = client.should_commit(rank, 0, value, timeout=10.0)
+                    client.close()
+
+                # one dissenting vote -> everyone gets False
+                out = {}
+                threads = [
+                    threading.Thread(target=vote, args=(0, True, out)),
+                    threading.Thread(target=vote, args=(1, False, out)),
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=15)
+                assert out == {0: False, 1: False}
+
+                # unanimous -> True (round state reset correctly)
+                out = {}
+                threads = [
+                    threading.Thread(target=vote, args=(0, True, out)),
+                    threading.Thread(target=vote, args=(1, True, out)),
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=15)
+                assert out == {0: True, 1: True}
+
+    def test_quorum_survives_lighthouse_late_start(self):
+        # Manager created while the lighthouse is down: heartbeats fail
+        # silently, and a quorum call issued before the lighthouse exists
+        # succeeds once it comes up (connect backoff, reference
+        # src/net.rs:10-36 behavior).
+        probe = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+        addr = probe.address()
+        probe.shutdown()  # free the port; manager now points at a dead addr
+
+        mgr = ManagerServer(
+            replica_id="g0",
+            lighthouse_addr=addr,
+            store_address="store_g0",
+            world_size=1,
+            quorum_retries=3,
+        )
+        try:
+            result = {}
+
+            def call():
+                client = ManagerClient(mgr.address())
+                result["r"] = client._quorum(
+                    group_rank=0,
+                    step=0,
+                    checkpoint_metadata="",
+                    shrink_only=False,
+                    timeout=15.0,
+                )
+                client.close()
+
+            t = threading.Thread(target=call)
+            t.start()
+            time.sleep(1.0)
+            # Bring the lighthouse up on the same port.
+            host, _, port = addr.rpartition(":")
+            lh = LighthouseServer(
+                bind=f":{port}", min_replicas=1, join_timeout_ms=100
+            )
+            t.join(timeout=20)
+            assert result["r"].quorum_id == 1
+            lh.shutdown()
+        finally:
+            mgr.shutdown()
+
+    def test_checkpoint_metadata_unknown_rank(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as lh:
+            with self._managed_pair(lh, "g0", world_size=1) as mgr:
+                client = ManagerClient(mgr.address())
+                with pytest.raises(RuntimeError, match="rank not found"):
+                    client._checkpoint_metadata(7, 5.0)
+                client.close()

@@ -1,0 +1,110 @@
+"""Unit tests for compute_quorum_results (native C++ pure function).
+
+Scenario parity with reference src/manager.rs:626-1218 test list: heal
+assignment math, init_sync skip, round-robin source assignment, commit
+failure propagation.
+"""
+
+import pytest
+
+from torchft_tpu.coordination import (
+    Quorum,
+    QuorumMember,
+    compute_quorum_results,
+)
+
+
+def member(rid: str, step: int = 0, commit_failures: int = 0) -> QuorumMember:
+    return QuorumMember(
+        replica_id=rid,
+        address=f"addr_{rid}",
+        store_address=f"store_{rid}",
+        step=step,
+        world_size=2,
+        commit_failures=commit_failures,
+    )
+
+
+def quorum(*members: QuorumMember, quorum_id: int = 1) -> Quorum:
+    return Quorum(quorum_id=quorum_id, participants=list(members))
+
+
+class TestComputeQuorumResults:
+    def test_all_up_to_date(self):
+        q = quorum(member("a", 5), member("b", 5), member("c", 5))
+        r = compute_quorum_results("b", 0, q)
+        assert r.quorum_id == 1
+        assert r.replica_rank == 1
+        assert r.replica_world_size == 3
+        assert r.max_step == 5
+        assert r.max_world_size == 3
+        assert r.max_replica_rank == 1
+        assert not r.heal
+        assert r.recover_src_replica_rank is None
+        assert r.recover_dst_replica_ranks == []
+        # primary for group_rank 0 is max_participants[0] == "a"
+        assert r.store_address == "store_a"
+
+    def test_sorted_by_replica_id(self):
+        q = quorum(member("z", 3), member("a", 3))
+        r = compute_quorum_results("z", 0, q)
+        assert r.replica_rank == 1
+        r = compute_quorum_results("a", 0, q)
+        assert r.replica_rank == 0
+
+    def test_behind_replica_heals(self):
+        q = quorum(member("a", 5), member("b", 3), member("c", 5))
+        rb = compute_quorum_results("b", 0, q)
+        assert rb.heal
+        assert rb.max_step == 5
+        assert rb.max_replica_rank is None
+        assert rb.max_world_size == 2
+        # src must be an up-to-date rank: a(0) or c(2)
+        assert rb.recover_src_replica_rank in (0, 2)
+        assert rb.recover_src_manager_address in ("addr_a", "addr_c")
+        # and the src's result lists b(1) as a recover destination
+        src_id = {0: "a", 2: "c"}[rb.recover_src_replica_rank]
+        rsrc = compute_quorum_results(src_id, 0, q)
+        assert not rsrc.heal
+        assert rsrc.recover_dst_replica_ranks == [1]
+
+    def test_group_rank_offsets_recovery_source(self):
+        # Two recovering replicas, two up to date: different group ranks
+        # rotate the assignment so transfer load spreads.
+        q = quorum(member("a", 5), member("b", 0), member("c", 5), member("d", 0))
+        r0 = compute_quorum_results("b", 0, q)
+        r1 = compute_quorum_results("b", 1, q)
+        assert r0.recover_src_replica_rank != r1.recover_src_replica_rank
+
+    def test_init_sync_at_step_zero(self):
+        q = quorum(member("a", 0), member("b", 0), member("c", 0))
+        # primary for group_rank 0 is "a": it does not heal, others do.
+        ra = compute_quorum_results("a", 0, q, init_sync=True)
+        rb = compute_quorum_results("b", 0, q, init_sync=True)
+        rc = compute_quorum_results("c", 0, q, init_sync=True)
+        assert not ra.heal
+        assert rb.heal and rb.recover_src_replica_rank == 0
+        assert rc.heal and rc.recover_src_replica_rank == 0
+        assert sorted(ra.recover_dst_replica_ranks) == [1, 2]
+
+    def test_init_sync_disabled(self):
+        q = quorum(member("a", 0), member("b", 0))
+        rb = compute_quorum_results("b", 0, q, init_sync=False)
+        assert not rb.heal
+
+    def test_commit_failures_max_propagates(self):
+        q = quorum(member("a", 1, commit_failures=0), member("b", 1, commit_failures=3))
+        r = compute_quorum_results("a", 0, q)
+        assert r.commit_failures == 3
+
+    def test_not_in_quorum_raises(self):
+        q = quorum(member("a", 1))
+        with pytest.raises(RuntimeError, match="not participating"):
+            compute_quorum_results("ghost", 0, q)
+
+    def test_primary_store_rotates_with_group_rank(self):
+        q = quorum(member("a", 2), member("b", 2))
+        r0 = compute_quorum_results("a", 0, q)
+        r1 = compute_quorum_results("a", 1, q)
+        assert r0.store_address == "store_a"
+        assert r1.store_address == "store_b"

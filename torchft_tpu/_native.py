@@ -1,0 +1,88 @@
+"""ctypes bindings for the native coordination core.
+
+Analog of the reference's PyO3 extension module registration
+(reference: src/lib.rs:742-758).  The shared library is built from
+``native/`` by ``make``; if missing it is built on first import (the target
+environment always has g++/make).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtorchft_tpu_native.so")
+
+_build_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+
+
+def _build() -> None:
+    result = subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-j", str(os.cpu_count() or 2)],
+        capture_output=True,
+        text=True,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{result.stdout}\n{result.stderr}"
+        )
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+
+        lib.tft_last_error.restype = ctypes.c_char_p
+        lib.tft_free.argtypes = [ctypes.c_void_p]
+
+        lib.tft_lighthouse_create.restype = ctypes.c_int64
+        lib.tft_lighthouse_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tft_manager_create.restype = ctypes.c_int64
+        lib.tft_manager_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.tft_store_create.restype = ctypes.c_int64
+        lib.tft_store_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+
+        lib.tft_server_address.restype = ctypes.c_void_p
+        lib.tft_server_address.argtypes = [ctypes.c_int64]
+        lib.tft_server_shutdown.restype = ctypes.c_int
+        lib.tft_server_shutdown.argtypes = [ctypes.c_int64]
+
+        lib.tft_compute_quorum_results.restype = ctypes.c_void_p
+        lib.tft_compute_quorum_results.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def last_error() -> str:
+    return get_lib().tft_last_error().decode()
+
+
+def take_string(ptr: int) -> str:
+    """Copy a malloc'd C string into Python and free it."""
+    lib = get_lib()
+    if not ptr:
+        raise RuntimeError(last_error())
+    try:
+        return ctypes.string_at(ptr).decode()
+    finally:
+        lib.tft_free(ptr)

@@ -40,6 +40,12 @@ class DistributedSampler:
     ) -> None:
         if not isinstance(dataset_len, int):
             dataset_len = len(dataset_len)
+        if not (0 <= rank < num_replicas):
+            raise ValueError(f"invalid rank {rank}, must be in [0, {num_replicas})")
+        if not (0 <= replica_rank < num_replica_groups):
+            raise ValueError(
+                f"invalid replica_rank {replica_rank}, must be in [0, {num_replica_groups})"
+            )
         self.dataset_len = dataset_len
         self.global_rank = rank + num_replicas * replica_rank
         self.global_world_size = num_replicas * num_replica_groups

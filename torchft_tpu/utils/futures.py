@@ -60,8 +60,13 @@ class _TimerHandle:
 
     def cancel(self) -> None:
         self._timer.cancelled = True
-        with self._manager._cond:
-            self._manager._cond.notify()
+        # Only wake the timer thread when this timer is the heap head (it may
+        # be sleeping until exactly this deadline); cancelled non-head timers
+        # are lazily dropped when they surface.
+        mgr = self._manager
+        with mgr._cond:
+            if mgr._heap and mgr._heap[0] is self._timer:
+                mgr._cond.notify()
 
 
 class _TimeoutManager:
@@ -184,6 +189,11 @@ def future_wait(fut: "Future[T]", timeout: "float | timedelta") -> T:
     try:
         return fut.result(timeout=_to_seconds(timeout))
     except TimeoutError:
+        # A future may legitimately complete *with* a TimeoutError (e.g. one
+        # produced by future_timeout) — re-raise that as-is rather than
+        # misreporting it as this wait expiring.
+        if fut.done():
+            raise
         raise TimeoutError(f"future did not complete within {timeout}")
 
 

@@ -27,16 +27,21 @@ _lock = threading.Lock()
 _recent_events: Deque[Dict[str, Any]] = collections.deque(maxlen=_EVENT_RING_SIZE)
 
 
+_LOGGERS = {
+    "quorum": _quorum_logger,
+    "commit": _commit_logger,
+    "error": _error_logger,
+}
+
+
 def log_event(kind: str, message: str, **extra: Any) -> None:
     """Record a structured protocol event (kind in {quorum, commit, error})."""
+    if kind not in _LOGGERS:
+        raise ValueError(f"unknown event kind {kind!r}, expected one of {sorted(_LOGGERS)}")
     record = {"kind": kind, "message": message, **extra}
     with _lock:
         _recent_events.append(record)
-    logger = {
-        "quorum": _quorum_logger,
-        "commit": _commit_logger,
-        "error": _error_logger,
-    }.get(kind, _error_logger)
+    logger = _LOGGERS[kind]
     rendered = " ".join(f"{k}={v}" for k, v in extra.items())
     if kind == "error":
         logger.error("%s %s", message, rendered)
