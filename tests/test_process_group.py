@@ -1,0 +1,328 @@
+"""ProcessGroup conformance + resiliency tests.
+
+Mirrors reference torchft/process_group_test.py: per-backend collective
+smoke over threads-as-ranks, reconfigure, and the kill-a-rank resiliency
+scenario (reference :961-1020) where survivors must error, reconfigure to a
+smaller world, and succeed.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import StoreServer
+from torchft_tpu.parallel.process_group import (
+    REDUCE_AVG,
+    REDUCE_MAX,
+    REDUCE_SUM,
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+    ProcessGroupTCP,
+    ProcessGroupWrapper,
+)
+
+
+def run_parallel(world, fn, pgs=None):
+    """Run fn(rank, pg) on one thread per rank; returns results by rank."""
+    if pgs is None:
+        pgs = [None] * world
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        futures = [ex.submit(fn, r, pgs[r]) for r in range(world)]
+        return [f.result(timeout=60) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def make_group(store, world, prefix="test", timeout=20.0):
+    """Configure a TCP process group across `world` thread-ranks."""
+    pgs = [ProcessGroupTCP(timeout=timeout) for _ in range(world)]
+
+    def configure(rank, _):
+        pgs[rank].configure(f"{store.address()}/{prefix}", f"rank{rank}", rank, world)
+
+    run_parallel(world, configure)
+    return pgs
+
+
+class TestProcessGroupTCP:
+    @pytest.mark.parametrize("world", [2, 3, 5])
+    def test_allreduce_sum(self, store, world):
+        pgs = make_group(store, world)
+        data = [np.arange(10, dtype=np.float32) + r for r in range(world)]
+        expected = sum(data)
+
+        def op(rank, _):
+            return pgs[rank].allreduce([data[rank]], REDUCE_SUM).wait()[0]
+
+        for result in run_parallel(world, op):
+            np.testing.assert_allclose(result, expected, rtol=1e-6)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allreduce_avg_and_max(self, store):
+        world = 3
+        pgs = make_group(store, world)
+        data = [np.full((4,), float(r + 1), dtype=np.float32) for r in range(world)]
+
+        def op_avg(rank, _):
+            return pgs[rank].allreduce([data[rank]], REDUCE_AVG).wait()[0]
+
+        for result in run_parallel(world, op_avg):
+            np.testing.assert_allclose(result, np.full((4,), 2.0), rtol=1e-6)
+
+        def op_max(rank, _):
+            return pgs[rank].allreduce([data[rank]], REDUCE_MAX).wait()[0]
+
+        for result in run_parallel(world, op_max):
+            np.testing.assert_allclose(result, np.full((4,), 3.0))
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allreduce_large_buffer(self, store):
+        # Bigger than socket buffers: exercises the deadlock-free exchange.
+        world = 2
+        pgs = make_group(store, world)
+        data = [np.random.default_rng(r).standard_normal(1 << 20).astype(np.float32) for r in range(world)]
+
+        def op(rank, _):
+            return pgs[rank].allreduce([data[rank]], REDUCE_SUM).wait()[0]
+
+        results = run_parallel(world, op)
+        np.testing.assert_allclose(results[0], data[0] + data[1], rtol=1e-5)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allgather(self, store):
+        world = 3
+        pgs = make_group(store, world)
+
+        def op(rank, _):
+            return pgs[rank].allgather(np.array([rank, rank * 10])).wait()
+
+        for result in run_parallel(world, op):
+            assert len(result) == world
+            for r, piece in enumerate(result):
+                np.testing.assert_array_equal(piece, [r, r * 10])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_broadcast(self, store):
+        world = 3
+        pgs = make_group(store, world)
+
+        def op(rank, _):
+            arr = np.array([42.0]) if rank == 1 else np.zeros(1)
+            return pgs[rank].broadcast(arr, root=1).wait()
+
+        for result in run_parallel(world, op):
+            np.testing.assert_array_equal(result, [42.0])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter(self, store):
+        world = 2
+        pgs = make_group(store, world)
+        data = [np.arange(8, dtype=np.float32).reshape(4, 2) * (r + 1) for r in range(world)]
+        expected_total = data[0] + data[1]
+
+        def op(rank, _):
+            return pgs[rank].reduce_scatter(data[rank], REDUCE_SUM).wait()
+
+        results = run_parallel(world, op)
+        np.testing.assert_allclose(results[0], expected_total[:2], rtol=1e-6)
+        np.testing.assert_allclose(results[1], expected_total[2:], rtol=1e-6)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_alltoall(self, store):
+        world = 3
+        pgs = make_group(store, world)
+
+        def op(rank, _):
+            inputs = [np.array([rank * 10 + dst]) for dst in range(world)]
+            return pgs[rank].alltoall(inputs).wait()
+
+        results = run_parallel(world, op)
+        for rank, out in enumerate(results):
+            for src, piece in enumerate(out):
+                np.testing.assert_array_equal(piece, [src * 10 + rank])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_send_recv(self, store):
+        world = 2
+        pgs = make_group(store, world)
+
+        def op(rank, _):
+            if rank == 0:
+                pgs[0].send(np.array([1.5, 2.5]), dst=1, tag=7).wait()
+                return None
+            return pgs[1].recv(src=0, tag=7).wait()
+
+        results = run_parallel(world, op)
+        np.testing.assert_array_equal(results[1], [1.5, 2.5])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_barrier(self, store):
+        world = 3
+        pgs = make_group(store, world)
+        run_parallel(world, lambda r, _: pgs[r].barrier().wait())
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_world_size_one_local(self, store):
+        (pg,) = make_group(store, 1)
+        result = pg.allreduce([np.arange(3)], REDUCE_SUM).wait()
+        np.testing.assert_array_equal(result[0], [0, 1, 2])
+        pg.shutdown()
+
+    def test_abort_latches_error(self, store):
+        world = 2
+        pgs = make_group(store, world)
+        pgs[0].abort()
+        assert pgs[0].errored() is not None
+        work = pgs[0].allreduce([np.zeros(2)])
+        with pytest.raises(RuntimeError):
+            work.wait(timeout=5)
+
+    def test_resiliency_kill_rank_then_reconfigure(self, store):
+        # reference process_group_test.py:961-1020: kill the last rank,
+        # survivors raise, then reconfigure to a smaller world and succeed.
+        world = 3
+        pgs = make_group(store, world, prefix="r1", timeout=3.0)
+
+        # rank 2 "dies" (abort closes its sockets)
+        pgs[2].abort()
+
+        def failing_op(rank, _):
+            try:
+                pgs[rank].allreduce([np.ones(4)]).wait(timeout=10)
+                return None
+            except Exception as e:  # noqa: BLE001
+                return e
+
+        errors = run_parallel(2, failing_op)
+        assert all(e is not None for e in errors), "survivors must observe failure"
+        assert all(pgs[r].errored() is not None for r in range(2))
+
+        # survivors reconfigure under a fresh prefix into world=2
+        def reconfigure(rank, _):
+            pgs[rank].configure(f"{store.address()}/r2", f"rank{rank}", rank, 2)
+
+        run_parallel(2, reconfigure)
+        assert all(pgs[r].errored() is None for r in range(2))
+
+        def op(rank, _):
+            return pgs[rank].allreduce([np.ones(4)]).wait()[0]
+
+        for result in run_parallel(2, op):
+            np.testing.assert_array_equal(result, np.full(4, 2.0))
+        for pg in pgs[:2]:
+            pg.shutdown()
+
+    def test_timeout_on_missing_peer(self, store):
+        # rank 0 configures against a world of 2 but rank 1 never shows up.
+        pg = ProcessGroupTCP(timeout=1.0)
+        with pytest.raises((TimeoutError, OSError)):
+            pg.configure(f"{store.address()}/lonely", "rank0", 1, 2)
+
+
+class TestWrappers:
+    def test_dummy_ops(self):
+        pg = ProcessGroupDummy()
+        np.testing.assert_array_equal(
+            pg.allreduce([np.array([1.0, 2.0])]).wait()[0], [1.0, 2.0]
+        )
+        assert pg.size() == 1
+        pg.configure("", "r", 0, 1)
+        assert pg.configure_count == 1
+
+    def test_error_swallowing(self, store):
+        inner = ProcessGroupDummy()
+        pg = ErrorSwallowingProcessGroupWrapper(inner)
+        assert pg.errored() is None
+        pg.report_error(RuntimeError("boom"))
+        assert pg.errored() is not None
+        # ops become pass-through no-ops
+        result = pg.allreduce([np.array([3.0])]).wait()
+        np.testing.assert_array_equal(result[0], [3.0])
+        # configure clears the error
+        pg.configure("", "r", 0, 1)
+        assert pg.errored() is None
+
+    def test_error_swallowing_catches_op_failure(self):
+        inner = ProcessGroupDummy()
+        pg = ErrorSwallowingProcessGroupWrapper(inner)
+        # recv fails on dummy; wrapper must swallow with a None result
+        work = pg.recv(src=0)
+        assert work.wait(timeout=5) is None
+        assert pg.errored() is not None
+
+    def test_error_swallowing_keeps_result_shapes(self):
+        pg = ErrorSwallowingProcessGroupWrapper(ProcessGroupDummy())
+        pg.report_error(RuntimeError("down"))
+        # single-array ops return a bare array, list ops a list — matching
+        # the success path so training code doesn't branch on failure.
+        bc = pg.broadcast(np.arange(4.0)).wait(timeout=5)
+        assert isinstance(bc, np.ndarray) and bc.shape == (4,)
+        ar = pg.allreduce([np.arange(4.0)]).wait(timeout=5)
+        assert isinstance(ar, list) and ar[0].shape == (4,)
+        rs = pg.reduce_scatter(np.arange(4.0).reshape(4, 1)).wait(timeout=5)
+        assert isinstance(rs, np.ndarray)
+
+    def test_fake_injects_future_error(self):
+        inner = ProcessGroupDummy()
+        pg = FakeProcessGroupWrapper(inner)
+        pg.report_future_error(RuntimeError("injected"))
+        with pytest.raises(RuntimeError, match="injected"):
+            pg.allreduce([np.zeros(1)]).wait(timeout=5)
+        # next op is clean
+        pg.allreduce([np.zeros(1)]).wait(timeout=5)
+
+    def test_fake_injects_configure_error(self):
+        pg = FakeProcessGroupWrapper(ProcessGroupDummy())
+        pg.report_configure_error(RuntimeError("cfg boom"))
+        with pytest.raises(RuntimeError, match="cfg boom"):
+            pg.configure("", "r", 0, 1)
+        pg.configure("", "r", 0, 1)  # second attempt clean
+
+    def test_wrapper_forwards(self):
+        inner = ProcessGroupDummy()
+        pg = ProcessGroupWrapper(inner)
+        assert pg.size() == 1
+        assert pg.parent is inner
+
+
+class TestNumerics:
+    def test_int32_allreduce_no_overflow(self, store):
+        # Partial ring sums must widen to i64 (values near 2**30, world 3).
+        world = 3
+        pgs = make_group(store, world, prefix="ovf")
+        data = [np.full(4, 2**30 - 1, dtype=np.int64) for _ in range(world)]
+
+        def op(rank, _):
+            return pgs[rank].allreduce([data[rank].astype(np.int64)]).wait()[0]
+
+        for result in run_parallel(world, op):
+            np.testing.assert_array_equal(result, np.full(4, 3 * (2**30 - 1)))
+        # int32 inputs widen internally and cast back
+        data32 = [np.full(4, 1000, dtype=np.int32) for _ in range(world)]
+
+        def op32(rank, _):
+            out = pgs[rank].allreduce([data32[rank]]).wait()[0]
+            assert out.dtype == np.int32
+            return out
+
+        for result in run_parallel(world, op32):
+            np.testing.assert_array_equal(result, np.full(4, 3000))
+        for pg in pgs:
+            pg.shutdown()

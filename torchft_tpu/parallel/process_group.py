@@ -1,0 +1,852 @@
+"""Reconfigurable process groups: the fault-tolerant collective layer.
+
+TPU-native rebuild of the reference's reconfigurable ProcessGroup hierarchy
+(reference: torchft/process_group.py:133-2023).  The key fault-tolerance
+properties reproduced here (reference §5 semantics):
+
+- **reconfigure**: ``configure(store_addr, replica_id, rank, world_size)``
+  tears down and re-forms the group with new membership (keyed by the
+  per-quorum store prefix) without restarting the process.
+- **abortable with deadline**: every op takes the group timeout; ``abort()``
+  cancels in-flight ops by closing sockets, never killing the process.
+- **error latching**: after a failure every op fails fast (or is swallowed by
+  ``ErrorSwallowingProcessGroupWrapper``) until the next configure.
+- **host-mediated DCN path**: collectives run over TCP on host buffers
+  (numpy), the Gloo analog.  On TPU the *inner* dimensions (FSDP/TP over ICI)
+  are XLA collectives inside jit and are fault-free by assumption; this layer
+  owns only the elastic replica dimension, so membership changes never
+  trigger re-jit (zero-fill participation keeps compiled shapes static).
+
+Design divergence from the reference, by intent: no subprocess-isolated
+"Baby" variants (no NCCL-context crash mode exists on this path — a failed
+TCP collective cannot poison the XLA runtime), and no fake world-size-1
+backend registration (a torch-DeviceMesh-specific trick; the JAX mesh
+composition lives in torchft_tpu/parallel/device_mesh.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchft_tpu.coordination import StoreClient
+from torchft_tpu.parallel.work import Work, completed_work, failed_work
+
+logger = logging.getLogger(__name__)
+
+REDUCE_SUM = "sum"
+REDUCE_AVG = "avg"
+REDUCE_MAX = "max"
+REDUCE_MIN = "min"
+
+_REDUCE_FNS: Dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    REDUCE_SUM: lambda a, b: a + b,
+    REDUCE_AVG: lambda a, b: a + b,  # divided by world size at the end
+    REDUCE_MAX: np.maximum,
+    REDUCE_MIN: np.minimum,
+}
+
+
+def _accumulation_dtype(dtype: np.dtype) -> np.dtype:
+    """Widened dtype for ring partial sums: f64 / i64 / u64 to avoid both
+    float non-determinism blowup and silent integer overflow."""
+    if np.issubdtype(dtype, np.floating):
+        return np.dtype(np.float64)
+    if np.issubdtype(dtype, np.signedinteger):
+        return np.dtype(np.int64)
+    if np.issubdtype(dtype, np.unsignedinteger):
+        return np.dtype(np.uint64)
+    return dtype
+
+
+def _as_numpy(x: Any) -> np.ndarray:
+    """Host view of an array (device->host copy for jax arrays)."""
+    return np.asarray(x)
+
+
+def _routable_local_ip(store_addr: str) -> str:
+    """Local IP of the interface that routes to the store host.
+
+    Hostnames are not guaranteed resolvable across hosts/containers; the
+    interface used to reach the rendezvous store is by construction routable
+    from every peer that also reaches the store.
+    """
+    host, _, port = store_addr.rpartition(":")
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((host or "127.0.0.1", int(port or 1)))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        return socket.gethostname()
+
+
+class ProcessGroup(ABC):
+    """Abstract reconfigurable process group over host buffers.
+
+    API parity with the reference base ProcessGroup
+    (reference: torchft/process_group.py:133-386), adapted to numpy/pytree
+    data instead of torch tensors.
+    """
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        self._timeout = timeout
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @abstractmethod
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        """(Re)initialize membership. store_addr is ``host:port/prefix``."""
+
+    @abstractmethod
+    def abort(self) -> None:
+        """Cancel in-flight ops and latch an aborted error."""
+
+    @abstractmethod
+    def errored(self) -> Optional[Exception]:
+        """Latched failure, or None if healthy."""
+
+    def shutdown(self) -> None:
+        self.abort()
+
+    def set_timeout(self, timeout: float) -> None:
+        self._timeout = timeout
+
+    # -- topology ----------------------------------------------------------
+
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    # -- collectives -------------------------------------------------------
+
+    @abstractmethod
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work: ...
+
+    @abstractmethod
+    def allgather(self, array: Any) -> Work:
+        """Resolves to a list of ``size()`` arrays, indexed by rank."""
+
+    @abstractmethod
+    def broadcast(self, array: Any, root: int = 0) -> Work: ...
+
+    @abstractmethod
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        """Reduce then scatter row-chunks; resolves to this rank's chunk.
+
+        ``array.shape[0]`` must be divisible by ``size()``.
+        """
+
+    @abstractmethod
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        """Exchange: sends arrays[i] to rank i; resolves to received list."""
+
+    @abstractmethod
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work: ...
+
+    @abstractmethod
+    def recv(self, src: int, tag: int = 0) -> Work:
+        """Resolves to the received array (shape/dtype carried on the wire)."""
+
+    def barrier(self) -> Work:
+        return self.allreduce([np.zeros(1, dtype=np.float32)])
+
+
+class ProcessGroupDummy(ProcessGroup):
+    """World-size-1 no-op group (reference: torchft/process_group.py:960-1081).
+
+    Used to bootstrap wrappers before the first quorum and in tests.
+    """
+
+    def __init__(self, rank: int = 0, world: int = 1, timeout: float = 60.0) -> None:
+        super().__init__(timeout)
+        assert world == 1, "ProcessGroupDummy only supports world_size 1"
+        self._rank = rank
+        self._world = world
+        self._errored: Optional[Exception] = None
+        self.configure_count = 0
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self.configure_count += 1
+        self._errored = None
+
+    def abort(self) -> None:
+        self._errored = RuntimeError("aborted")
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world
+
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
+        return completed_work([_as_numpy(a).copy() for a in arrays])
+
+    def allgather(self, array: Any) -> Work:
+        return completed_work([_as_numpy(array).copy()])
+
+    def broadcast(self, array: Any, root: int = 0) -> Work:
+        return completed_work(_as_numpy(array).copy())
+
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        return completed_work(_as_numpy(array).copy())
+
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        return completed_work([_as_numpy(a).copy() for a in arrays])
+
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work:
+        return failed_work(RuntimeError("send not supported on world-size-1 group"))
+
+    def recv(self, src: int, tag: int = 0) -> Work:
+        return failed_work(RuntimeError("recv not supported on world-size-1 group"))
+
+
+# ---------------------------------------------------------------------------
+# TCP backend (host-mediated DCN collectives — the Gloo analog)
+# ---------------------------------------------------------------------------
+
+_HELLO_MAGIC = 0x7F7A11AA
+
+
+class _PeerConn:
+    """A connected, rank-identified socket to one peer."""
+
+    def __init__(self, sock: socket.socket, rank: int) -> None:
+        self.sock = sock
+        self.rank = rank
+        sock.setblocking(True)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _PGAborted(RuntimeError):
+    pass
+
+
+class ProcessGroupTCP(ProcessGroup):
+    """Fault-tolerant collectives over a full TCP mesh of host processes.
+
+    The cross-replica-group (DCN) collective backend: rendezvous through the
+    quorum primary's store under a per-quorum prefix (set by the Manager,
+    reference: torchft/manager.py:659-690), full-mesh connect, then ring
+    algorithms on host buffers.  Bandwidth-optimal ring allreduce /
+    reduce-scatter; direct sends for broadcast/gather at the small world
+    sizes of the replica dimension.
+
+    All ops run in submission order on a single worker thread; both
+    endpoints of each socket submit the same collective sequence so streams
+    stay in sync (the standard collective contract).
+    """
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        super().__init__(timeout)
+        self._rank = -1
+        self._world = 0
+        self._peers: Dict[int, _PeerConn] = {}
+        self._listener: Optional[socket.socket] = None
+        self._errored: Optional[Exception] = None
+        self._aborted = False
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._queue: "queue.Queue[Optional[Tuple[int, Callable[[], Any], Future]]]" = (
+            queue.Queue()
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        self._teardown()
+        deadline = time.monotonic() + self._timeout
+
+        with self._lock:
+            self._errored = None
+            self._aborted = False
+            self._generation += 1
+            gen = self._generation
+        self._rank = rank
+        self._world = world_size
+
+        if world_size == 1:
+            self._peers = {}
+            self._start_worker(gen)
+            return
+
+        addr, _, prefix = store_addr.partition("/")
+        store = StoreClient(addr, connect_timeout=self._timeout)
+        try:
+            try:
+                listener = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+                listener.bind(("", 0))
+            except OSError:
+                # Host without IPv6 (ipv6.disable=1 containers).
+                listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                listener.bind(("", 0))
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.listen(world_size)
+            self._listener = listener
+            # Advertise the interface address peers can actually route to:
+            # the local IP of a connection toward the store host (hostnames
+            # may not resolve across container boundaries).
+            host = _routable_local_ip(addr)
+            port = listener.getsockname()[1]
+            store.set(f"{prefix}/rank_{rank}", f"{host}:{port}")
+
+            peers: Dict[int, _PeerConn] = {}
+            # Deterministic connect direction avoids duplicate links: lower
+            # ranks dial higher ranks; higher ranks accept.
+            for peer in range(rank + 1, world_size):
+                peer_addr = store.get(
+                    f"{prefix}/rank_{peer}",
+                    timeout=max(deadline - time.monotonic(), 0.001),
+                )
+                phost, _, pport = peer_addr.rpartition(":")
+                sock = socket.create_connection(
+                    (phost, int(pport)),
+                    timeout=max(deadline - time.monotonic(), 0.001),
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(struct.pack(">II", _HELLO_MAGIC, rank))
+                peers[peer] = _PeerConn(sock, peer)
+            for _ in range(rank):
+                listener.settimeout(max(deadline - time.monotonic(), 0.001))
+                sock, _ = listener.accept()
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                magic, peer_rank = struct.unpack(">II", self._read_exact_sock(sock, 8, deadline))
+                if magic != _HELLO_MAGIC:
+                    raise RuntimeError("bad hello from peer")
+                peers[peer_rank] = _PeerConn(sock, peer_rank)
+            self._peers = peers
+            self._start_worker(gen)
+        except Exception:
+            self._teardown()
+            raise
+        finally:
+            store.close()
+
+    def _start_worker(self, gen: int) -> None:
+        # Fresh queue per generation so stale ops/poison pills from a prior
+        # configure can never reach the new worker. Swapped under the lock so
+        # _submit can never enqueue onto a retired queue.
+        with self._lock:
+            self._queue = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._worker_loop,
+                args=(gen, self._queue),
+                name="pg_tcp_worker",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def _teardown(self) -> None:
+        with self._lock:
+            self._generation += 1  # invalidate the running worker
+            peers = list(self._peers.values())
+            self._peers = {}
+            listener = self._listener
+            self._listener = None
+            old_queue = self._queue
+            old_queue.put(None)  # wake the worker so it can exit
+        for p in peers:
+            p.close()
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        worker = self._worker
+        if worker is not None and worker is not threading.current_thread():
+            worker.join(timeout=5.0)
+        with self._lock:
+            # After this, _submit fails fast instead of enqueueing into limbo.
+            self._worker = None
+        # Fail any ops still sitting in the retired queue so no Work handle
+        # is left unresolved (a hang is worse than an error in FT code).
+        while True:
+            try:
+                item = old_queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[2].set_exception(_PGAborted("process group torn down"))
+
+    def abort(self) -> None:
+        with self._lock:
+            self._aborted = True
+            if self._errored is None:
+                self._errored = _PGAborted("process group aborted")
+        self._teardown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world
+
+    # -- op submission -----------------------------------------------------
+
+    def _submit(self, fn: "Callable[[], Any]") -> Work:
+        fut: Future = Future()
+        with self._lock:
+            if self._errored is not None:
+                return failed_work(self._errored)
+            if self._worker is None:
+                return failed_work(
+                    _PGAborted("process group not configured/running")
+                )
+            # Enqueue under the lock: the queue object is swapped by
+            # _teardown/_start_worker under the same lock, so this item can
+            # never land on a retired queue with no worker to fail it.
+            self._queue.put((self._generation, fn, fut))
+        return Work(fut)
+
+    def _worker_loop(self, gen: int, q: "queue.Queue") -> None:
+        superseded = False
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            item_gen, fn, fut = item
+            with self._lock:
+                superseded = self._generation != gen
+                errored = self._errored
+            if superseded or item_gen != gen or errored is not None:
+                # Keep draining so every queued Work resolves — abandoned
+                # futures would hang their waiters forever.
+                fut.set_exception(
+                    errored or _PGAborted("process group reconfigured")
+                )
+                continue
+            try:
+                fut.set_result(fn())
+            except Exception as e:  # noqa: BLE001 - latch every op failure
+                with self._lock:
+                    if self._errored is None:
+                        self._errored = e
+                fut.set_exception(e)
+
+    # -- wire helpers ------------------------------------------------------
+
+    @staticmethod
+    def _read_exact_sock(sock: socket.socket, n: int, deadline: float) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            sock.settimeout(max(deadline - time.monotonic(), 0.001))
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _peer(self, rank: int) -> _PeerConn:
+        peer = self._peers.get(rank)
+        if peer is None:
+            raise _PGAborted(f"no connection to rank {rank}")
+        return peer
+
+    def _send_msg(self, dst: int, tag: int, array: np.ndarray, deadline: float) -> None:
+        peer = self._peer(dst)
+        array = np.ascontiguousarray(array)
+        header = pickle.dumps(
+            {"tag": tag, "shape": array.shape, "dtype": str(array.dtype)}
+        )
+        peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
+        peer.sock.sendall(
+            struct.pack(">II", len(header), array.nbytes) + header + array.tobytes()
+        )
+
+    def _recv_msg(self, src: int, tag: int, deadline: float) -> np.ndarray:
+        peer = self._peer(src)
+        hlen, nbytes = struct.unpack(
+            ">II", self._read_exact_sock(peer.sock, 8, deadline)
+        )
+        header = pickle.loads(self._read_exact_sock(peer.sock, hlen, deadline))
+        if header["tag"] != tag:
+            raise RuntimeError(
+                f"collective tag mismatch: expected {tag}, got {header['tag']}"
+            )
+        payload = self._read_exact_sock(peer.sock, nbytes, deadline)
+        return np.frombuffer(payload, dtype=np.dtype(header["dtype"])).reshape(
+            header["shape"]
+        ).copy()
+
+    def _exchange(
+        self,
+        send_dst: int,
+        send_tag: int,
+        send_array: np.ndarray,
+        recv_src: int,
+        recv_tag: int,
+        deadline: float,
+    ) -> np.ndarray:
+        """Simultaneous send+recv without deadlocking on full TCP buffers.
+
+        Ring steps send and receive concurrently; pushing the send to a side
+        thread keeps both directions draining even when payloads exceed
+        socket buffer sizes.
+        """
+        send_exc: List[BaseException] = []
+
+        def _sender() -> None:
+            try:
+                self._send_msg(send_dst, send_tag, send_array, deadline)
+            except BaseException as e:  # noqa: BLE001
+                send_exc.append(e)
+
+        t = threading.Thread(target=_sender, daemon=True)
+        t.start()
+        received = self._recv_msg(recv_src, recv_tag, deadline)
+        t.join(timeout=max(deadline - time.monotonic(), 0.001) + 1.0)
+        if t.is_alive():
+            # The socket stream is mid-write; returning now would let the
+            # next step interleave bytes on the same socket. Fail the op —
+            # the error latches and the group reconfigures.
+            raise TimeoutError("collective send did not complete by deadline")
+        if send_exc:
+            raise send_exc[0]
+        return received
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
+        np_arrays = [_as_numpy(a) for a in arrays]
+        deadline_budget = self._timeout
+
+        def run() -> List[np.ndarray]:
+            deadline = time.monotonic() + deadline_budget
+            return [self._allreduce_one(a, op, deadline) for a in np_arrays]
+
+        return self._submit(run)
+
+    def _allreduce_one(self, array: np.ndarray, op: str, deadline: float) -> np.ndarray:
+        w, r = self._world, self._rank
+        if w == 1:
+            return array.copy()
+        reduce_fn = _REDUCE_FNS[op]
+        acc_dtype = _accumulation_dtype(array.dtype)
+        flat = array.astype(acc_dtype).ravel()
+        n = flat.size
+        chunk = -(-n // w)
+        padded = np.zeros(chunk * w, dtype=acc_dtype)
+        padded[:n] = flat
+        chunks = [padded[i * chunk : (i + 1) * chunk].copy() for i in range(w)]
+
+        nxt, prv = (r + 1) % w, (r - 1) % w
+        # ring reduce-scatter: after w-1 steps, chunk (r+1)%w is fully reduced
+        for step in range(w - 1):
+            send_idx = (r - step) % w
+            recv_idx = (r - step - 1) % w
+            received = self._exchange(
+                nxt, 100 + step, chunks[send_idx], prv, 100 + step, deadline
+            )
+            chunks[recv_idx] = reduce_fn(chunks[recv_idx], received)
+        # ring allgather of the reduced chunks
+        for step in range(w - 1):
+            send_idx = (r - step + 1) % w
+            recv_idx = (r - step) % w
+            chunks[recv_idx] = self._exchange(
+                nxt, 200 + step, chunks[send_idx], prv, 200 + step, deadline
+            )
+        result = np.concatenate(chunks)[:n]
+        if op == REDUCE_AVG:
+            result = result / w
+        return result.astype(array.dtype).reshape(array.shape)
+
+    def allgather(self, array: Any) -> Work:
+        np_array = _as_numpy(array)
+        deadline_budget = self._timeout
+
+        def run() -> List[np.ndarray]:
+            deadline = time.monotonic() + deadline_budget
+            w, r = self._world, self._rank
+            if w == 1:
+                return [np_array.copy()]
+            pieces: List[Optional[np.ndarray]] = [None] * w
+            pieces[r] = np.ascontiguousarray(np_array)
+            nxt, prv = (r + 1) % w, (r - 1) % w
+            for step in range(w - 1):
+                send_idx = (r - step) % w
+                recv_idx = (r - step - 1) % w
+                pieces[recv_idx] = self._exchange(
+                    nxt, 300 + step, pieces[send_idx], prv, 300 + step, deadline
+                )
+            return [p.copy() for p in pieces]  # type: ignore[union-attr]
+
+        return self._submit(run)
+
+    def broadcast(self, array: Any, root: int = 0) -> Work:
+        np_array = _as_numpy(array)
+        deadline_budget = self._timeout
+
+        def run() -> np.ndarray:
+            deadline = time.monotonic() + deadline_budget
+            w, r = self._world, self._rank
+            if w == 1:
+                return np_array.copy()
+            if r == root:
+                for peer in range(w):
+                    if peer != r:
+                        self._send_msg(peer, 400, np_array, deadline)
+                return np_array.copy()
+            return self._recv_msg(root, 400, deadline)
+
+        return self._submit(run)
+
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        np_array = _as_numpy(array)
+        deadline_budget = self._timeout
+
+        def run() -> np.ndarray:
+            deadline = time.monotonic() + deadline_budget
+            w, r = self._world, self._rank
+            if w == 1:
+                return np_array.copy()
+            if np_array.shape[0] % w != 0:
+                raise ValueError(
+                    f"reduce_scatter dim0 {np_array.shape[0]} not divisible by {w}"
+                )
+            reduce_fn = _REDUCE_FNS[op]
+            rows = np_array.shape[0] // w
+            acc_dtype = _accumulation_dtype(np_array.dtype)
+            chunks = [
+                np_array[i * rows : (i + 1) * rows].astype(acc_dtype)
+                for i in range(w)
+            ]
+            nxt, prv = (r + 1) % w, (r - 1) % w
+            # Ring schedule shifted by one vs allreduce so each rank ends
+            # holding its *own* fully-reduced chunk r.
+            for step in range(w - 1):
+                send_idx = (r - step - 1) % w
+                recv_idx = (r - step - 2) % w
+                received = self._exchange(
+                    nxt, 500 + step, chunks[send_idx], prv, 500 + step, deadline
+                )
+                chunks[recv_idx] = reduce_fn(chunks[recv_idx], received)
+            result = chunks[r]
+            if op == REDUCE_AVG:
+                result = result / w
+            return result.astype(np_array.dtype)
+
+        return self._submit(run)
+
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        np_arrays = [_as_numpy(a) for a in arrays]
+        deadline_budget = self._timeout
+
+        def run() -> List[np.ndarray]:
+            deadline = time.monotonic() + deadline_budget
+            w, r = self._world, self._rank
+            if len(np_arrays) != w:
+                raise ValueError(f"alltoall needs {w} arrays, got {len(np_arrays)}")
+            out: List[Optional[np.ndarray]] = [None] * w
+            out[r] = np_arrays[r].copy()
+            for offset in range(1, w):
+                dst = (r + offset) % w
+                src = (r - offset) % w
+                out[src] = self._exchange(
+                    dst, 600 + offset, np_arrays[dst], src, 600 + offset, deadline
+                )
+            return out  # type: ignore[return-value]
+
+        return self._submit(run)
+
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work:
+        np_array = _as_numpy(array)
+        deadline_budget = self._timeout
+
+        def run() -> None:
+            deadline = time.monotonic() + deadline_budget
+            self._send_msg(dst, 1000 + tag, np_array, deadline)
+
+        return self._submit(run)
+
+    def recv(self, src: int, tag: int = 0) -> Work:
+        deadline_budget = self._timeout
+
+        def run() -> np.ndarray:
+            deadline = time.monotonic() + deadline_budget
+            return self._recv_msg(src, 1000 + tag, deadline)
+
+        return self._submit(run)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+
+class ProcessGroupWrapper(ProcessGroup):
+    """Forwards every op to an inner PG; base for behavior-modifying wrappers."""
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__(pg._timeout)
+        self._pg = pg
+
+    @property
+    def parent(self) -> ProcessGroup:
+        return self._pg
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self._pg.configure(store_addr, replica_id, rank, world_size)
+
+    def abort(self) -> None:
+        self._pg.abort()
+
+    def errored(self) -> Optional[Exception]:
+        return self._pg.errored()
+
+    def set_timeout(self, timeout: float) -> None:
+        self._pg.set_timeout(timeout)
+
+    def rank(self) -> int:
+        return self._pg.rank()
+
+    def size(self) -> int:
+        return self._pg.size()
+
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
+        return self._wrap(
+            self._pg.allreduce(arrays, op),
+            lambda: [_as_numpy(a) for a in arrays],
+        )
+
+    def allgather(self, array: Any) -> Work:
+        return self._wrap(self._pg.allgather(array), lambda: [_as_numpy(array)])
+
+    def broadcast(self, array: Any, root: int = 0) -> Work:
+        return self._wrap(self._pg.broadcast(array, root), lambda: _as_numpy(array))
+
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        # Fallback keeps the success-path *shape*: this rank's row chunk.
+        def fallback() -> np.ndarray:
+            np_array = _as_numpy(array)
+            w = max(self._pg.size(), 1)
+            rows = np_array.shape[0] // w if np_array.shape[0] >= w else 1
+            r = max(self._pg.rank(), 0)
+            return np_array[r * rows : (r + 1) * rows]
+
+        return self._wrap(self._pg.reduce_scatter(array, op), fallback)
+
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        return self._wrap(
+            self._pg.alltoall(arrays), lambda: [_as_numpy(a) for a in arrays]
+        )
+
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work:
+        return self._wrap(self._pg.send(array, dst, tag), lambda: None)
+
+    def recv(self, src: int, tag: int = 0) -> Work:
+        return self._wrap(self._pg.recv(src, tag), lambda: None)
+
+    def _wrap(self, work: Work, fallback: "Callable[[], Any]") -> Work:
+        """Hook: ``fallback()`` builds a success-path-shaped substitute result."""
+        return work
+
+
+class ErrorSwallowingProcessGroupWrapper(ProcessGroupWrapper):
+    """After the first error, ops become no-ops returning their inputs.
+
+    Reference: torchft/process_group.py:1123-1179 — lets the training loop
+    continue through a failed step; Manager.should_commit observes the error
+    and triggers reconfigure.
+    """
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__(pg)
+        self._swallowed: Optional[Exception] = None
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self._swallowed = None
+        super().configure(store_addr, replica_id, rank, world_size)
+
+    def errored(self) -> Optional[Exception]:
+        return self._swallowed or super().errored()
+
+    def report_error(self, exc: Exception) -> None:
+        self._swallowed = exc
+
+    def _wrap(self, work: Work, fallback: "Callable[[], Any]") -> Work:
+        if self._swallowed is not None:
+            return completed_work(fallback())
+
+        out: Future = Future()
+
+        def _done(f: "Future[Any]") -> None:
+            exc = f.exception()
+            if exc is not None:
+                if self._swallowed is None:
+                    self._swallowed = (
+                        exc if isinstance(exc, Exception) else RuntimeError(str(exc))
+                    )
+                # Resolve with a result shaped like the success path so the
+                # training loop proceeds; Manager observes errored() later.
+                out.set_result(fallback())
+            else:
+                out.set_result(f.result())
+
+        work.get_future().add_done_callback(_done)
+        return Work(out)
+
+
+class FakeProcessGroupWrapper(ProcessGroupWrapper):
+    """Test-only fault injection: fail the *future* of upcoming ops.
+
+    Reference: torchft/process_group.py:1182-1230 — lets integration tests
+    inject an allreduce failure at a chosen step without touching sockets.
+    """
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__(pg)
+        self._next_op_error: Optional[Exception] = None
+        self._next_configure_error: Optional[Exception] = None
+
+    def report_future_error(self, exc: Exception) -> None:
+        self._next_op_error = exc
+
+    def report_configure_error(self, exc: Exception) -> None:
+        self._next_configure_error = exc
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        if self._next_configure_error is not None:
+            exc, self._next_configure_error = self._next_configure_error, None
+            raise exc
+        super().configure(store_addr, replica_id, rank, world_size)
+
+    def _wrap(self, work: Work, fallback: "Callable[[], Any]") -> Work:
+        if self._next_op_error is not None:
+            exc, self._next_op_error = self._next_op_error, None
+            return failed_work(exc)
+        return work
