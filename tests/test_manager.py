@@ -1,0 +1,344 @@
+"""Manager unit tests with mocked coordination client.
+
+Mirrors reference torchft/manager_test.py:84-911: crafted QuorumResults
+drive every Manager state — happy path, async/sync heal, not enough
+participants, allreduce error, pg.errored, fixed-with-spares, max_retries.
+"""
+
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import QuorumResult
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.parallel.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+)
+
+
+def make_quorum(
+    quorum_id=1,
+    replica_rank=0,
+    replica_world_size=2,
+    max_step=0,
+    max_replica_rank=0,
+    max_world_size=2,
+    heal=False,
+    **kw,
+):
+    return QuorumResult(
+        quorum_id=quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        recover_src_manager_address=kw.get("recover_src_manager_address", ""),
+        recover_src_replica_rank=kw.get("recover_src_replica_rank"),
+        recover_dst_replica_ranks=kw.get("recover_dst_replica_ranks", []),
+        store_address="fakestore:1/",
+        max_step=max_step,
+        max_replica_rank=max_replica_rank,
+        max_world_size=max_world_size,
+        heal=heal,
+        commit_failures=kw.get("commit_failures", 0),
+    )
+
+
+@pytest.fixture
+def manager_ctx():
+    """Manager with fully mocked coordination plumbing."""
+    patches = [
+        patch("torchft_tpu.manager.ManagerServer"),
+        patch("torchft_tpu.manager.StoreServer"),
+        patch("torchft_tpu.manager.StoreClient"),
+        patch("torchft_tpu.manager.ManagerClient"),
+    ]
+    mocks = [p.start() for p in patches]
+    store_client = mocks[2].return_value
+    store_client.get.side_effect = lambda key, **kw: {
+        "manager_addr": "mock:1",
+        "replica_id": "rep0:uuid",
+    }[key]
+    client = mocks[3].return_value
+
+    transport = MagicMock()
+    transport.metadata.return_value = "http://mock"
+
+    def build(pg=None, **kwargs):
+        defaults = dict(
+            pg=pg or ProcessGroupDummy(),
+            min_replica_size=2,
+            load_state_dict=lambda sd: None,
+            state_dict=lambda: {"w": np.zeros(2)},
+            lighthouse_addr="mock-lh:1",
+            group_rank=0,
+            group_world_size=1,
+            checkpoint_transport=transport,
+            use_async_quorum=True,
+        )
+        defaults.update(kwargs)
+        return Manager(**defaults)
+
+    yield build, client, transport
+    for p in patches:
+        p.stop()
+
+
+class TestManagerHappyPath:
+    def test_step_and_commit(self, manager_ctx):
+        build, client, transport = manager_ctx
+        manager = build()
+        client._quorum.return_value = make_quorum()
+        client.should_commit.return_value = True
+
+        manager.start_quorum()
+        assert manager.num_participants() == 2
+        assert manager.is_participating()
+        assert manager.participating_rank() == 0
+
+        result = manager.allreduce(np.full(4, 2.0)).wait(timeout=10)
+        np.testing.assert_allclose(result, np.full(4, 1.0))  # / participants
+
+        assert manager.should_commit()
+        assert manager.current_step() == 1
+        assert manager.batches_committed() == 2
+        transport.disallow_checkpoint.assert_called()
+
+    def test_pg_configured_on_quorum_change(self, manager_ctx):
+        build, client, _ = manager_ctx
+        pg = ProcessGroupDummy()
+        manager = build(pg=pg)
+        client._quorum.return_value = make_quorum(quorum_id=1)
+        client.should_commit.return_value = True
+
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert pg.configure_count == 1
+        manager.should_commit()
+
+        # same quorum id -> no reconfigure
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert pg.configure_count == 1
+
+        # new quorum id -> reconfigure
+        client._quorum.return_value = make_quorum(quorum_id=2)
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert pg.configure_count == 2
+
+    def test_pytree_allreduce(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build()
+        client._quorum.return_value = make_quorum()
+        manager.start_quorum()
+        grads = {"a": np.full(2, 4.0), "b": [np.full(3, 8.0)]}
+        out = manager.allreduce(grads).wait(timeout=10)
+        np.testing.assert_allclose(out["a"], np.full(2, 2.0))
+        np.testing.assert_allclose(out["b"][0], np.full(3, 4.0))
+
+
+class TestManagerHealing:
+    def test_async_heal_applies_on_commit(self, manager_ctx):
+        build, client, transport = manager_ctx
+        loaded = {}
+        manager = build(
+            load_state_dict=lambda sd: loaded.update(sd),
+            state_dict=lambda: {"w": 1},
+        )
+        client._quorum.return_value = make_quorum(
+            replica_rank=1,
+            max_step=7,
+            max_replica_rank=None,
+            max_world_size=1,
+            heal=True,
+            recover_src_replica_rank=0,
+            recover_src_manager_address="peer:1",
+        )
+        client.should_commit.return_value = True
+        client._checkpoint_metadata.return_value = "http://peer"
+        transport.recv_checkpoint.return_value = {
+            "user": {"default": {"w": 42}},
+            "torchft": {"step": 7, "batches_committed": 70},
+        }
+
+        with patch("torchft_tpu.manager.ManagerClient") as peer_cls:
+            peer_cls.return_value._checkpoint_metadata.return_value = "http://peer"
+            manager.start_quorum()
+            manager.wait_quorum()
+
+        # healing: not participating this step, contributes zeros
+        assert manager._healing
+        assert not manager.is_participating()
+        result = manager.allreduce(np.full(2, 5.0)).wait(timeout=10)
+        np.testing.assert_allclose(result, np.zeros(2))
+
+        # commit applies the healed user state on the main thread
+        assert manager.should_commit()
+        assert loaded == {"w": 42}
+        # step restored from the healed torchft dict then bumped by commit
+        assert manager.current_step() == 8
+
+    def test_sync_quorum_heals_eagerly(self, manager_ctx):
+        build, client, transport = manager_ctx
+        loaded = {}
+        manager = build(
+            use_async_quorum=False,
+            load_state_dict=lambda sd: loaded.update(sd),
+            state_dict=lambda: {"w": 0},
+        )
+        client._quorum.return_value = make_quorum(
+            replica_rank=1,
+            max_step=3,
+            heal=True,
+            recover_src_replica_rank=0,
+            recover_src_manager_address="peer:1",
+        )
+        transport.recv_checkpoint.return_value = {
+            "user": {"default": {"w": 9}},
+            "torchft": {"step": 3, "batches_committed": 6},
+        }
+        with patch("torchft_tpu.manager.ManagerClient") as peer_cls:
+            peer_cls.return_value._checkpoint_metadata.return_value = "meta"
+            manager.start_quorum()
+        # eager apply: state loaded before returning; participates this step
+        assert loaded == {"w": 9}
+        assert not manager._healing
+        assert manager.is_participating()
+
+    def test_send_checkpoint_to_recovering_peers(self, manager_ctx):
+        build, client, transport = manager_ctx
+        manager = build()
+        client._quorum.return_value = make_quorum(
+            recover_dst_replica_ranks=[1, 2], max_step=4
+        )
+        manager.start_quorum()
+        manager.wait_quorum()
+        transport.send_checkpoint.assert_called_once()
+        kwargs = transport.send_checkpoint.call_args.kwargs
+        assert kwargs["dst_ranks"] == [1, 2]
+        assert kwargs["step"] == 4
+        assert "user" in kwargs["state_dict"] and "torchft" in kwargs["state_dict"]
+
+
+class TestManagerFailures:
+    def test_not_enough_participants_blocks_commit(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build(min_replica_size=3)
+        client._quorum.return_value = make_quorum(max_world_size=2)
+        client.should_commit.return_value = False
+        manager.start_quorum()
+        assert not manager.should_commit()
+        assert manager.current_step() == 0
+        # the local vote must have been False
+        assert client.should_commit.call_args.args[2] is False
+
+    def test_allreduce_error_swallowed_and_blocks_commit(self, manager_ctx):
+        build, client, _ = manager_ctx
+        pg = FakeProcessGroupWrapper(ProcessGroupDummy())
+        manager = build(pg=pg)
+        client._quorum.return_value = make_quorum()
+        client.should_commit.return_value = False
+        manager.start_quorum()
+        pg.report_future_error(RuntimeError("injected allreduce failure"))
+        # the work completes cleanly (with the input) but the error latches
+        result = manager.allreduce(np.full(2, 3.0)).wait(timeout=10)
+        np.testing.assert_allclose(result, np.full(2, 3.0))
+        assert manager.errored() is not None
+        assert not manager.should_commit()
+        assert client.should_commit.call_args.args[2] is False
+        # after the error, allreduce is a no-op passthrough
+        np.testing.assert_allclose(
+            manager.allreduce(np.full(2, 9.0)).wait(timeout=10), np.full(2, 9.0)
+        )
+
+    def test_pg_errored_blocks_commit(self, manager_ctx):
+        build, client, _ = manager_ctx
+        pg = ErrorSwallowingProcessGroupWrapper(ProcessGroupDummy())
+        manager = build(pg=pg)
+        client._quorum.return_value = make_quorum()
+        client.should_commit.return_value = False
+        manager.start_quorum()
+        pg.report_error(RuntimeError("pg broke"))
+        assert not manager.should_commit()
+        assert manager.errored() is not None
+
+    def test_quorum_failure_captured(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build()
+        client._quorum.side_effect = TimeoutError("lighthouse down")
+        client.should_commit.return_value = False
+        manager.start_quorum()
+        assert not manager.should_commit()
+        assert manager.errored() is not None
+
+    def test_max_retries_raises(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build(max_retries=2, min_replica_size=2)
+        client._quorum.return_value = make_quorum(max_world_size=1)
+        client.should_commit.return_value = False
+        for _ in range(3):
+            manager.start_quorum()
+            if manager._commit_failures == 2:
+                with pytest.raises(RuntimeError, match="max_retries"):
+                    manager.should_commit()
+            else:
+                assert not manager.should_commit()
+
+    def test_commit_failures_reported_to_quorum(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build(min_replica_size=5)
+        client._quorum.return_value = make_quorum()
+        client.should_commit.return_value = False
+        manager.start_quorum()
+        assert not manager.should_commit()
+        manager.start_quorum()
+        manager.wait_quorum()
+        # second quorum call carries commit_failures=1
+        assert client._quorum.call_args.kwargs["commit_failures"] == 1
+
+
+class TestWorldSizeModes:
+    def test_fixed_with_spares_caps_world(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build(
+            min_replica_size=2, world_size_mode=WorldSizeMode.FIXED_WITH_SPARES
+        )
+        client._quorum.return_value = make_quorum(
+            max_world_size=4, max_replica_rank=3
+        )
+        manager.start_quorum()
+        assert manager.num_participants() == 2
+        # this replica (rank 3) is a spare -> not participating
+        assert not manager.is_participating()
+        assert manager.participating_rank() is None
+
+
+class TestStateDict:
+    def test_state_dict_round_trip(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build()
+        manager.load_state_dict({"step": 12, "batches_committed": 34})
+        assert manager.current_step() == 12
+        assert manager.state_dict() == {"step": 12, "batches_committed": 34}
+
+    def test_manager_state_dict_composite(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build(state_dict=lambda: {"w": 5})
+        sd = manager._manager_state_dict()
+        assert sd["user"]["default"] == {"w": 5}
+        assert sd["torchft"] == {"step": 0, "batches_committed": 0}
+
+    def test_multiple_state_dict_fns(self, manager_ctx):
+        build, client, _ = manager_ctx
+        manager = build()
+        loaded = {}
+        manager.register_state_dict_fn(
+            "frag0", lambda sd: loaded.update(frag0=sd), lambda: "s0"
+        )
+        manager.register_state_dict_fn(
+            "frag1", lambda sd: loaded.update(frag1=sd), lambda: "s1"
+        )
+        sd = manager._manager_state_dict()
+        assert sd["user"]["frag0"] == "s0" and sd["user"]["frag1"] == "s1"

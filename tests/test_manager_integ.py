@@ -1,0 +1,228 @@
+"""Integration tests: threads-as-replicas with a real coordination stack.
+
+The reference's central testing trick (reference:
+torchft/manager_integ_test.py:179-359): each replica group is a thread with
+its own Manager + store + PG; one real LighthouseServer binds port 0.
+Fault injection via step-keyed events; recovery must make state dicts
+converge **bitwise** across replicas (reference :361-362) — the
+zero-contribution allreduce hands the healer the same averaged gradients the
+participants applied, so one step after healing everyone is identical.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import (
+    FakeProcessGroupWrapper,
+    ProcessGroupTCP,
+)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+class EventInjector:
+    """(replica, step)-keyed fault injection
+    (reference: manager_integ_test.py:79-161)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._fail_at: "Dict[tuple, bool]" = {}
+        self._fail_allreduce_at: "Dict[tuple, bool]" = {}
+        self.count = 0
+
+    def fail_at(self, replica: int, step: int) -> "EventInjector":
+        with self._lock:
+            self._fail_at[(replica, step)] = True
+        return self
+
+    def fail_allreduce_at(self, replica: int, step: int) -> "EventInjector":
+        with self._lock:
+            self._fail_allreduce_at[(replica, step)] = True
+        return self
+
+    def check(self, replica: int, step: int, pg: FakeProcessGroupWrapper) -> None:
+        with self._lock:
+            if self._fail_at.pop((replica, step), None):
+                self.count += 1
+                raise InjectedFailure(
+                    f"injected failure replica={replica} step={step}"
+                )
+            if self._fail_allreduce_at.pop((replica, step), None):
+                self.count += 1
+                pg.report_future_error(
+                    RuntimeError(f"injected allreduce failure step={step}")
+                )
+
+
+@dataclass
+class Runner:
+    """One replica group (single local rank) running a toy DDP loop."""
+
+    replica_id: int
+    lighthouse_addr: str
+    event_injector: EventInjector
+    total_steps: int = 5
+    min_replica_size: int = 1
+    use_async_quorum: bool = True
+    attempts: int = 3
+    lr: float = 0.1
+    state_history: "List[dict]" = field(default_factory=list)
+
+    def run(self) -> dict:
+        last_exc: "Optional[BaseException]" = None
+        for attempt in range(self.attempts):
+            try:
+                return self._train(attempt)
+            except InjectedFailure as e:
+                last_exc = e
+                continue
+        raise RuntimeError(f"replica {self.replica_id} exhausted attempts") from last_exc
+
+    def _train(self, attempt: int) -> dict:
+        # Toy model: params w; deterministic "gradient" = f(step). Fresh
+        # params each (re)start — healing must restore them.
+        params = {"w": np.zeros(4, dtype=np.float32)}
+        momentum = {"w": np.zeros(4, dtype=np.float32)}
+
+        def load_state_dict(sd):
+            params["w"] = np.array(sd["params"]["w"])
+            momentum["w"] = np.array(sd["momentum"]["w"])
+
+        def state_dict():
+            return {
+                "params": {"w": params["w"].copy()},
+                "momentum": {"w": momentum["w"].copy()},
+            }
+
+        pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+        manager = Manager(
+            pg=pg,
+            min_replica_size=self.min_replica_size,
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            lighthouse_addr=self.lighthouse_addr,
+            replica_id=f"replica_{self.replica_id}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=self.use_async_quorum,
+            timeout=20.0,
+            quorum_timeout=20.0,
+        )
+        try:
+            while manager.current_step() < self.total_steps:
+                step = manager.current_step()
+                self.event_injector.check(self.replica_id, step, pg)
+
+                manager.start_quorum()
+                # deterministic per-step pseudo-gradient, same on every
+                # replica so DDP averaging is an identity check
+                grads = {
+                    "w": np.full(4, float(step + 1), dtype=np.float32)
+                    * (1.0 + 0.5 * self.replica_id)
+                }
+                avg_grads = manager.allreduce(grads).wait(timeout=30)
+                if manager.should_commit():
+                    momentum["w"] = 0.9 * momentum["w"] + avg_grads["w"]
+                    params["w"] = params["w"] - self.lr * momentum["w"]
+                    self.state_history.append(
+                        {"step": manager.current_step(), "w": params["w"].copy()}
+                    )
+            return {
+                "replica_id": self.replica_id,
+                "state_dict": state_dict(),
+                "manager_state": manager.state_dict(),
+            }
+        finally:
+            manager.shutdown()
+
+
+def run_replicas(runners: "List[Runner]") -> "List[dict]":
+    with ThreadPoolExecutor(max_workers=len(runners)) as ex:
+        futures = [ex.submit(r.run) for r in runners]
+        return [f.result(timeout=120) for f in futures]
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    yield server
+    server.shutdown()
+
+
+def assert_bitwise_equal(results):
+    base = results[0]["state_dict"]
+    for other in results[1:]:
+        np.testing.assert_array_equal(
+            base["params"]["w"], other["state_dict"]["params"]["w"]
+        )
+        np.testing.assert_array_equal(
+            base["momentum"]["w"], other["state_dict"]["momentum"]["w"]
+        )
+
+
+class TestDDPInteg:
+    def test_ddp_healthy(self, lighthouse):
+        injector = EventInjector()
+        runners = [
+            Runner(i, lighthouse.address(), injector, total_steps=4, min_replica_size=2)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert all(r["manager_state"]["step"] == 4 for r in results)
+        # 2 participants x 4 steps
+        assert all(r["manager_state"]["batches_committed"] == 8 for r in results)
+        assert_bitwise_equal(results)
+
+    @pytest.mark.parametrize("use_async", [True, False])
+    def test_ddp_recovery(self, lighthouse, use_async):
+        injector = EventInjector().fail_at(replica=1, step=2)
+        runners = [
+            Runner(
+                i,
+                lighthouse.address(),
+                injector,
+                total_steps=5,
+                min_replica_size=1,
+                use_async_quorum=use_async,
+            )
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 1
+        assert all(r["manager_state"]["step"] == 5 for r in results)
+        assert_bitwise_equal(results)
+
+    def test_ddp_allreduce_failure_recovers(self, lighthouse):
+        injector = EventInjector().fail_allreduce_at(replica=1, step=1)
+        runners = [
+            Runner(i, lighthouse.address(), injector, total_steps=4, min_replica_size=1)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 1
+        assert all(r["manager_state"]["step"] == 4 for r in results)
+        assert_bitwise_equal(results)
+
+    def test_multi_replica_recovery(self, lighthouse):
+        # two different replicas die at different steps
+        injector = EventInjector().fail_at(1, 1).fail_at(2, 2)
+        runners = [
+            Runner(i, lighthouse.address(), injector, total_steps=5, min_replica_size=1)
+            for i in range(3)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 2
+        assert all(r["manager_state"]["step"] == 5 for r in results)
+        assert_bitwise_equal(results)

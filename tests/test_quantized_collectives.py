@@ -1,0 +1,113 @@
+"""Quantized collective correctness vs eager (reference:
+torchft/quantization_test.py + collectives_test.py)."""
+
+import numpy as np
+import pytest
+
+from tests.test_process_group import make_group, run_parallel, store  # noqa: F401
+from torchft_tpu.ops import quantization as q
+from torchft_tpu.ops.collectives import allreduce_quantized, reduce_scatter_quantized
+from torchft_tpu.parallel.process_group import REDUCE_AVG, REDUCE_SUM
+
+
+class TestQuantization:
+    def test_quantize_round_trip(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((16, 256)).astype(np.float32)
+        scales, payload = q.quantize(a)
+        out = q.dequantize(scales, payload, a.shape, a.dtype)
+        # int8 row-scale error bound: absmax/127 per element
+        bound = (np.abs(a).max(axis=1, keepdims=True) / 127.0) * 0.51
+        assert np.all(np.abs(out - a) <= bound + 1e-7)
+
+    def test_pack_unpack(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        scales, payload = q.quantize(a)
+        s2, p2 = q.unpack(q.pack(scales, payload), 3, 4)
+        np.testing.assert_array_equal(scales, s2)
+        np.testing.assert_array_equal(payload, p2)
+
+    def test_zero_rows(self):
+        a = np.zeros((4, 8), dtype=np.float32)
+        scales, payload = q.quantize(a)
+        out = q.dequantize(scales, payload, a.shape, a.dtype)
+        np.testing.assert_array_equal(out, a)
+
+    def test_reduce_quantized(self):
+        rng = np.random.default_rng(1)
+        arrays = [rng.standard_normal((4, 64)).astype(np.float32) for _ in range(3)]
+        bufs = [q.pack(*q.quantize(a)) for a in arrays]
+        reduced = q.reduce_quantized(bufs, 4, 64)
+        scales, payload = q.unpack(reduced, 4, 64)
+        out = q.dequantize(scales, payload, (4, 64), np.float32)
+        expected = sum(arrays)
+        assert np.abs(out - expected).max() < np.abs(expected).max() * 0.05
+
+
+class TestQuantizedCollectives:
+    @pytest.mark.parametrize("op", [REDUCE_SUM, REDUCE_AVG])
+    def test_allreduce_quantized_vs_eager(self, store, op):  # noqa: F811
+        world = 3
+        pgs = make_group(store, world, prefix="qar")
+        rng = np.random.default_rng(7)
+        data = [
+            [rng.standard_normal((33, 65)).astype(np.float32), rng.standard_normal(100).astype(np.float32)]
+            for _ in range(world)
+        ]
+        expected = [sum(d[i] for d in data) for i in range(2)]
+        if op == REDUCE_AVG:
+            expected = [e / world for e in expected]
+
+        def run(rank, _):
+            return allreduce_quantized(data[rank], op, pgs[rank]).wait(timeout=30)
+
+        for result in run_parallel(world, run):
+            for got, want in zip(result, expected):
+                assert got.shape == want.shape
+                rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+                assert rel < 0.05, f"quantization error too large: {rel}"
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allreduce_quantized_average_by(self, store):  # noqa: F811
+        # Manager passes the live participant count (not pg size).
+        world = 2
+        pgs = make_group(store, world, prefix="qavg")
+        data = [np.full((8, 16), 2.0, dtype=np.float32) for _ in range(world)]
+
+        def run(rank, _):
+            return allreduce_quantized(
+                [data[rank]], REDUCE_AVG, pgs[rank], average_by=4
+            ).wait(timeout=30)
+
+        for result in run_parallel(world, run):
+            np.testing.assert_allclose(result[0], np.full((8, 16), 1.0), rtol=0.02)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter_quantized(self, store):  # noqa: F811
+        world = 2
+        pgs = make_group(store, world, prefix="qrs")
+        rng = np.random.default_rng(3)
+        data = [rng.standard_normal((8, 32)).astype(np.float32) for _ in range(world)]
+        expected = sum(data)
+
+        def run(rank, _):
+            return reduce_scatter_quantized(data[rank], REDUCE_SUM, pgs[rank]).wait(
+                timeout=30
+            )
+
+        results = run_parallel(world, run)
+        for rank, got in enumerate(results):
+            want = expected[rank * 4 : (rank + 1) * 4]
+            rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+            assert rel < 0.05
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_rejects_int_arrays(self, store):  # noqa: F811
+        pgs = make_group(store, 2, prefix="qint")
+        with pytest.raises(ValueError, match="floating point"):
+            allreduce_quantized([np.ones(4, dtype=np.int32)], REDUCE_SUM, pgs[0])
+        for pg in pgs:
+            pg.shutdown()

@@ -1,0 +1,247 @@
+"""Checkpoint transport round-trip tests.
+
+Mirrors reference torchft/checkpointing/{http_transport_test,
+pg_transport_test, transport_test}.py: full + chunked HTTP fetch, RWLock
+serving guarantees, PG transport incl. in-place receive.
+"""
+
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import HTTPTransport, PGTransport
+from torchft_tpu.checkpointing import serialization as ser
+from torchft_tpu.coordination import StoreServer
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+
+def sample_state_dict():
+    return {
+        "user": {
+            "params": {
+                "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "b": np.zeros(4, dtype=np.float32),
+            },
+            "opt": [np.ones(3, dtype=np.float64), 7],
+            "label": "hello",
+        },
+        "torchft": {"step": 5, "batches_committed": 10},
+    }
+
+
+def assert_state_dicts_equal(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert x == y
+
+
+class TestSerialization:
+    def test_round_trip(self):
+        sd = sample_state_dict()
+        assert_state_dicts_equal(ser.deserialize(ser.serialize(sd)), sd)
+
+    def test_chunked_round_trip(self):
+        sd = sample_state_dict()
+        import jax
+
+        n = len(jax.tree_util.tree_flatten(sd)[0])
+        chunks = ser.split_chunks(n, 3)
+        assert sorted(sum(chunks, [])) == list(range(n))
+        merged = {}
+        skeleton = None
+        for idx in chunks:
+            s, leaves, total = ser.deserialize_from(
+                __import__("io").BytesIO(ser.serialize(sd, chunk_indices=idx))
+            )
+            skeleton = s
+            merged.update(leaves)
+        assert_state_dicts_equal(ser.reassemble(skeleton, merged, n), sd)
+
+    def test_missing_chunk_detected(self):
+        sd = sample_state_dict()
+        import io
+
+        s, leaves, n = ser.deserialize_from(
+            io.BytesIO(ser.serialize(sd, chunk_indices=[0]))
+        )
+        with pytest.raises(ValueError, match="missing leaf"):
+            ser.reassemble(s, leaves, n)
+
+    def test_jax_arrays(self):
+        import jax.numpy as jnp
+
+        sd = {"w": jnp.arange(6.0).reshape(2, 3)}
+        out = ser.deserialize(ser.serialize(sd))
+        np.testing.assert_array_equal(out["w"], np.arange(6.0).reshape(2, 3))
+
+
+class TestHTTPTransport:
+    def test_full_round_trip(self):
+        sender = HTTPTransport(timeout=10.0)
+        receiver = HTTPTransport(timeout=10.0)
+        try:
+            sd = sample_state_dict()
+            sender.send_checkpoint([1], step=5, state_dict=sd, timeout=10.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=5, timeout=10.0
+            )
+            assert_state_dicts_equal(out, sd)
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_chunked_round_trip(self):
+        sender = HTTPTransport(timeout=10.0, num_chunks=3)
+        receiver = HTTPTransport(timeout=10.0, num_chunks=3)
+        try:
+            sd = sample_state_dict()
+            sender.send_checkpoint([1], step=2, state_dict=sd, timeout=10.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=2, timeout=10.0
+            )
+            assert_state_dicts_equal(out, sd)
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_wrong_step_404(self):
+        sender = HTTPTransport(timeout=5.0)
+        try:
+            sender.send_checkpoint([1], step=5, state_dict={"x": 1}, timeout=5.0)
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{sender.metadata()}/checkpoint/99/full", timeout=5
+                )
+        finally:
+            sender.shutdown()
+
+    def test_disallow_checkpoint(self):
+        sender = HTTPTransport(timeout=5.0)
+        try:
+            sender.send_checkpoint([1], step=1, state_dict={"x": 1}, timeout=5.0)
+            sender.disallow_checkpoint()
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{sender.metadata()}/checkpoint/1/full", timeout=5
+                )
+        finally:
+            sender.shutdown()
+
+
+class TestPGTransport:
+    def _pair(self, store, state_dict_fn=None):
+        pgs = [ProcessGroupTCP(timeout=10.0) for _ in range(2)]
+        threads = [
+            threading.Thread(
+                target=pgs[r].configure,
+                args=(f"{store.address()}/pgt", f"r{r}", r, 2),
+            )
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        return (
+            PGTransport(pgs[0], timeout=10.0),
+            PGTransport(pgs[1], timeout=10.0, state_dict_fn=state_dict_fn),
+            pgs,
+        )
+
+    def test_round_trip(self):
+        with StoreServer() as store:
+            sender, receiver, pgs = self._pair(store)
+            sd = sample_state_dict()
+            out = {}
+
+            def send():
+                sender.send_checkpoint([1], step=5, state_dict=sd, timeout=10.0)
+
+            def recv():
+                out["sd"] = receiver.recv_checkpoint(
+                    src_rank=0, metadata="<n/a>", step=5, timeout=10.0
+                )
+
+            ts = [threading.Thread(target=send), threading.Thread(target=recv)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(20)
+            assert_state_dicts_equal(out["sd"], sd)
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_in_place_receive(self):
+        with StoreServer() as store:
+            target = {
+                "user": {
+                    "params": {
+                        "w": np.zeros((3, 4), dtype=np.float32),
+                        "b": np.zeros(4, dtype=np.float32),
+                    },
+                    "opt": [np.zeros(3, dtype=np.float64), 0],
+                    "label": "",
+                },
+                "torchft": {"step": 0, "batches_committed": 0},
+            }
+            sender, receiver, pgs = self._pair(store, state_dict_fn=lambda: target)
+            sd = sample_state_dict()
+            out = {}
+
+            def send():
+                sender.send_checkpoint([1], step=5, state_dict=sd, timeout=10.0)
+
+            def recv():
+                out["sd"] = receiver.recv_checkpoint(
+                    src_rank=0, metadata="<n/a>", step=5, timeout=10.0
+                )
+
+            ts = [threading.Thread(target=send), threading.Thread(target=recv)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(20)
+            assert_state_dicts_equal(out["sd"], sd)
+            # fast path: the result's array leaves ARE the target's buffers
+            assert out["sd"]["user"]["params"]["w"] is target["user"]["params"]["w"]
+            np.testing.assert_array_equal(
+                target["user"]["params"]["w"], sd["user"]["params"]["w"]
+            )
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_step_mismatch(self):
+        with StoreServer() as store:
+            sender, receiver, pgs = self._pair(store)
+            errs = {}
+
+            def send():
+                try:
+                    sender.send_checkpoint([1], step=5, state_dict={"x": np.ones(2)}, timeout=5.0)
+                except Exception as e:  # noqa: BLE001
+                    errs["send"] = e
+
+            def recv():
+                try:
+                    receiver.recv_checkpoint(src_rank=0, metadata="", step=7, timeout=5.0)
+                except Exception as e:  # noqa: BLE001
+                    errs["recv"] = e
+
+            ts = [threading.Thread(target=send), threading.Thread(target=recv)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(15)
+            assert "step mismatch" in str(errs["recv"])
+            for pg in pgs:
+                pg.shutdown()

@@ -1,0 +1,5 @@
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = ["CheckpointTransport", "HTTPTransport", "PGTransport"]

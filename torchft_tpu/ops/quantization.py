@@ -1,0 +1,82 @@
+"""Row-scaled 8-bit quantization for bandwidth-reduced DCN collectives.
+
+Analog of the reference's fused quantization kernels
+(reference: torchft/quantization.py:44-686): per-row absmax scales, int8
+payload, and scales interleaved into one flat comm buffer; dequant-reduce-
+requant fuses the reduction.  The reference targets fp8e4nv on SM90 with an
+int8 fallback; the DCN payloads here are int8 (numpy has no fp8), matching
+the reference's fallback format (:30-41).
+
+Two implementations share the wire format:
+- host path (numpy) used by the TCP/DCN collective layer below;
+- device path (jax / Pallas TPU kernel, torchft_tpu.ops.pallas_quant) for
+  quantizing on-chip before the host copy — see fused_* wrappers there.
+
+Wire layout per array: ``[rows x f32 scale][rows x cols int8]`` flattened.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+INT8_MAX = 127.0
+
+
+def _as_rows(a: np.ndarray) -> np.ndarray:
+    """View as 2-D (rows, cols): leading dim preserved, rest flattened."""
+    if a.ndim == 0:
+        return a.reshape(1, 1)
+    if a.ndim == 1:
+        return a.reshape(1, -1)
+    return a.reshape(a.shape[0], -1)
+
+
+def quantize(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row absmax int8 quantization -> (scales f32 [rows], payload int8)."""
+    rows = _as_rows(np.asarray(a, dtype=np.float32))
+    absmax = np.abs(rows).max(axis=1)
+    scales = np.where(absmax > 0, absmax / INT8_MAX, 1.0).astype(np.float32)
+    payload = np.clip(
+        np.rint(rows / scales[:, None]), -INT8_MAX, INT8_MAX
+    ).astype(np.int8)
+    return scales, payload
+
+
+def dequantize(
+    scales: np.ndarray, payload: np.ndarray, shape: "Tuple[int, ...]", dtype: np.dtype
+) -> np.ndarray:
+    out = payload.astype(np.float32) * scales[:, None]
+    return out.reshape(shape).astype(dtype)
+
+
+def pack(scales: np.ndarray, payload: np.ndarray) -> np.ndarray:
+    """Interleave scales + payload into one uint8 comm buffer
+    (reference quantization.py:54-165 packs fp8 payload + f32 scales)."""
+    return np.concatenate([scales.view(np.uint8).ravel(), payload.view(np.uint8).ravel()])
+
+
+def unpack(buf: np.ndarray, rows: int, cols: int) -> Tuple[np.ndarray, np.ndarray]:
+    scale_bytes = rows * 4
+    scales = buf[:scale_bytes].view(np.float32).copy()
+    payload = buf[scale_bytes : scale_bytes + rows * cols].view(np.int8).reshape(rows, cols).copy()
+    return scales, payload
+
+
+def reduce_quantized(
+    bufs: "List[np.ndarray]", rows: int, cols: int, average_by: int = 0
+) -> np.ndarray:
+    """Dequantize each packed buffer, accumulate in f32, requantize.
+
+    Analog of the reference's fused dequant-accumulate-requant kernel
+    (reference quantization.py:262-430). ``average_by > 0`` divides the
+    accumulated sum (AVG fusion).
+    """
+    acc = np.zeros((rows, cols), dtype=np.float32)
+    for buf in bufs:
+        scales, payload = unpack(buf, rows, cols)
+        acc += payload.astype(np.float32) * scales[:, None]
+    if average_by > 0:
+        acc /= average_by
+    return pack(*quantize(acc))
