@@ -1,0 +1,220 @@
+"""LocalSGD / DiLoCo integration: threads-as-replicas with the real stack.
+
+Mirrors reference torchft/local_sgd_integ_test.py: LocalSGD recovery,
+DiLoCo recovery, and a third replica joining mid-run (upscale).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+from tests.test_manager_integ import EventInjector, InjectedFailure
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    yield server
+    server.shutdown()
+
+
+class DiLoCoRunner:
+    """Replica running DiLoCo: deterministic inner updates so outer syncs
+    are exactly comparable across replicas."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        lighthouse_addr: str,
+        injector: EventInjector,
+        outer_syncs: int = 4,
+        sync_every: int = 4,
+        n_fragments: int = 2,
+        algo: str = "diloco",
+        inner_sleep: float = 0.0,
+    ) -> None:
+        self.replica_id = replica_id
+        self.lighthouse_addr = lighthouse_addr
+        self.injector = injector
+        self.outer_syncs = outer_syncs
+        self.sync_every = sync_every
+        self.n_fragments = n_fragments
+        self.algo = algo
+        self.inner_sleep = inner_sleep
+
+    def run(self) -> dict:
+        for attempt in range(3):
+            try:
+                return self._train()
+            except InjectedFailure:
+                continue
+        raise RuntimeError("exhausted attempts")
+
+    def _train(self) -> dict:
+        params = {
+            "layer0": np.zeros(4, dtype=np.float32),
+            "layer1": np.zeros(4, dtype=np.float32),
+        }
+        holder = {"p": params}
+
+        def get_params():
+            return dict(holder["p"])
+
+        def set_params(p):
+            holder["p"] = dict(p)
+
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=10.0),
+            min_replica_size=1,
+            lighthouse_addr=self.lighthouse_addr,
+            replica_id=f"diloco_{self.replica_id}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=20.0,
+            load_state_dict=lambda sd: holder.__setitem__(
+                "p", {k: np.array(v) for k, v in sd.items()}
+            ),
+            state_dict=lambda: {k: np.array(v) for k, v in holder["p"].items()},
+        )
+        try:
+            if self.algo == "diloco":
+                algo = DiLoCo(
+                    manager,
+                    [["layer0"], ["layer1"]][: self.n_fragments]
+                    if self.n_fragments > 1
+                    else [["layer0", "layer1"]],
+                    get_params,
+                    set_params,
+                    optax.sgd(0.5, momentum=0.9, nesterov=True),
+                    sync_every=self.sync_every,
+                )
+            else:
+                algo = LocalSGD(manager, get_params, set_params, self.sync_every)
+            target_steps = self.outer_syncs * (
+                self.n_fragments if self.algo == "diloco" else 1
+            )
+            inner = 0
+            while manager.current_step() < target_steps:
+                self.injector.check(self.replica_id, manager.current_step(), None)
+                if self.inner_sleep:
+                    import time
+
+                    time.sleep(self.inner_sleep)
+                # deterministic inner update (same on all replicas)
+                inner += 1
+                p = get_params()
+                set_params(
+                    {k: v - 0.01 * (1.0 + i) for i, (k, v) in enumerate(sorted(p.items()))}
+                )
+                algo.step()
+            return {
+                "params": get_params(),
+                "manager_state": manager.state_dict(),
+            }
+        finally:
+            manager.shutdown()
+
+
+def run_replicas(runners) -> "List[dict]":
+    with ThreadPoolExecutor(max_workers=len(runners)) as ex:
+        futures = [ex.submit(r.run) for r in runners]
+        return [f.result(timeout=180) for f in futures]
+
+
+def assert_params_equal(results):
+    base = results[0]["params"]
+    for other in results[1:]:
+        for k in base:
+            np.testing.assert_array_equal(base[k], other["params"][k])
+
+
+class TestLocalSGDInteg:
+    def test_local_sgd_healthy(self, lighthouse):
+        injector = EventInjector()
+        runners = [
+            DiLoCoRunner(i, lighthouse.address(), injector, algo="local_sgd", outer_syncs=3)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert all(r["manager_state"]["step"] == 3 for r in results)
+        assert_params_equal(results)
+
+    def test_local_sgd_recovery(self, lighthouse):
+        injector = EventInjector().fail_at(replica=1, step=1)
+        runners = [
+            DiLoCoRunner(i, lighthouse.address(), injector, algo="local_sgd", outer_syncs=4)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 1
+        assert all(r["manager_state"]["step"] == 4 for r in results)
+        assert_params_equal(results)
+
+
+class TestDiLoCoInteg:
+    def test_diloco_healthy_two_fragments(self, lighthouse):
+        injector = EventInjector()
+        runners = [
+            DiLoCoRunner(i, lighthouse.address(), injector, outer_syncs=3)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        # step counts fragment syncs: 3 rounds x 2 fragments
+        assert all(r["manager_state"]["step"] == 6 for r in results)
+        assert_params_equal(results)
+
+    def test_diloco_recovery(self, lighthouse):
+        injector = EventInjector().fail_at(replica=1, step=2)
+        runners = [
+            DiLoCoRunner(i, lighthouse.address(), injector, outer_syncs=4)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 1
+        assert all(r["manager_state"]["step"] == 8 for r in results)
+        assert_params_equal(results)
+
+    def test_diloco_upscale_mid_run(self, lighthouse):
+        # third replica joins after the first two have synced a few times;
+        # inner steps are paced so the join lands mid-run.
+        injector = EventInjector()
+        runners = [
+            DiLoCoRunner(
+                i, lighthouse.address(), injector, outer_syncs=5, inner_sleep=0.05
+            )
+            for i in range(3)
+        ]
+        results = {}
+
+        def run_delayed(idx, delay):
+            if delay:
+                import time
+
+                time.sleep(delay)
+            results[idx] = runners[idx].run()
+
+        threads = [
+            threading.Thread(target=run_delayed, args=(0, 0)),
+            threading.Thread(target=run_delayed, args=(1, 0)),
+            threading.Thread(target=run_delayed, args=(2, 0.5)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        ordered = [results[i] for i in range(3)]
+        assert all(r["manager_state"]["step"] == 10 for r in ordered)
+        assert_params_equal(ordered)

@@ -245,3 +245,65 @@ class TestPGTransport:
             assert "step mismatch" in str(errs["recv"])
             for pg in pgs:
                 pg.shutdown()
+
+
+class TestBf16AndZeroDim:
+    def test_bf16_round_trip(self):
+        # TPU's default training dtype must survive serialization (ml_dtypes
+        # have no buffer-protocol format char — regression for memoryview.cast)
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        sd = {
+            "w": np.full((4, 3), 1.5, dtype=np.float32).astype(ml_dtypes.bfloat16),
+            "step": np.asarray(7, dtype=np.int32),
+            "j": jnp.ones((2,), dtype=jnp.bfloat16),
+        }
+        out = ser.deserialize(ser.serialize(sd))
+        assert out["w"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            out["w"].astype(np.float32), np.full((4, 3), 1.5, np.float32)
+        )
+        assert out["step"].shape == () and out["step"] == 7
+        assert out["j"].dtype == ml_dtypes.bfloat16
+
+    def test_bf16_http_transport(self):
+        import ml_dtypes
+
+        sender = HTTPTransport(timeout=10.0)
+        receiver = HTTPTransport(timeout=10.0)
+        try:
+            sd = {"w": np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16)}
+            sender.send_checkpoint([1], step=3, state_dict=sd, timeout=10.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=3, timeout=10.0
+            )
+            assert out["w"].dtype == ml_dtypes.bfloat16
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_recv_retries_until_staged(self):
+        # healer fetches BEFORE the sender stages: must poll, not fail
+        import threading
+        import time as _time
+
+        sender = HTTPTransport(timeout=10.0)
+        receiver = HTTPTransport(timeout=10.0)
+        try:
+            sd = {"w": np.ones(3)}
+
+            def stage_late():
+                _time.sleep(0.5)
+                sender.send_checkpoint([1], step=9, state_dict=sd, timeout=5.0)
+
+            t = threading.Thread(target=stage_late)
+            t.start()
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=9, timeout=10.0
+            )
+            t.join()
+            np.testing.assert_array_equal(out["w"], np.ones(3))
+        finally:
+            sender.shutdown()
+            receiver.shutdown()

@@ -68,10 +68,11 @@ class _Handler(BaseHTTPRequestHandler):
             with transport._staged_lock.r_lock(timeout=transport._lock_timeout):
                 staged = transport._staged
                 if staged is None or staged[0] != step:
-                    # Healer raced the sender's staging: 404 with Retry-After;
-                    # recv_checkpoint polls until its deadline.
+                    # Healer raced the sender's staging: retryable 503 (the
+                    # receiver polls until its deadline). Permanent problems
+                    # (bad path, chunk out of range) stay 404 and fail fast.
                     self.send_error(
-                        404,
+                        503,
                         f"no checkpoint staged for step {step}",
                     )
                     return
@@ -153,8 +154,8 @@ class HTTPTransport(CheckpointTransport[Any]):
         def fetch(path: str):
             # The healer and the sender learn the quorum simultaneously; the
             # sender may still be device->host staging the snapshot. Poll
-            # through 404s until the deadline (pull-transport analog of the
-            # reference blocking readers on the rwlock until staged).
+            # through retryable 503s (and connection errors during sender
+            # restart) until the deadline; permanent 404s fail immediately.
             backoff = 0.05
             while True:
                 t = max(deadline - time.monotonic(), 0.001)
@@ -162,7 +163,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     with urllib.request.urlopen(f"{base}/{path}", timeout=t) as resp:
                         return ser.deserialize_from(resp)
                 except urllib.error.HTTPError as e:
-                    if e.code not in (404, 503) or time.monotonic() + backoff >= deadline:
+                    if e.code != 503 or time.monotonic() + backoff >= deadline:
                         raise
                 except urllib.error.URLError:
                     if time.monotonic() + backoff >= deadline:

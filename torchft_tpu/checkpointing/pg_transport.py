@@ -53,21 +53,15 @@ class PGTransport(CheckpointTransport[Any]):
     def send_checkpoint(
         self, dst_ranks: "List[int]", step: int, state_dict: Any, timeout: float
     ) -> None:
-        from torchft_tpu.checkpointing.serialization import _flatten
+        from torchft_tpu.checkpointing.serialization import _flatten, _leaf_meta
 
         skeleton, leaves = _flatten(state_dict)
         metas = []
         arrays: List[Optional[np.ndarray]] = []
         for leaf in leaves:
-            if hasattr(leaf, "__array__"):
-                arr = np.asarray(leaf)
-                # shape recorded before ascontiguousarray (it promotes 0-d
-                # arrays to (1,), corrupting pytree leaf shapes on receive)
-                metas.append({"kind": "array", "shape": arr.shape, "dtype": str(arr.dtype)})
-                arrays.append(np.ascontiguousarray(arr))
-            else:
-                metas.append({"kind": "object", "value": leaf})
-                arrays.append(None)
+            meta, arr = _leaf_meta(leaf)
+            metas.append(meta)
+            arrays.append(arr)
         header = np.frombuffer(
             pickle.dumps({"step": step, "skeleton": skeleton, "leaves": metas}),
             dtype=np.uint8,
@@ -77,7 +71,7 @@ class PGTransport(CheckpointTransport[Any]):
             for i, arr in enumerate(arrays):
                 if arr is not None:
                     self._pg.send(
-                        arr.view(np.uint8).reshape(-1), dst, tag=_TENSOR_TAG + i
+                        arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
                     ).wait(timeout=timeout)
 
     def recv_checkpoint(

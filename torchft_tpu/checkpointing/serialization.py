@@ -76,7 +76,10 @@ def prepare(
         out.write(header)
         for buf in buffers:
             if buf is not None:
-                out.write(memoryview(buf).cast("B"))
+                # uint8 view, not memoryview.cast: ml_dtypes (bfloat16, fp8 —
+                # the TPU training dtypes) have no buffer-protocol format
+                # char and would raise in cast("B").
+                out.write(buf.reshape(-1).view(np.uint8))
 
     return total, writer
 

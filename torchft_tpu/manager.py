@@ -64,6 +64,12 @@ def _to_sec(t: "float | timedelta | None", default: float) -> float:
     return float(t)
 
 
+def _is_floating(dtype: Any) -> bool:
+    """True for float dtypes incl. ml_dtypes (bfloat16/fp8 — the TPU training
+    dtypes), which np.issubdtype does not classify as np.floating."""
+    return jax.numpy.issubdtype(dtype, jax.numpy.floating)
+
+
 class WorldSizeMode(Enum):
     """How the quorum world size behaves (reference manager.py:112-127).
 
@@ -460,7 +466,7 @@ class Manager:
             np_leaves = [np.zeros_like(x) for x in np_leaves]
 
         if reduce_op == REDUCE_AVG:
-            if not all(np.issubdtype(x.dtype, np.floating) for x in np_leaves):
+            if not all(_is_floating(x.dtype) for x in np_leaves):
                 raise ValueError(
                     "average reduce op is only supported for floating point arrays"
                 )

@@ -17,6 +17,8 @@ from typing import Any, List
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import (
     ProcessGroup,
@@ -54,7 +56,7 @@ def allreduce_quantized(
         raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
     np_arrays = [np.asarray(a) for a in arrays]
     for a in np_arrays:
-        if not np.issubdtype(a.dtype, np.floating):
+        if not jnp.issubdtype(a.dtype, jnp.floating):
             raise ValueError("quantized allreduce requires floating point arrays")
 
     world = pg.size()
@@ -144,7 +146,7 @@ def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
     np_array = np.asarray(array)
-    if not np.issubdtype(np_array.dtype, np.floating):
+    if not jnp.issubdtype(np_array.dtype, jnp.floating):
         raise ValueError("quantized reduce_scatter requires floating point arrays")
     world = pg.size()
     if world <= 1:
@@ -168,10 +170,11 @@ def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
     out_shape = (my_rows,) + np_array.shape[1:]
 
     def _finish(received: "List[np.ndarray]") -> np.ndarray:
-        reduced = q.reduce_quantized(received, my_rows, cols, average_by=divisor)
-        scales, payload = q.unpack(reduced, my_rows, cols)
-        return q.dequantize(scales, payload, (my_rows, cols), np.float32).reshape(
-            out_shape
+        # raw f32 result: the reduced slice stays local, so requantizing
+        # (needed in allreduce for the allgather hop) would only add error
+        acc = q.reduce_quantized(
+            received, my_rows, cols, average_by=divisor, requantize=False
         )
+        return acc.reshape(out_shape)
 
     return pg.alltoall(send_bufs).then(_finish)

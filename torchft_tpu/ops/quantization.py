@@ -65,13 +65,19 @@ def unpack(buf: np.ndarray, rows: int, cols: int) -> Tuple[np.ndarray, np.ndarra
 
 
 def reduce_quantized(
-    bufs: "List[np.ndarray]", rows: int, cols: int, average_by: int = 0
+    bufs: "List[np.ndarray]",
+    rows: int,
+    cols: int,
+    average_by: int = 0,
+    requantize: bool = True,
 ) -> np.ndarray:
     """Dequantize each packed buffer, accumulate in f32, requantize.
 
     Analog of the reference's fused dequant-accumulate-requant kernel
     (reference quantization.py:262-430). ``average_by > 0`` divides the
-    accumulated sum (AVG fusion).
+    accumulated sum (AVG fusion). ``requantize=False`` returns the raw f32
+    accumulator (for results that stay local rather than going back on the
+    wire).
     """
     acc = np.zeros((rows, cols), dtype=np.float32)
     for buf in bufs:
@@ -79,4 +85,6 @@ def reduce_quantized(
         acc += payload.astype(np.float32) * scales[:, None]
     if average_by > 0:
         acc /= average_by
+    if not requantize:
+        return acc
     return pack(*quantize(acc))
