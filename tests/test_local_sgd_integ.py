@@ -5,6 +5,7 @@ DiLoCo recovery, and a third replica joining mid-run (upscale).
 """
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List
 
@@ -106,15 +107,11 @@ class DiLoCoRunner:
             target_steps = self.outer_syncs * (
                 self.n_fragments if self.algo == "diloco" else 1
             )
-            inner = 0
             while manager.current_step() < target_steps:
                 self.injector.check(self.replica_id, manager.current_step(), None)
                 if self.inner_sleep:
-                    import time
-
                     time.sleep(self.inner_sleep)
                 # deterministic inner update (same on all replicas)
-                inner += 1
                 p = get_params()
                 set_params(
                     {k: v - 0.01 * (1.0 + i) for i, (k, v) in enumerate(sorted(p.items()))}
@@ -201,8 +198,6 @@ class TestDiLoCoInteg:
 
         def run_delayed(idx, delay):
             if delay:
-                import time
-
                 time.sleep(delay)
             results[idx] = runners[idx].run()
 

@@ -114,6 +114,15 @@ class TestManagedDeviceMesh:
         mesh = ft_init_device_mesh(manager, {"fsdp": 8}, devices=jax.devices())
         assert mesh.shape()["dp_replicate"] == 1
 
+    def test_non_participating_gets_empty_batch_slice(self):
+        """A healing replica must not silently train on rank 0's data."""
+        manager = mock_manager()
+        manager.num_participants.return_value = 3
+        manager.participating_rank.return_value = None
+        manager.is_participating.return_value = False
+        mesh = ft_init_device_mesh(manager, {"fsdp": 8}, devices=jax.devices())
+        assert mesh.global_batch_slice(12) == (0, 0)
+
     def test_device_count_mismatch(self):
         manager = mock_manager()
         with pytest.raises(ValueError, match="devices"):

@@ -197,6 +197,12 @@ class _Fragment:
             self._manager.allreduce(pseudograds, should_quantize=self._should_quantize)
         )
 
+    def discard_pending_work(self) -> None:
+        """Drop any queued allreduce work (error-path cleanup so the next
+        prepare_sync's not-already-pending assert holds)."""
+        self._allreduce_work.clear()
+        self._local_parameters = None
+
     def perform_sync(self) -> bool:
         """Wait for the allreduce, vote, and outer-step on success
         (reference :423-476)."""
@@ -348,8 +354,16 @@ class DiLoCo:
                 self._local_step,
                 self._manager.current_step(),
             )
-            self._fragments[fragment].perform_sync()
+            # Reset before the fallible sync (like LocalSGD.sync): if
+            # perform_sync raises (e.g. allreduce wait timeout), a caller
+            # that catches per-step errors and keeps stepping must start a
+            # fresh cycle, not hit the exceeded-cycle assert below forever.
             self._local_step = 0
+            try:
+                self._fragments[fragment].perform_sync()
+            except Exception:
+                self._fragments[fragment].discard_pending_work()
+                raise
             return
         raise AssertionError(
             f"local_step {self._local_step} exceeded cycle {self._cycle}"

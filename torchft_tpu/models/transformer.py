@@ -1,0 +1,327 @@
+"""Flagship model: a llama-style decoder-only transformer, TPU-first.
+
+The reference's examples train a toy CNN/MLP (train_ddp.py:84-102,
+train_diloco.py:76-120) and its reference-scale config is Llama3-8B via
+torchtitan (torchft/examples/slurm/runner.py:16-49).  This module is that
+model family built natively: pure-functional JAX (params are a pytree),
+bfloat16 compute with fp32 master params, RMSNorm + rotary embeddings + GQA
++ SwiGLU, layers stacked and iterated with `lax.scan` (one trace per block,
+fast compiles at depth), optional `jax.checkpoint` rematerialization, and a
+4-D parallelism story expressed as `PartitionSpec`s:
+
+- ``dp``   data-parallel replicas *within* a slice (pure batch dim),
+- ``fsdp`` fully-sharded data parallel (params sharded over it, batch too),
+- ``tp``   tensor parallel (attention heads / MLP hidden),
+- ``cp``   context parallel (sequence; ring attention over this axis).
+
+The elastic FT replica dimension deliberately does NOT appear here: it lives
+above jit in the Manager (zero-fill + divide-by-participants keeps compiled
+shapes static across quorum changes — SURVEY §7, reference manager.py:416).
+
+Weights layout keeps matmuls [*, E] x [E, F] shaped for the MXU; all
+reductions accumulate in fp32 (`preferred_element_type`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.ops.ring_attention import dense_attention, ring_attention_local
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    n_layers: int = 6
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # "dense" = single-pass attention (cp must be 1 / unsharded seq);
+    # "ring"  = ring attention, sequence sharded over `cp_axis`.
+    attn_impl: str = "dense"
+    dp_axis: str = "dp"
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+    cp_axis: str = "cp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Initialize the parameter pytree. Per-layer weights are stacked on a
+    leading [n_layers] dim so the forward can `lax.scan` over blocks."""
+    e, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    pd = cfg.param_dtype
+    keys = jax.random.split(rng, 8)
+
+    def dense(key, *shape):
+        fan_in = shape[-2]
+        return (jax.random.normal(key, shape, pd) / np.sqrt(fan_in)).astype(pd)
+
+    return {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, e), pd) * 0.02,
+        "blocks": {
+            "attn_norm": jnp.ones((l, e), pd),
+            "wq": dense(keys[1], l, e, nh * hd),
+            "wk": dense(keys[2], l, e, nkv * hd),
+            "wv": dense(keys[3], l, e, nkv * hd),
+            "wo": dense(keys[4], l, nh * hd, e),
+            "mlp_norm": jnp.ones((l, e), pd),
+            "w_gate": dense(keys[5], l, e, f),
+            "w_up": dense(keys[6], l, e, f),
+            "w_down": dense(keys[7], l, f, e),
+        },
+        "final_norm": jnp.ones((e,), pd),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpecs matching init_params' tree: 2-D weights sharded
+    (fsdp x tp); the stacked layer dim stays unsharded so `lax.scan` slices
+    locally."""
+    fs, tp = cfg.fsdp_axis, cfg.tp_axis
+    return {
+        "embed": P(tp, fs),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fs, tp),
+            "wk": P(None, fs, tp),
+            "wv": P(None, fs, tp),
+            "wo": P(None, tp, fs),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fs, tp),
+            "w_up": P(None, fs, tp),
+            "w_down": P(None, tp, fs),
+        },
+        "final_norm": P(None),
+    }
+
+
+def batch_spec(cfg: TransformerConfig) -> P:
+    """Tokens [B, T]: batch over (dp, fsdp), sequence over cp."""
+    return P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis)
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        param_specs(cfg),
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jax.Array, w: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x [B, T, H, D], positions [T] (global)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
+    """Returns block(x, layer_params, positions) -> x for one decoder layer."""
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    act = cfg.dtype
+
+    def attention(q, k, v):
+        if cfg.attn_impl == "ring":
+            if mesh is None:
+                raise ValueError("ring attention requires a mesh")
+            spec = P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, cfg.tp_axis, None)
+            fn = jax.shard_map(
+                lambda q_, k_, v_: ring_attention_local(
+                    q_, k_, v_, axis_name=cfg.cp_axis, causal=True
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )
+            return fn(q, k, v)
+        return dense_attention(q, k, v, causal=True)
+
+    def block(x: jax.Array, p: Params, positions: jax.Array) -> jax.Array:
+        b, t, e = x.shape
+        h = _rms_norm(x, p["attn_norm"])
+        q = (h @ p["wq"].astype(act)).reshape(b, t, nh, hd)
+        k = (h @ p["wk"].astype(act)).reshape(b, t, nkv, hd)
+        v = (h @ p["wv"].astype(act)).reshape(b, t, nkv, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if nkv != nh:  # GQA: broadcast kv heads up to query heads
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = attention(q, k, v).reshape(b, t, nh * hd)
+        x = x + attn @ p["wo"].astype(act)
+
+        h = _rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(h @ p["w_gate"].astype(act))
+        up = h @ p["w_up"].astype(act)
+        x = x + (gate * up) @ p["w_down"].astype(act)
+        return x
+
+    return block
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: "Optional[Mesh]" = None,
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (fp32).
+
+    With a mesh, activations get sharding constraints so XLA places the tp
+    collectives; without one it is a plain single-device program (the
+    `entry()` compile-check path).
+    """
+    b, t = tokens.shape
+    act = cfg.dtype
+    if mesh is not None:
+        # One-hot matmul instead of gather: runs on the MXU and partitions
+        # cleanly when embed is sharded (tp, fsdp) — XLA's SPMD partitioner
+        # fully rematerializes a sharded gather.
+        x = jnp.einsum(
+            "btv,ve->bte",
+            jax.nn.one_hot(tokens, cfg.vocab_size, dtype=act),
+            params["embed"].astype(act),
+        )
+    else:
+        x = params["embed"].astype(act)[tokens]
+    positions = jnp.arange(t)
+
+    if mesh is not None:
+        act_spec = NamedSharding(
+            mesh, P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, None)
+        )
+        x = jax.lax.with_sharding_constraint(x, act_spec)
+
+    block = _make_block(cfg, mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer_params):
+        x = block(x, layer_params, positions)
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(x, act_spec)
+        return x, None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _rms_norm(x, params["final_norm"])
+    # Tied output head: [B,T,E] x [E,V] on the MXU, fp32 logits.
+    logits = jnp.einsum(
+        "bte,ve->btv",
+        x.astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: "Optional[Mesh]" = None,
+) -> jax.Array:
+    """Next-token cross-entropy, mean over all positions but the last."""
+    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# training step
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    optimizer: Any,
+    mesh: "Optional[Mesh]" = None,
+    donate: bool = True,
+):
+    """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
+    full training step (fwd + bwd + optax update). With a mesh, in/out
+    shardings pin params to `param_specs` and the batch to `batch_spec`."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    pspecs = param_specs(cfg)
+    param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
+    batch_sh = NamedSharding(mesh, batch_spec(cfg))
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, None, batch_sh),
+        out_shardings=(param_sh, None, None),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_grad_step(
+    cfg: TransformerConfig, mesh: "Optional[Mesh]" = None
+):
+    """Build a jitted (params, tokens) -> (loss, grads) step — the FT-DDP
+    shape: grads come back to the host, `Manager.allreduce` averages them
+    across replica groups over DCN, then `apply_updates` runs (reference
+    ddp.py:47-79 comm-hook factored the same way)."""
+
+    def step(params, tokens):
+        return jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+
+    if mesh is None:
+        return jax.jit(step)
+    pspecs = param_specs(cfg)
+    param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
+    batch_sh = NamedSharding(mesh, batch_spec(cfg))
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, batch_sh),
+        out_shardings=(None, param_sh),
+    )

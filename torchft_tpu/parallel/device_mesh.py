@@ -75,9 +75,15 @@ class ManagedDeviceMesh:
 
     def global_batch_slice(self, global_batch_size: int) -> "Tuple[int, int]":
         """This replica's contiguous [start, end) share of the global batch,
-        given the live quorum (DistributedSampler analog at batch level)."""
+        given the live quorum (DistributedSampler analog at batch level).
+
+        Returns the empty slice (0, 0) while not participating (healing /
+        no quorum yet) — defaulting to rank 0's slice would silently train
+        on another replica's data."""
+        rank = self.replica_rank()
+        if rank is None or not self.is_participating():
+            return 0, 0
         n = max(self.num_participants(), 1)
-        rank = self.replica_rank() or 0
         per, rem = divmod(global_batch_size, n)
         # first `rem` ranks take one extra example so every example in the
         # global batch is assigned under any elastic membership
