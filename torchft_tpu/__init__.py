@@ -8,7 +8,40 @@ adapters (FT-DDP, LocalSGD, DiLoCo) — designed JAX-first: inner parallelism
 (FSDP/TP/SP within a slice) is pjit sharding over ICI and stays static; the
 elastic replica dimension lives above jit so membership changes never re-jit.
 
-Public API surface mirrors reference torchft/__init__.py:7-34.
+Public API surface mirrors reference torchft/__init__.py:7-34: the Manager,
+the Optimizer wrapper, FT-DDP, the elastic data sampler, and the concrete
+ProcessGroup backends are importable from the package root.
 """
+
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.ddp import DistributedDataParallel, PureDistributedDataParallel
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.parallel.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    ProcessGroup,
+    ProcessGroupDummy,
+    ProcessGroupTCP,
+)
+
+# Reference name: torchft.Optimizer (torchft/optim.py re-exported at root).
+Optimizer = OptimizerWrapper
+
+__all__ = [
+    "DiLoCo",
+    "DistributedDataParallel",
+    "DistributedSampler",
+    "ErrorSwallowingProcessGroupWrapper",
+    "LocalSGD",
+    "Manager",
+    "Optimizer",
+    "OptimizerWrapper",
+    "ProcessGroup",
+    "ProcessGroupDummy",
+    "ProcessGroupTCP",
+    "PureDistributedDataParallel",
+    "WorldSizeMode",
+]
 
 __version__ = "0.1.0"
