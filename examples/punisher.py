@@ -1,0 +1,89 @@
+"""Chaos CLI: kill replicas through the Lighthouse to exercise recovery
+(reference: torchft/examples/slurm/punisher.py:15-46).
+
+The reference cancels SLURM jobs through torchx; here replicas are killed
+through the Lighthouse's own kill endpoint (``POST /replica/{id}/kill``,
+forwarded as a ManagerService.Kill RPC — same path as the dashboard's kill
+button), which works for any deployment the Lighthouse can reach.
+
+    python examples/punisher.py --lighthouse host:port kill-one
+    python examples/punisher.py --lighthouse host:port kill-all
+    python examples/punisher.py --lighthouse host:port kill-loop \
+        --num-failures 5 --mtbf-secs 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+import urllib.request
+
+
+def list_replicas(lighthouse: str, max_age_ms: int = 5000) -> "list[str]":
+    """Replica ids with a live heartbeat (restarted replicas re-register
+    under a fresh uuid suffix, so stale ids must be filtered by age)."""
+    with urllib.request.urlopen(
+        f"http://{lighthouse}/status.json", timeout=10
+    ) as resp:
+        status = json.load(resp)
+    return [
+        m["replica_id"]
+        for m in status.get("heartbeats", [])
+        if m.get("age_ms", 0) < max_age_ms
+    ]
+
+
+def kill(lighthouse: str, replica_id: str) -> None:
+    req = urllib.request.Request(
+        f"http://{lighthouse}/replica/{replica_id}/kill", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        print(f"killed {replica_id}: {resp.read().decode().strip()}")
+
+
+def kill_one(lighthouse: str, spare_first: bool = True) -> None:
+    replicas = list_replicas(lighthouse)
+    # keep replica 0 alive by convention (reference spares "ft_0") so the
+    # job always has a healthy recovery source
+    candidates = [r for r in replicas if not spare_first or not r.startswith(
+        ("replica_0", "train_ddp_0", "train_diloco_0"))]
+    if not candidates:
+        sys.exit(f"no killable replicas (live: {replicas})")
+    choice = random.choice(candidates)
+    print(f"killing {choice!r} of {candidates}")
+    kill(lighthouse, choice)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--lighthouse", required=True, help="host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list")
+    sub.add_parser("kill-one")
+    sub.add_parser("kill-all")
+    loop = sub.add_parser("kill-loop")
+    loop.add_argument("--num-failures", type=int, default=3)
+    loop.add_argument("--mtbf-secs", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    if args.cmd == "list":
+        for r in list_replicas(args.lighthouse):
+            print(r)
+    elif args.cmd == "kill-one":
+        kill_one(args.lighthouse)
+    elif args.cmd == "kill-all":
+        for r in list_replicas(args.lighthouse):
+            kill(args.lighthouse, r)
+    elif args.cmd == "kill-loop":
+        for _ in range(args.num_failures):
+            kill_one(args.lighthouse)
+            dur = random.random() * (2 * args.mtbf_secs)
+            print(f"sleeping {dur:.1f}s (mtbf {args.mtbf_secs}s)")
+            time.sleep(dur)
+
+
+if __name__ == "__main__":
+    main()

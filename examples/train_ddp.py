@@ -1,0 +1,170 @@
+"""Fault-tolerant DDP training example (reference: train_ddp.py:104-213).
+
+One process = one replica group (TPU slice or CPU worker). Point every
+replica at the same Lighthouse and they form an elastic quorum: kill any
+replica mid-run and the rest keep training; restart it and it live-heals
+its weights from a healthy peer — no full-job restart.
+
+Single-machine demo (threads-as-replicas + in-process Lighthouse):
+
+    python examples/train_ddp.py --local-replicas 2 --steps 50
+
+Note: kill-based chaos testing (dashboard kill button, punisher.py) needs
+the one-process-per-replica deployment below — a kill RPC exits the whole
+process, so in demo mode it would take down every thread-replica at once.
+
+Real deployment (one process per slice):
+
+    TORCHFT_LIGHTHOUSE=host:port REPLICA_GROUP_ID=0 python examples/train_ddp.py
+    TORCHFT_LIGHTHOUSE=host:port REPLICA_GROUP_ID=1 python examples/train_ddp.py
+
+The model is the reference's CIFAR-shaped CNN on synthetic data (this
+image has no dataset egress); swap in a real dataloader + the
+DistributedSampler shard for production.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=100, help="committed steps to train")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--sync-quorum", action="store_true",
+                   help="synchronous quorum (default overlaps with forward)")
+    p.add_argument("--local-replicas", type=int, default=0,
+                   help="demo mode: run N replica-group threads + a local Lighthouse")
+    p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax profiler trace here (Perfetto-compatible)")
+    return p.parse_args(argv)
+
+
+def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchft_tpu as ft
+    from torchft_tpu.models import cnn
+
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    state = {"params": params, "opt_state": None}
+
+    manager = ft.Manager(
+        pg=ft.ProcessGroupTCP(timeout=30.0),
+        min_replica_size=args.min_replicas,
+        load_state_dict=lambda sd: state.update(sd),
+        state_dict=lambda: {"params": state["params"],
+                            "opt_state": state["opt_state"]},
+        replica_id=replica_id,
+        lighthouse_addr=lighthouse_addr,
+        group_rank=0,
+        group_world_size=1,
+        use_async_quorum=not args.sync_quorum,
+        timeout=30.0,
+    )
+    ddp = ft.DistributedDataParallel(manager)
+    optimizer = ft.Optimizer(manager, optax.adamw(args.lr))
+    state["opt_state"] = optimizer.init(params)
+
+    def loss_fn(params, images, labels):
+        logits = cnn.forward(params, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    rng = np.random.default_rng(hash(replica_id) % 2**31)
+
+    try:
+        while manager.current_step() < args.steps:
+            # synthetic CIFAR-shaped batch; each replica sees its own data
+            images = jnp.asarray(
+                rng.standard_normal((args.batch_size, 32, 32, 3), dtype=np.float32)
+            )
+            labels = jnp.asarray(rng.integers(0, 10, args.batch_size))
+
+            # must be called at the start of each step: triggers the quorum
+            # (overlapped with forward unless --sync-quorum)
+            optimizer.begin_step()
+
+            loss, grads = grad_fn(state["params"], images, labels)
+            # gradient averaging over the live quorum (zero-contribution
+            # participation: membership changes never change compiled shapes)
+            avg_grads = ddp.allreduce_gradients(grads).wait(timeout=30)
+
+            # applies the update only if the group votes to commit
+            state["params"], state["opt_state"], committed = optimizer.step(
+                state["params"], avg_grads, state["opt_state"]
+            )
+            if committed and manager.current_step() % 10 == 0:
+                log(f"[{replica_id} step {manager.current_step()}] "
+                    f"loss={float(loss):.4f} "
+                    f"participants={manager.num_participants()}")
+        return {"params": state["params"], "step": manager.current_step()}
+    finally:
+        manager.shutdown()
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
+    try:
+        if args.local_replicas:
+            from torchft_tpu.coordination import LighthouseServer
+
+            lighthouse = LighthouseServer(
+                min_replicas=args.min_replicas, join_timeout_ms=200
+            )
+            print(f"lighthouse dashboard: http://{lighthouse.address()}/")
+            threads = [
+                threading.Thread(
+                    target=train,
+                    args=(f"train_ddp_{i}", lighthouse.address(), args),
+                    daemon=True,
+                )
+                for i in range(args.local_replicas)
+            ]
+            try:
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            finally:
+                lighthouse.shutdown()
+        else:
+            lighthouse_addr = os.environ.get("TORCHFT_LIGHTHOUSE")
+            if not lighthouse_addr:
+                raise SystemExit(
+                    "set TORCHFT_LIGHTHOUSE=host:port (or use --local-replicas N)"
+                )
+            replica_id = f"train_ddp_{os.environ.get('REPLICA_GROUP_ID', 0)}"
+            result = train(replica_id, lighthouse_addr, args)
+            print(f"done: {result['step']} committed steps")
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {args.profile_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -335,7 +335,42 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
     http_reply(fd, 200, "text/html", render_status_html());
     return;
   }
+  if (method == "GET" && path == "/status.json") {
+    http_reply(fd, 200, "application/json", render_status_json());
+    return;
+  }
   http_reply(fd, 404, "text/plain", "not found\n");
+}
+
+std::string LighthouseServer::render_status_json() {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  Json out = Json::object();
+  out["quorum_id"] = quorum_id_;
+  out["status"] = last_reason_;
+  Json hbs = Json::array();
+  for (const auto& [rid, ts] : heartbeats_) {
+    Json h = Json::object();
+    h["replica_id"] = rid;
+    h["age_ms"] = now - ts;
+    hbs.push_back(h);
+  }
+  out["heartbeats"] = hbs;
+  if (prev_quorum_.has_value()) {
+    Json q = Json::object();
+    q["quorum_id"] = prev_quorum_->quorum_id;
+    Json parts = Json::array();
+    for (const auto& p : prev_quorum_->participants) {
+      Json m = Json::object();
+      m["replica_id"] = p.replica_id;
+      m["address"] = p.address;
+      m["step"] = p.step;
+      parts.push_back(m);
+    }
+    q["participants"] = parts;
+    out["prev_quorum"] = q;
+  }
+  return out.dump();
 }
 
 std::string LighthouseServer::render_status_html() {

@@ -98,6 +98,7 @@ class LighthouseServer : public RpcServer {
   Json rpc_quorum(const Json& params, int64_t timeout_ms);
   Json rpc_heartbeat(const Json& params);
   std::string render_status_html();
+  std::string render_status_json();
 
   LighthouseOpt opt_;
 

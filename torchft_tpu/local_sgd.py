@@ -161,7 +161,7 @@ class _Fragment:
 
     def restore_parameters(self) -> None:
         self._write_fragment(
-            {k: np.array(v) for k, v in self.original_parameters.items()}
+            jax.tree_util.tree_map(np.array, self.original_parameters)
         )
 
     def register_state_dict_fn(self) -> None:
@@ -169,16 +169,16 @@ class _Fragment:
         key = f"StreamingDiLoCoFragment_{self._fragment_id}"
 
         def load_fn(sd: "Dict[str, Any]") -> None:
-            self.original_parameters = {
-                k: np.array(v) for k, v in sd["original_parameters"].items()
-            }
+            self.original_parameters = jax.tree_util.tree_map(
+                np.array, sd["original_parameters"]
+            )
             self._outer_state = sd["outer_optimizer"]
 
         def save_fn() -> "Dict[str, Any]":
             return {
-                "original_parameters": {
-                    k: np.array(v) for k, v in self.original_parameters.items()
-                },
+                "original_parameters": jax.tree_util.tree_map(
+                    np.array, self.original_parameters
+                ),
                 "outer_optimizer": self._outer_state,
             }
 
@@ -188,10 +188,11 @@ class _Fragment:
         """Pseudograds = backup - local; kick off the async allreduce
         (reference :402-421)."""
         local = _to_host(self._fragment_params())
-        pseudograds = {
-            k: self.original_parameters[k].astype(np.float32) - local[k].astype(np.float32)
-            for k in self._keys
-        }
+        pseudograds = jax.tree_util.tree_map(
+            lambda g, l: g.astype(np.float32) - l.astype(np.float32),
+            self.original_parameters,
+            local,
+        )
         assert not self._allreduce_work
         self._allreduce_work.append(
             self._manager.allreduce(pseudograds, should_quantize=self._should_quantize)
@@ -219,31 +220,32 @@ class _Fragment:
         if should_commit:
             # outer update on the backup params; optax's sgd(+momentum,
             # nesterov) is the reference's default outer optimizer
-            grads = {
-                k: np.asarray(avg_pseudograds[k], dtype=np.float32)
-                for k in self._keys
-            }
+            tm = jax.tree_util.tree_map
+            grads = tm(lambda v: np.asarray(v, dtype=np.float32), avg_pseudograds)
             updates, self._outer_state = self._outer.update(
                 grads, self._outer_state, self.original_parameters
             )
             new_global = optax.apply_updates(
-                {k: v.astype(np.float32) for k, v in self.original_parameters.items()},
+                tm(lambda v: v.astype(np.float32), self.original_parameters),
                 updates,
             )
-            new_global = {
-                k: np.asarray(v, dtype=self.original_parameters[k].dtype)
-                for k, v in new_global.items()
-            }
+            new_global = tm(
+                lambda v, o: np.asarray(v, dtype=o.dtype),
+                new_global,
+                self.original_parameters,
+            )
             self.original_parameters = new_global
             # merge: params = (1-alpha) * global + alpha * local
-            merged = {
-                k: (1.0 - self._alpha) * new_global[k].astype(np.float32)
-                + self._alpha * self._local_parameters[k].astype(np.float32)
-                for k in self._keys
-            }
-            self._write_fragment(
-                {k: merged[k].astype(new_global[k].dtype) for k in self._keys}
+            merged = tm(
+                lambda g, l: np.asarray(
+                    (1.0 - self._alpha) * g.astype(np.float32)
+                    + self._alpha * l.astype(np.float32),
+                    dtype=g.dtype,
+                ),
+                new_global,
+                self._local_parameters,
             )
+            self._write_fragment(merged)
         self._local_parameters = None
         return should_commit
 
