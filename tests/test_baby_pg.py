@@ -1,0 +1,182 @@
+"""Subprocess-isolated ("Baby") process groups + monitored pipe.
+
+Mirrors the reference's Baby-PG tests (reference:
+torchft/process_group_test.py:910-1020 and multiprocessing tests): ops run
+in a spawned worker, worker crash surfaces as a clean error in the parent,
+reconfigure restarts the worker, and the parent process always survives.
+"""
+
+import multiprocessing as mp
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import StoreServer
+from torchft_tpu.multiprocessing import _MonitoredPipe
+from torchft_tpu.parallel.process_group import ProcessGroupBabyTCP
+
+
+@pytest.fixture
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def _configure_pair(store, prefix, timeout=30.0):
+    pgs = [ProcessGroupBabyTCP(timeout=timeout) for _ in range(2)]
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [
+            ex.submit(
+                pgs[r].configure, f"{store.address()}/{prefix}", f"rank{r}", r, 2
+            )
+            for r in range(2)
+        ]
+        for f in futs:
+            f.result(timeout=60)
+    return pgs
+
+
+class TestMonitoredPipe:
+    def test_roundtrip_and_timeout(self):
+        a, b = mp.Pipe()
+        pa, pb = _MonitoredPipe(a), _MonitoredPipe(b)
+        pa.send({"x": 1})
+        assert pb.recv(timeout=5) == {"x": 1}
+        with pytest.raises(TimeoutError):
+            pb.recv(timeout=0.2)
+
+    def test_exception_passthrough(self):
+        a, b = mp.Pipe()
+        pa, pb = _MonitoredPipe(a), _MonitoredPipe(b)
+        pa.send(ValueError("shipped"))
+        with pytest.raises(ValueError, match="shipped"):
+            pb.recv(timeout=5)
+
+    def test_eof_on_close(self):
+        a, b = mp.Pipe()
+        pa, pb = _MonitoredPipe(a), _MonitoredPipe(b)
+        pa.close()
+        with pytest.raises(EOFError):
+            pb.recv(timeout=5)
+
+
+class TestProcessGroupBabyTCP:
+    def test_configure_failure_propagates_root_cause(self):
+        pg = ProcessGroupBabyTCP(timeout=10.0)
+        # unreachable store: the worker's configure error must surface in
+        # the parent with the real cause, not a generic protocol error
+        with pytest.raises(Exception) as exc_info:
+            pg.configure("127.0.0.1:1/none", "rank0", 0, 2)
+        assert not isinstance(exc_info.value, AssertionError)
+        pg.shutdown()
+
+    def test_allreduce_and_broadcast(self, store):
+        pgs = _configure_pair(store, "baby1")
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r]
+                        .allreduce([np.full(4, float(r + 1), np.float32)])
+                        .wait(timeout=30),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                results = [f.result(timeout=60) for f in futs]
+            for res in results:
+                np.testing.assert_array_equal(res[0], np.full(4, 3.0, np.float32))
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r]
+                        .broadcast(
+                            np.arange(4, dtype=np.float32) if r == 0 else np.zeros(4, np.float32),
+                            root=0,
+                        )
+                        .wait(timeout=30),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                results = [f.result(timeout=60) for f in futs]
+            for res in results:
+                np.testing.assert_array_equal(res, np.arange(4, dtype=np.float32))
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_worker_crash_is_isolated(self, store):
+        pgs = _configure_pair(store, "baby2")
+        try:
+            # kill rank 1's worker out from under it — the parent must see a
+            # clean error on both sides (peer detects the dropped socket)
+            pgs[1]._proc.kill()
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r]
+                        .allreduce([np.zeros(2, np.float32)])
+                        .wait(timeout=30),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                errs = 0
+                for f in futs:
+                    try:
+                        f.result(timeout=60)
+                    except Exception:
+                        errs += 1
+            assert errs == 2
+            assert pgs[1].errored() is not None
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_reconfigure_after_abort(self, store):
+        pgs = _configure_pair(store, "baby3")
+        try:
+            for pg in pgs:
+                pg.abort()
+            assert all(pg.errored() is not None for pg in pgs)
+            # ops fail fast while aborted
+            with pytest.raises(Exception):
+                pgs[0].allreduce([np.zeros(1)]).wait(timeout=5)
+
+            # reconfigure restarts workers and clears the error
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        pgs[r].configure,
+                        f"{store.address()}/baby3b",
+                        f"rank{r}",
+                        r,
+                        2,
+                    )
+                    for r in range(2)
+                ]
+                for f in futs:
+                    f.result(timeout=60)
+            assert all(pg.errored() is None for pg in pgs)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r]
+                        .allreduce([np.ones(2, np.float32)])
+                        .wait(timeout=30),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                for f in futs:
+                    np.testing.assert_array_equal(
+                        f.result(timeout=60)[0], np.full(2, 2.0, np.float32)
+                    )
+        finally:
+            for pg in pgs:
+                pg.shutdown()

@@ -1,0 +1,87 @@
+"""ParameterServer prototype: session mint + 2-rank PG serving.
+
+Mirrors the reference's parameter-server semantics
+(reference: torchft/parameter_server.py): GET /new_session returns a
+store prefix, server thread serves rank 0, client configures rank 1.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parallel.process_group import ProcessGroup, ProcessGroupTCP
+from torchft_tpu.parameter_server import ParameterServer
+
+
+class _EchoPS(ParameterServer):
+    """Serves one allreduce then one broadcast of stored params per session."""
+
+    params = np.arange(8, dtype=np.float32)
+    sessions_served = 0
+    session_error = None
+
+    @classmethod
+    def new_process_group(cls) -> ProcessGroup:
+        return ProcessGroupTCP(timeout=20.0)
+
+    def forward(self, session_id: str, pg: ProcessGroup) -> None:
+        try:
+            got = pg.allreduce([np.ones(4, np.float32)]).wait(timeout=20)
+            np.testing.assert_array_equal(got[0], np.full(4, 3.0, np.float32))
+            pg.broadcast(self.params, root=0).wait(timeout=20)
+            type(self).sessions_served += 1
+        except Exception as e:  # noqa: BLE001 - surfaced by the test body
+            type(self).session_error = e
+            raise
+
+
+@pytest.fixture
+def ps():
+    server = _EchoPS(port=0)
+    _EchoPS.sessions_served = 0
+    _EchoPS.session_error = None
+    yield server
+    server.shutdown()
+
+
+class TestParameterServer:
+    def test_session_roundtrip(self, ps):
+        pg = _EchoPS.new_session(ps.address())
+        try:
+            got = pg.allreduce([np.full(4, 2.0, np.float32)]).wait(timeout=20)
+            np.testing.assert_array_equal(got[0], np.full(4, 3.0, np.float32))
+            params = pg.broadcast(np.zeros(8, np.float32), root=0).wait(timeout=20)
+            np.testing.assert_array_equal(params, _EchoPS.params)
+        finally:
+            pg.shutdown()
+        assert _EchoPS.session_error is None
+
+    def test_multiple_sequential_sessions(self, ps):
+        for _ in range(2):
+            pg = _EchoPS.new_session(ps.address())
+            try:
+                pg.allreduce([np.full(4, 2.0, np.float32)]).wait(timeout=20)
+                pg.broadcast(np.zeros(8, np.float32), root=0).wait(timeout=20)
+            finally:
+                pg.shutdown()
+        # server threads finish asynchronously after the client's last op
+        done = threading.Event()
+
+        def _poll():
+            while _EchoPS.sessions_served < 2:
+                if done.wait(0.05):
+                    return
+            done.set()
+
+        t = threading.Thread(target=_poll, daemon=True)
+        t.start()
+        assert done.wait(10), "server sessions did not complete"
+
+    def test_bad_path_rejected(self, ps):
+        import urllib.error
+        import urllib.request
+
+        bad = ps.address().replace("/new_session", "/nope")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad)

@@ -301,6 +301,44 @@ class TestWrappers:
         assert pg.size() == 1
         assert pg.parent is inner
 
+    def test_managed_forwards_allreduce_to_manager(self):
+        from unittest.mock import MagicMock
+
+        from torchft_tpu.parallel.process_group import ManagedProcessGroup
+        from torchft_tpu.parallel.work import completed_work
+
+        manager = MagicMock()
+        manager.num_participants.return_value = 3
+        manager.participating_rank.return_value = 1
+        manager.errored.return_value = None
+        manager.allreduce.return_value = completed_work([np.array([6.0])])
+
+        pg = ManagedProcessGroup(manager)
+        assert pg.size() == 3
+        assert pg.rank() == 1
+        assert pg.errored() is None
+
+        out = pg.allreduce([np.array([2.0])], op="sum").wait(timeout=5)
+        np.testing.assert_array_equal(out[0], [6.0])
+        manager.allreduce.assert_called_once()
+        assert manager.allreduce.call_args.kwargs["reduce_op"] == "sum"
+
+        # non-allreduce collectives are rejected — the Manager owns quorum
+        with pytest.raises(RuntimeError):
+            pg.broadcast(np.zeros(1)).wait(timeout=5)
+        with pytest.raises(RuntimeError):
+            pg.configure("", "r", 0, 1)
+
+    def test_managed_rank_when_not_participating(self):
+        from unittest.mock import MagicMock
+
+        from torchft_tpu.parallel.process_group import ManagedProcessGroup
+
+        manager = MagicMock()
+        manager.participating_rank.return_value = None
+        pg = ManagedProcessGroup(manager)
+        assert pg.rank() == 0
+
 
 class TestNumerics:
     def test_int32_allreduce_no_overflow(self, store):

@@ -20,7 +20,9 @@ from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.parallel.process_group import (
     ErrorSwallowingProcessGroupWrapper,
+    ManagedProcessGroup,
     ProcessGroup,
+    ProcessGroupBabyTCP,
     ProcessGroupDummy,
     ProcessGroupTCP,
 )
@@ -34,10 +36,12 @@ __all__ = [
     "DistributedSampler",
     "ErrorSwallowingProcessGroupWrapper",
     "LocalSGD",
+    "ManagedProcessGroup",
     "Manager",
     "Optimizer",
     "OptimizerWrapper",
     "ProcessGroup",
+    "ProcessGroupBabyTCP",
     "ProcessGroupDummy",
     "ProcessGroupTCP",
     "PureDistributedDataParallel",

@@ -17,11 +17,15 @@ properties reproduced here (reference §5 semantics):
   owns only the elastic replica dimension, so membership changes never
   trigger re-jit (zero-fill participation keeps compiled shapes static).
 
-Design divergence from the reference, by intent: no subprocess-isolated
-"Baby" variants (no NCCL-context crash mode exists on this path — a failed
-TCP collective cannot poison the XLA runtime), and no fake world-size-1
-backend registration (a torch-DeviceMesh-specific trick; the JAX mesh
-composition lives in torchft_tpu/parallel/device_mesh.py).
+Subprocess isolation: ``ProcessGroupBabyTCP`` runs the real PG in a spawned
+worker process (reference "Baby" variants, torchft/process_group.py:
+1358-2023).  On TPU there is no NCCL-context crash mode to contain, but the
+isolation still buys a *hard* abort — killing the worker cancels a wedged
+collective no matter what state its sockets are in — and shields the
+trainer (and its XLA runtime) from any failure mode of the collective
+stack.  Design divergence from the reference, by intent: no fake
+world-size-1 backend registration (a torch-DeviceMesh-specific trick; the
+JAX mesh composition lives in torchft_tpu/parallel/device_mesh.py).
 """
 
 from __future__ import annotations
@@ -850,3 +854,356 @@ class FakeProcessGroupWrapper(ProcessGroupWrapper):
             exc, self._next_op_error = self._next_op_error, None
             return failed_work(exc)
         return work
+
+
+class ManagedProcessGroup(ProcessGroup):
+    """A ProcessGroup whose allreduce routes through a ``Manager``.
+
+    Reference: torchft/process_group.py:1233-1266 — lets code written
+    against the plain ProcessGroup API (e.g. a gradient-averaging hook or a
+    mesh dimension) transparently get quorum-aware, error-swallowing,
+    participant-count-scaled allreduce.  ``size()`` reports the *live*
+    participant count so loss/gradient scaling stays correct as replicas
+    fail and join; all other collectives and lifecycle calls are invalid on
+    this wrapper — the Manager owns quorum and reconfiguration.
+    """
+
+    def __init__(self, manager: Any) -> None:
+        super().__init__()
+        self._manager = manager
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        raise RuntimeError(
+            "ManagedProcessGroup is configured by its Manager, not directly"
+        )
+
+    def abort(self) -> None:
+        raise RuntimeError("ManagedProcessGroup cannot be aborted directly")
+
+    def errored(self) -> Optional[Exception]:
+        return self._manager.errored()
+
+    def rank(self) -> int:
+        r = self._manager.participating_rank()
+        return r if r is not None else 0
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
+        # Manager.allreduce takes a pytree; a list of arrays is one.
+        return self._manager.allreduce(list(arrays), reduce_op=op)
+
+    def allgather(self, array: Any) -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+    def broadcast(self, array: Any, root: int = 0) -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+    def recv(self, src: int, tag: int = 0) -> Work:
+        return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
+
+
+# ---------------------------------------------------------------------------
+# Subprocess-isolated ("Baby") process groups
+# ---------------------------------------------------------------------------
+
+
+def _baby_worker(
+    pg_cls: type,
+    pipe_conn: Any,
+    store_addr: str,
+    replica_id: str,
+    rank: int,
+    world_size: int,
+    timeout: float,
+) -> None:
+    """Worker-process loop: run the real PG, execute ops from the pipe.
+
+    Protocol (reference worker loop, torchft/process_group.py:1470-1600):
+    parent sends ``(op_id, func_name, args, kwargs)``; worker runs the op,
+    *waits* the resulting Work, and replies ``(op_id, value)`` on success or
+    ``(op_id, exception)`` on failure. ``(op_id, "__shutdown__", ...)``
+    exits the loop. Collectives execute on a small thread pool so an
+    in-flight op cannot block the command loop (and ops on distinct tags can
+    overlap), matching the parent's async Work API.
+    """
+    import concurrent.futures as cf
+
+    pg = pg_cls()
+    pg.set_timeout(timeout)
+    try:
+        pg.configure(store_addr, replica_id, rank, world_size)
+    except Exception as e:  # noqa: BLE001 - shipped to parent
+        try:
+            # bare exception: _MonitoredPipe re-raises it in the parent's
+            # configure with the real root cause intact
+            pipe_conn.send(e)
+        except (BrokenPipeError, OSError):
+            pass
+        return
+    pipe_conn.send((-1, "configured"))
+
+    send_lock = threading.Lock()
+    pool = cf.ThreadPoolExecutor(max_workers=4, thread_name_prefix="baby_op")
+
+    def _run(op_id: int, func: str, args: tuple, kwargs: dict) -> None:
+        try:
+            work = getattr(pg, func)(*args, **kwargs)
+            value = work.wait(timeout=timeout) if isinstance(work, Work) else work
+        except Exception as e:  # noqa: BLE001 - shipped to parent
+            with send_lock:
+                try:
+                    pipe_conn.send((op_id, e))
+                except (BrokenPipeError, OSError):
+                    pass
+            return
+        with send_lock:
+            try:
+                pipe_conn.send((op_id, value))
+            except (BrokenPipeError, OSError):
+                pass
+
+    try:
+        while True:
+            try:
+                msg = pipe_conn.recv()
+            except (EOFError, OSError):
+                break
+            op_id, func, args, kwargs = msg
+            if func == "__shutdown__":
+                break
+            pool.submit(_run, op_id, func, args, kwargs)
+    finally:
+        pool.shutdown(wait=False)
+        try:
+            pg.shutdown()
+        except Exception:  # noqa: BLE001 - worker teardown is best-effort
+            pass
+
+
+class ProcessGroupBaby(ProcessGroup):
+    """Runs the real PG in a spawned subprocess for crash isolation.
+
+    Reference: torchft/process_group.py:1358-1828.  ``configure`` kills any
+    existing worker and spawns a fresh one (subprocess restart *is* the
+    reconfigure); every collective is shipped over a command pipe and
+    returns a Work backed by a future that a reader thread resolves.
+    ``abort()`` kills the worker — the hard-cancel that a wedged socket
+    stack cannot block.
+
+    Workers start via the ``spawn`` method, so (as with any spawning
+    library) the using script must be importable without side effects —
+    guard its entry point with ``if __name__ == "__main__":``.
+    """
+
+    PG_CLASS: type = None  # set by subclasses
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        super().__init__(timeout)
+        self._proc: Optional[Any] = None
+        self._pipe: Optional[Any] = None
+        self._rank = -1
+        self._world = -1
+        self._errored_exc: Optional[Exception] = None
+        self._next_op_id = 0
+        self._gen = 0  # bumped per configure; guards against stale readers
+        self._pending: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        import multiprocessing as mp
+
+        self._kill_worker()
+        self._errored_exc = None
+        self._rank = rank
+        self._world = world_size
+
+        ctx = mp.get_context("spawn")
+        parent_conn, child_conn = ctx.Pipe()
+        self._proc = ctx.Process(
+            target=_baby_worker,
+            args=(
+                type(self).PG_CLASS,
+                child_conn,
+                store_addr,
+                replica_id,
+                rank,
+                world_size,
+                self._timeout,
+            ),
+            daemon=True,
+        )
+        self._proc.start()
+        child_conn.close()
+
+        from torchft_tpu.multiprocessing import _MonitoredPipe
+
+        pipe = _MonitoredPipe(parent_conn)
+        with self._lock:
+            self._pipe = pipe
+            self._gen += 1
+            gen = self._gen
+        # first message acks configure; a worker-side configure failure
+        # arrives as a bare exception that _MonitoredPipe re-raises here
+        ack = self._recv_ack(pipe)
+        if ack != (-1, "configured"):
+            self._kill_worker()
+            raise RuntimeError(f"unexpected configure ack from worker: {ack!r}")
+
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            args=(pipe, gen),
+            name="baby_pg_reader",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def _recv_ack(self, pipe: Any) -> Any:
+        try:
+            return pipe.recv(timeout=self._timeout)
+        except Exception:
+            self._kill_worker()
+            raise
+
+    def _read_loop(self, pipe: Any, gen: int) -> None:
+        while True:
+            try:
+                op_id, value = pipe.recv(timeout=None)
+            except Exception as e:  # noqa: BLE001 - includes EOF/reset/transport
+                # EOFError (clean close) or ConnectionResetError (SIGKILL)
+                # both mean the worker died; transported exceptions arrive
+                # without an op id and are equally fatal to all pending ops.
+                # The generation check inside _fail_all makes a stale reader
+                # (whose PG was already reconfigured) a no-op.
+                if isinstance(e, (EOFError, OSError)):
+                    self._fail_all(RuntimeError(f"baby PG worker exited: {e!r}"), gen)
+                else:
+                    self._fail_all(
+                        e if isinstance(e, Exception) else RuntimeError(str(e)), gen
+                    )
+                return
+            with self._lock:
+                if gen != self._gen:
+                    return  # reconfigured under us; results no longer ours
+                fut = self._pending.pop(op_id, None)
+                if fut is not None and isinstance(value, Exception):
+                    self._errored_exc = self._errored_exc or value
+            if fut is not None:
+                if isinstance(value, Exception):
+                    fut.set_exception(value)
+                else:
+                    fut.set_result(value)
+
+    def _fail_all(self, exc: Exception, gen: "Optional[int]" = None) -> None:
+        with self._lock:
+            if gen is not None and gen != self._gen:
+                return  # stale reader of a pre-reconfigure worker
+            self._errored_exc = self._errored_exc or exc
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _kill_worker(self) -> None:
+        # claim pipe+proc under the lock: abort() and configure() can race
+        # here, and nulling before close makes the reader thread see a stale
+        # pipe (deliberate teardown), not a worker death
+        with self._lock:
+            pipe, self._pipe = self._pipe, None
+            proc, self._proc = self._proc, None
+        if pipe is not None:
+            try:
+                pipe.close()
+            except OSError:
+                pass
+        if proc is not None:
+            try:
+                proc.terminate()
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5)
+            except ValueError:
+                pass  # process never started (configure failed mid-spawn)
+        self._fail_all(_PGAborted("process group aborted"))
+
+    def _submit(self, func: str, *args: Any, **kwargs: Any) -> Work:
+        with self._lock:
+            if self._errored_exc is not None:
+                return failed_work(self._errored_exc)
+            if self._pipe is None:
+                return failed_work(RuntimeError("process group not configured"))
+            op_id = self._next_op_id
+            self._next_op_id += 1
+            fut: Future = Future()
+            self._pending[op_id] = fut
+            pipe = self._pipe  # local ref: abort() may null the attribute
+        try:
+            pipe.send((op_id, func, args, kwargs))
+        except (BrokenPipeError, OSError) as e:
+            with self._lock:
+                self._pending.pop(op_id, None)
+            self._errored_exc = self._errored_exc or e
+            return failed_work(e)
+        return Work(fut).with_timeout(self._timeout)
+
+    # -- ProcessGroup API --------------------------------------------------
+
+    def abort(self) -> None:
+        self._kill_worker()  # latches _PGAborted via _fail_all
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored_exc
+
+    def shutdown(self) -> None:
+        if self._pipe is not None:
+            try:
+                self._pipe.send((-1, "__shutdown__", (), {}))
+            except (BrokenPipeError, OSError):
+                pass
+        self._kill_worker()
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world
+
+    def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
+        return self._submit("allreduce", [_as_numpy(a) for a in arrays], op)
+
+    def allgather(self, array: Any) -> Work:
+        return self._submit("allgather", _as_numpy(array))
+
+    def broadcast(self, array: Any, root: int = 0) -> Work:
+        return self._submit("broadcast", _as_numpy(array), root)
+
+    def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
+        return self._submit("reduce_scatter", _as_numpy(array), op)
+
+    def alltoall(self, arrays: "List[Any]") -> Work:
+        return self._submit("alltoall", [_as_numpy(a) for a in arrays])
+
+    def send(self, array: Any, dst: int, tag: int = 0) -> Work:
+        return self._submit("send", _as_numpy(array), dst, tag)
+
+    def recv(self, src: int, tag: int = 0) -> Work:
+        return self._submit("recv", src, tag)
+
+
+class ProcessGroupBabyTCP(ProcessGroupBaby):
+    """Subprocess-isolated ProcessGroupTCP (reference ProcessGroupBabyGloo
+    analog, torchft/process_group.py:1883-1923)."""
+
+    PG_CLASS = ProcessGroupTCP
