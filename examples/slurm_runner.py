@@ -1,0 +1,111 @@
+"""SLURM adapter: submit one job per replica group and keep them alive.
+
+Analog of the reference's torchtitan-on-SLURM runner
+(reference: torchft/examples/slurm/runner.py:16-100): each replica group is
+its own SLURM job carrying the ``REPLICA_GROUP_ID`` / ``NUM_REPLICA_GROUPS``
+/ ``TORCHFT_LIGHTHOUSE`` env, so the cluster scheduler can preempt or kill
+any one group while the rest keep training; this runner resubmits dead
+jobs, and the quorum protocol heals them back in.
+
+Dry-run (no SLURM needed) prints the exact sbatch command lines:
+
+    python examples/slurm_runner.py --replicas 4 --dry-run -- \
+        python examples/train_diloco.py --steps 10000
+
+On a real cluster, point TORCHFT_LIGHTHOUSE at a lighthouse reachable from
+the compute nodes (`python -m torchft_tpu.lighthouse --bind :29510`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu.launcher import replica_app_spec
+
+
+def sbatch_lines(spec, partition: str, tpus_per_group: int) -> list:
+    """One `sbatch --wrap` command per replica-group role."""
+    lines = []
+    for role in spec["roles"]:
+        env = " ".join(f"{k}={shlex.quote(v)}" for k, v in role["env"].items())
+        cmd = " ".join(shlex.quote(a) for a in [role["entrypoint"], *role["args"]])
+        lines.append(
+            f"sbatch --job-name={role['name']} --partition={partition} "
+            f"--gres=tpu:{tpus_per_group} --wrap={shlex.quote(f'{env} {cmd}')}"
+        )
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--partition", default="tpu")
+    p.add_argument("--tpus-per-group", type=int, default=8)
+    p.add_argument("--max-restarts", type=int, default=10)
+    p.add_argument("--resubmit-interval", type=float, default=30.0)
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("no command; usage: ... -- python train.py [args]")
+
+    # strip a leading interpreter: roles always launch via sys.executable
+    if os.path.basename(cmd[0]).startswith("python"):
+        if len(cmd) < 2:
+            p.error("interpreter given without a script")
+        script, script_args = cmd[1], cmd[2:]
+    else:
+        script, script_args = cmd[0], cmd[1:]
+
+    spec = replica_app_spec(
+        *script_args, replicas=args.replicas, max_restarts=args.max_restarts,
+        script=script,
+    )
+    lines = sbatch_lines(spec, args.partition, args.tpus_per_group)
+
+    if args.dry_run:
+        for line in lines:
+            print(line)
+        return 0
+
+    # submit + babysit: resubmit any group whose job left the queue
+    restarts = {i: 0 for i in range(args.replicas)}
+    jobs = {}
+    for i, line in enumerate(lines):
+        out = subprocess.run(line, shell=True, capture_output=True, text=True, check=True)
+        jobs[i] = out.stdout.strip().split()[-1]
+        print(f"replica_group {i} -> job {jobs[i]}")
+
+    while jobs:
+        time.sleep(args.resubmit_interval)
+        q = subprocess.run(
+            ["squeue", "-h", "-o", "%i"], capture_output=True, text=True
+        ).stdout.split()
+        for i, jid in list(jobs.items()):
+            if jid in q:
+                continue
+            if restarts[i] >= args.max_restarts:
+                print(f"replica_group {i} exhausted restarts; leaving down")
+                del jobs[i]
+                continue
+            restarts[i] += 1
+            out = subprocess.run(
+                lines[i], shell=True, capture_output=True, text=True, check=True
+            )
+            jobs[i] = out.stdout.strip().split()[-1]
+            print(f"replica_group {i} resubmitted -> job {jobs[i]} "
+                  f"({restarts[i]}/{args.max_restarts})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

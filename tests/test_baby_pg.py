@@ -109,6 +109,63 @@ class TestProcessGroupBabyTCP:
             for pg in pgs:
                 pg.shutdown()
 
+    def test_pipelined_ops_preserve_order(self, store):
+        # submit two collectives without waiting in between: the worker
+        # must enqueue them in pipe order so ranks' streams match
+        pgs = _configure_pair(store, "babyp")
+        try:
+            def both(r):
+                w1 = pgs[r].allreduce([np.full(4, 1.0 + r, np.float32)])
+                w2 = pgs[r].allreduce([np.full(2, 10.0 * (1 + r), np.float32)])
+                return w1.wait(timeout=30), w2.wait(timeout=30)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [ex.submit(both, r) for r in range(2)]
+                results = [f.result(timeout=60) for f in futs]
+            for r1, r2 in results:
+                np.testing.assert_array_equal(r1[0], np.full(4, 3.0, np.float32))
+                np.testing.assert_array_equal(r2[0], np.full(2, 30.0, np.float32))
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_live_reconfigure_keeps_clean_state(self, store):
+        # reconfigure over a healthy PG (quorum-change path): the stale
+        # reader of the old worker must not latch an error afterwards
+        import time
+
+        pgs = _configure_pair(store, "babyr1")
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        pgs[r].configure, f"{store.address()}/babyr2", f"rank{r}", r, 2
+                    )
+                    for r in range(2)
+                ]
+                for f in futs:
+                    f.result(timeout=60)
+            time.sleep(0.5)  # give the old readers time to wake on the closed pipe
+            assert all(pg.errored() is None for pg in pgs)
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r]
+                        .allreduce([np.ones(2, np.float32)])
+                        .wait(timeout=30),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                for f in futs:
+                    np.testing.assert_array_equal(
+                        f.result(timeout=60)[0], np.full(2, 2.0, np.float32)
+                    )
+            assert all(pg.errored() is None for pg in pgs)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
     def test_worker_crash_is_isolated(self, store):
         pgs = _configure_pair(store, "baby2")
         try:

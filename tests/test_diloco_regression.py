@@ -1,0 +1,197 @@
+"""DiLoCo / LocalSGD numerics-exact regression vs committed golden files.
+
+Mirrors the reference's golden-file strategy
+(reference: torchft/diloco_regression_test.py + test_fixtures/*.json):
+deterministic fixed-delta inner updates drive the real Manager + DiLoCo
+stack over 2 thread-replicas; the full per-sync parameter history is
+compared bitwise against JSON fixtures committed in tests/fixtures/.
+
+Any change to the outer-optimizer math, pseudogradient computation,
+fragment scheduling, or averaging semantics shows up as a fixture diff.
+
+Regenerate (after an *intentional* semantics change) with:
+    TORCHFT_TPU_REGEN_FIXTURES=1 python -m pytest tests/test_diloco_regression.py
+"""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+FIXTURES = Path(__file__).parent / "fixtures"
+REGEN = os.environ.get("TORCHFT_TPU_REGEN_FIXTURES") == "1"
+
+N_REPLICAS = 2
+
+
+def _train_replica(
+    replica_id: int,
+    lighthouse_addr: str,
+    variant: dict,
+    barrier: threading.Barrier,
+) -> list:
+    """Deterministic replica: inner delta depends on (replica, key index) so
+    the outer average is distinguishable from any single replica's value."""
+    params = {
+        "layer0": np.zeros(4, dtype=np.float32),
+        "layer1": np.zeros(4, dtype=np.float32),
+    }
+    holder = {"p": params}
+
+    manager = Manager(
+        pg=ProcessGroupTCP(timeout=20.0),
+        min_replica_size=N_REPLICAS,
+        lighthouse_addr=lighthouse_addr,
+        replica_id=f"golden_{replica_id}",
+        group_rank=0,
+        group_world_size=1,
+        use_async_quorum=False,
+        timeout=30.0,
+        quorum_timeout=30.0,
+        load_state_dict=lambda sd: holder.__setitem__(
+            "p", {k: np.array(v) for k, v in sd.items()}
+        ),
+        state_dict=lambda: {k: np.array(v) for k, v in holder["p"].items()},
+    )
+    history = []
+    try:
+        if variant["algo"] == "local_sgd":
+            algo = LocalSGD(
+                manager,
+                lambda: dict(holder["p"]),
+                lambda p: holder.__setitem__("p", dict(p)),
+                sync_every=variant["sync_every"],
+            )
+        else:
+            algo = DiLoCo(
+                manager,
+                variant["fragments"],
+                lambda: dict(holder["p"]),
+                lambda p: holder.__setitem__("p", dict(p)),
+                optax.sgd(0.5, momentum=0.9, nesterov=True),
+                sync_every=variant["sync_every"],
+                fragment_sync_delay=variant.get("fragment_sync_delay", 0),
+                fragment_update_alpha=variant.get("fragment_update_alpha", 0.0),
+            )
+        barrier.wait(timeout=60)
+        last_step = manager.current_step()
+        while manager.current_step() < variant["target_steps"]:
+            p = dict(holder["p"])
+            for i, k in enumerate(sorted(p)):
+                p[k] = p[k] - np.float32(0.01 * (1 + i) * (1 + replica_id))
+            holder["p"] = p
+            algo.step()
+            step = manager.current_step()
+            if step != last_step:
+                last_step = step
+                history.append(
+                    {
+                        "step": step,
+                        "params": {
+                            k: [float(x) for x in holder["p"][k]]
+                            for k in sorted(holder["p"])
+                        },
+                    }
+                )
+        return history
+    finally:
+        manager.shutdown()
+
+
+VARIANTS = {
+    "local_sgd": {"algo": "local_sgd", "sync_every": 3, "target_steps": 4},
+    "diloco_1frag": {
+        "algo": "diloco",
+        "fragments": [["layer0", "layer1"]],
+        "sync_every": 2,
+        "target_steps": 3,
+    },
+    "diloco_2frag": {
+        "algo": "diloco",
+        "fragments": [["layer0"], ["layer1"]],
+        "sync_every": 4,
+        "target_steps": 6,
+    },
+    "diloco_2frag_delay1": {
+        "algo": "diloco",
+        "fragments": [["layer0"], ["layer1"]],
+        "sync_every": 4,
+        "fragment_sync_delay": 1,
+        "target_steps": 6,
+    },
+    "diloco_2frag_alpha05": {
+        "algo": "diloco",
+        "fragments": [["layer0"], ["layer1"]],
+        "sync_every": 4,
+        "fragment_update_alpha": 0.5,
+        "target_steps": 6,
+    },
+}
+
+
+def _synced_keys(variant: dict, step: int) -> list:
+    """Keys that must be bitwise-equal across replicas after commit ``step``.
+
+    In streaming DiLoCo only the just-synced fragment is globally merged;
+    the other fragments carry replica-local inner updates until their own
+    sync. With ``fragment_update_alpha > 0`` even the synced fragment mixes
+    in local params by design, so nothing is cross-replica comparable.
+    """
+    if variant.get("fragment_update_alpha", 0.0) > 0.0:
+        return []
+    if variant["algo"] == "local_sgd":
+        return ["layer0", "layer1"]
+    frags = variant["fragments"]
+    return frags[(step - 1) % len(frags)]
+
+
+def _run_variant(variant: dict) -> list:
+    lighthouse = LighthouseServer(min_replicas=N_REPLICAS, join_timeout_ms=30000)
+    try:
+        barrier = threading.Barrier(N_REPLICAS)
+        with ThreadPoolExecutor(max_workers=N_REPLICAS) as ex:
+            futures = [
+                ex.submit(_train_replica, r, lighthouse.address(), variant, barrier)
+                for r in range(N_REPLICAS)
+            ]
+            histories = [f.result(timeout=180) for f in futures]
+    finally:
+        lighthouse.shutdown()
+
+    # replicas must agree bitwise on every globally-synced fragment
+    assert len(histories[0]) == len(histories[1]), "replicas saw different syncs"
+    for rec0, rec1 in zip(histories[0], histories[1]):
+        assert rec0["step"] == rec1["step"]
+        for key in _synced_keys(variant, rec0["step"]):
+            assert rec0["params"][key] == rec1["params"][key], (
+                f"replicas diverged on synced fragment {key} at step {rec0['step']}"
+            )
+    return histories[0]
+
+
+@pytest.mark.parametrize("name", sorted(VARIANTS))
+def test_golden(name):
+    history = _run_variant(VARIANTS[name])
+    assert history, "no syncs committed"
+    path = FIXTURES / f"{name}.json"
+    if REGEN or not path.exists():
+        FIXTURES.mkdir(exist_ok=True)
+        path.write_text(json.dumps(history, indent=1, sort_keys=True) + "\n")
+        if REGEN:
+            pytest.skip(f"regenerated {path.name}")
+    golden = json.loads(path.read_text())
+    assert history == golden, (
+        f"{name}: parameter history diverged from golden fixture {path.name}. "
+        "If this change is intentional, regenerate with "
+        "TORCHFT_TPU_REGEN_FIXTURES=1."
+    )

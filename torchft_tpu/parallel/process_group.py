@@ -880,6 +880,9 @@ class ManagedProcessGroup(ProcessGroup):
     def abort(self) -> None:
         raise RuntimeError("ManagedProcessGroup cannot be aborted directly")
 
+    def shutdown(self) -> None:
+        """No-op: the Manager owns the underlying PG's lifecycle."""
+
     def errored(self) -> Optional[Exception]:
         return self._manager.errored()
 
@@ -956,22 +959,20 @@ def _baby_worker(
     send_lock = threading.Lock()
     pool = cf.ThreadPoolExecutor(max_workers=4, thread_name_prefix="baby_op")
 
-    def _run(op_id: int, func: str, args: tuple, kwargs: dict) -> None:
-        try:
-            work = getattr(pg, func)(*args, **kwargs)
-            value = work.wait(timeout=timeout) if isinstance(work, Work) else work
-        except Exception as e:  # noqa: BLE001 - shipped to parent
-            with send_lock:
-                try:
-                    pipe_conn.send((op_id, e))
-                except (BrokenPipeError, OSError):
-                    pass
-            return
+    def _send(op_id: int, value: Any) -> None:
         with send_lock:
             try:
                 pipe_conn.send((op_id, value))
             except (BrokenPipeError, OSError):
                 pass
+
+    def _finish(op_id: int, work: Any) -> None:
+        try:
+            value = work.wait(timeout=timeout) if isinstance(work, Work) else work
+        except Exception as e:  # noqa: BLE001 - shipped to parent
+            _send(op_id, e)
+            return
+        _send(op_id, value)
 
     try:
         while True:
@@ -982,7 +983,16 @@ def _baby_worker(
             op_id, func, args, kwargs = msg
             if func == "__shutdown__":
                 break
-            pool.submit(_run, op_id, func, args, kwargs)
+            # enqueue on THIS thread so ops hit the inner PG in pipe order
+            # (pipelined collectives must match across ranks); only the
+            # wait() moves to the pool so an in-flight op can't block the
+            # command loop.
+            try:
+                work = getattr(pg, func)(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - shipped to parent
+                _send(op_id, e)
+                continue
+            pool.submit(_finish, op_id, work)
     finally:
         pool.shutdown(wait=False)
         try:
@@ -1118,10 +1128,14 @@ class ProcessGroupBaby(ProcessGroup):
     def _kill_worker(self) -> None:
         # claim pipe+proc under the lock: abort() and configure() can race
         # here, and nulling before close makes the reader thread see a stale
-        # pipe (deliberate teardown), not a worker death
+        # pipe (deliberate teardown), not a worker death. Bumping the
+        # generation here (not just in configure) immediately invalidates
+        # the old reader so it cannot latch an error after a reconfigure
+        # clears the latched state.
         with self._lock:
             pipe, self._pipe = self._pipe, None
             proc, self._proc = self._proc, None
+            self._gen += 1
         if pipe is not None:
             try:
                 pipe.close()
