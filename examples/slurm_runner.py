@@ -87,9 +87,15 @@ def main(argv=None) -> int:
 
     while jobs:
         time.sleep(args.resubmit_interval)
-        q = subprocess.run(
+        probe = subprocess.run(
             ["squeue", "-h", "-o", "%i"], capture_output=True, text=True
-        ).stdout.split()
+        )
+        if probe.returncode != 0:
+            # a flaky slurmctld must not look like "all jobs dead" — that
+            # would mass-resubmit duplicates into the same quorum
+            print(f"squeue failed ({probe.returncode}); skipping sweep")
+            continue
+        q = probe.stdout.split()
         for i, jid in list(jobs.items()):
             if jid in q:
                 continue

@@ -58,10 +58,14 @@ def replica_app_spec(
         raise ValueError("replicas must be > 0")
     base_env = dict(env or {})
     base_env.setdefault("LOGLEVEL", "INFO")
-    base_env.setdefault(
-        "TORCHFT_LIGHTHOUSE",
-        lighthouse or os.environ.get("TORCHFT_LIGHTHOUSE", "localhost:29510"),
-    )
+    if lighthouse is not None:
+        # explicit argument wins over anything in a forwarded caller env
+        base_env["TORCHFT_LIGHTHOUSE"] = lighthouse
+    else:
+        base_env.setdefault(
+            "TORCHFT_LIGHTHOUSE",
+            os.environ.get("TORCHFT_LIGHTHOUSE", "localhost:29510"),
+        )
 
     roles = []
     for replica_id in range(replicas):
@@ -160,9 +164,11 @@ class ReplicaGroupLauncher:
         terminated).
         """
         deadline = None if timeout is None else time.monotonic() + timeout
-        for rp in self._replicas:
-            rp.start()
         try:
+            # inside the try: a Popen failure mid-loop must still tear down
+            # the replicas (and local Lighthouse) already started
+            for rp in self._replicas:
+                rp.start()
             while True:
                 live = 0
                 for rp in self._replicas:

@@ -38,6 +38,7 @@ import struct
 import threading
 import time
 import uuid
+import concurrent.futures as concurrent_futures
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -54,19 +55,27 @@ REDUCE_AVG = "avg"
 REDUCE_MAX = "max"
 REDUCE_MIN = "min"
 
-_REDUCE_FNS: Dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
-    REDUCE_SUM: lambda a, b: a + b,
-    REDUCE_AVG: lambda a, b: a + b,  # divided by world size at the end
+# in-place reduction ufuncs for ring steps (AVG divides at the end)
+_REDUCE_UFUNCS: Dict[str, Any] = {
+    REDUCE_SUM: np.add,
+    REDUCE_AVG: np.add,
     REDUCE_MAX: np.maximum,
     REDUCE_MIN: np.minimum,
 }
 
 
 def _accumulation_dtype(dtype: np.dtype) -> np.dtype:
-    """Widened dtype for ring partial sums: f64 / i64 / u64 to avoid both
-    float non-determinism blowup and silent integer overflow."""
+    """Accumulation dtype for ring partial sums.
+
+    Floats accumulate in f32 (f64 stays f64): the replica dimension is
+    small, the ring reduces each chunk in a fixed order on exactly one rank
+    before allgather, so results are bitwise identical across ranks at any
+    precision — and f32 halves the wire bytes vs f64 promotion. Half-width
+    floats widen to f32 for precision; integers widen to 64-bit to avoid
+    silent overflow.
+    """
     if np.issubdtype(dtype, np.floating):
-        return np.dtype(np.float64)
+        return np.dtype(np.float64) if dtype.itemsize >= 8 else np.dtype(np.float32)
     if np.issubdtype(dtype, np.signedinteger):
         return np.dtype(np.int64)
     if np.issubdtype(dtype, np.unsignedinteger):
@@ -281,6 +290,7 @@ class ProcessGroupTCP(ProcessGroup):
         self._generation = 0
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
+        self._sender: "Optional[concurrent_futures.ThreadPoolExecutor]" = None
         self._queue: "queue.Queue[Optional[Tuple[int, Callable[[], Any], Future]]]" = (
             queue.Queue()
         )
@@ -364,6 +374,9 @@ class ProcessGroupTCP(ProcessGroup):
         # _submit can never enqueue onto a retired queue.
         with self._lock:
             self._queue = queue.Queue()
+            self._sender = concurrent_futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pg_tcp_sender"
+            )
             self._worker = threading.Thread(
                 target=self._worker_loop,
                 args=(gen, self._queue),
@@ -394,6 +407,11 @@ class ProcessGroupTCP(ProcessGroup):
         with self._lock:
             # After this, _submit fails fast instead of enqueueing into limbo.
             self._worker = None
+            sender, self._sender = self._sender, None
+        if sender is not None:
+            # don't wait: a sendall stuck on a dead peer unwedges itself when
+            # the socket close (above) fails it
+            sender.shutdown(wait=False)
         # Fail any ops still sitting in the retired queue so no Work handle
         # is left unresolved (a hang is worse than an error in FT code).
         while True:
@@ -481,6 +499,19 @@ class ProcessGroupTCP(ProcessGroup):
             raise _PGAborted(f"no connection to rank {rank}")
         return peer
 
+    @staticmethod
+    def _read_into_sock(
+        sock: socket.socket, view: memoryview, deadline: float
+    ) -> None:
+        """recv_into a buffer — zero intermediate copies for payloads."""
+        off, n = 0, len(view)
+        while off < n:
+            sock.settimeout(max(deadline - time.monotonic(), 0.001))
+            got = sock.recv_into(view[off:], n - off)
+            if got == 0:
+                raise ConnectionError("peer closed connection")
+            off += got
+
     def _send_msg(self, dst: int, tag: int, array: np.ndarray, deadline: float) -> None:
         peer = self._peer(dst)
         array = np.ascontiguousarray(array)
@@ -488,11 +519,23 @@ class ProcessGroupTCP(ProcessGroup):
             {"tag": tag, "shape": array.shape, "dtype": str(array.dtype)}
         )
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
-        peer.sock.sendall(
-            struct.pack(">II", len(header), array.nbytes) + header + array.tobytes()
-        )
+        peer.sock.sendall(struct.pack(">II", len(header), array.nbytes) + header)
+        if array.nbytes:
+            # memoryview: the payload goes to the kernel straight from the
+            # array's buffer, no tobytes() copy (reshape(-1): 0-d arrays
+            # can't cast to 'B')
+            peer.sock.sendall(memoryview(array.reshape(-1)).cast("B"))
 
-    def _recv_msg(self, src: int, tag: int, deadline: float) -> np.ndarray:
+    def _recv_msg(
+        self,
+        src: int,
+        tag: int,
+        deadline: float,
+        out: "Optional[np.ndarray]" = None,
+    ) -> np.ndarray:
+        """Receive one tagged array; ``out`` receives in place (zero-alloc
+        fast path for ring steps — reference pg_transport in-place recv
+        analog, torchft/checkpointing/pg_transport.py:230-300)."""
         peer = self._peer(src)
         hlen, nbytes = struct.unpack(
             ">II", self._read_exact_sock(peer.sock, 8, deadline)
@@ -502,10 +545,27 @@ class ProcessGroupTCP(ProcessGroup):
             raise RuntimeError(
                 f"collective tag mismatch: expected {tag}, got {header['tag']}"
             )
-        payload = self._read_exact_sock(peer.sock, nbytes, deadline)
-        return np.frombuffer(payload, dtype=np.dtype(header["dtype"])).reshape(
-            header["shape"]
-        ).copy()
+        if out is None:
+            out = np.empty(header["shape"], dtype=np.dtype(header["dtype"]))
+            if out.nbytes != nbytes:
+                raise RuntimeError(
+                    f"collective payload size mismatch: header says {nbytes},"
+                    f" shape/dtype imply {out.nbytes}"
+                )
+        elif (
+            out.nbytes != nbytes
+            or str(out.dtype) != header["dtype"]
+            or not out.flags.c_contiguous
+        ):
+            raise RuntimeError(
+                f"in-place recv buffer mismatch: {out.shape}/{out.dtype} vs "
+                f"wire {header['shape']}/{header['dtype']}"
+            )
+        if nbytes:
+            self._read_into_sock(
+                peer.sock, memoryview(out.reshape(-1)).cast("B"), deadline
+            )
+        return out
 
     def _exchange(
         self,
@@ -515,32 +575,39 @@ class ProcessGroupTCP(ProcessGroup):
         recv_src: int,
         recv_tag: int,
         deadline: float,
+        recv_out: "Optional[np.ndarray]" = None,
     ) -> np.ndarray:
         """Simultaneous send+recv without deadlocking on full TCP buffers.
 
-        Ring steps send and receive concurrently; pushing the send to a side
-        thread keeps both directions draining even when payloads exceed
-        socket buffer sizes.
+        Ring steps send and receive concurrently; pushing the send to the
+        persistent sender thread keeps both directions draining even when
+        payloads exceed socket buffer sizes.
         """
-        send_exc: List[BaseException] = []
-
-        def _sender() -> None:
+        sender = self._sender
+        if sender is None:
+            raise _PGAborted("process group not configured/running")
+        send_fut = sender.submit(
+            self._send_msg, send_dst, send_tag, send_array, deadline
+        )
+        send_err: "Optional[BaseException]" = None
+        try:
+            received = self._recv_msg(recv_src, recv_tag, deadline, out=recv_out)
+        finally:
+            # always reap the send: the socket stream must never be left
+            # mid-write when the next step starts (a recv error still
+            # propagates; it takes precedence over any send error)
             try:
-                self._send_msg(send_dst, send_tag, send_array, deadline)
-            except BaseException as e:  # noqa: BLE001
-                send_exc.append(e)
-
-        t = threading.Thread(target=_sender, daemon=True)
-        t.start()
-        received = self._recv_msg(recv_src, recv_tag, deadline)
-        t.join(timeout=max(deadline - time.monotonic(), 0.001) + 1.0)
-        if t.is_alive():
-            # The socket stream is mid-write; returning now would let the
-            # next step interleave bytes on the same socket. Fail the op —
-            # the error latches and the group reconfigures.
-            raise TimeoutError("collective send did not complete by deadline")
-        if send_exc:
-            raise send_exc[0]
+                send_fut.result(
+                    timeout=max(deadline - time.monotonic(), 0.001) + 1.0
+                )
+            except concurrent_futures.TimeoutError:
+                send_err = TimeoutError(
+                    "collective send did not complete by deadline"
+                )
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                send_err = e
+        if send_err is not None:
+            raise send_err
         return received
 
     # -- collectives -------------------------------------------------------
@@ -559,35 +626,45 @@ class ProcessGroupTCP(ProcessGroup):
         w, r = self._world, self._rank
         if w == 1:
             return array.copy()
-        reduce_fn = _REDUCE_FNS[op]
         acc_dtype = _accumulation_dtype(array.dtype)
-        flat = array.astype(acc_dtype).ravel()
-        n = flat.size
+        inplace_reduce = _REDUCE_UFUNCS[op]
+        n = array.size
         chunk = -(-n // w)
-        padded = np.zeros(chunk * w, dtype=acc_dtype)
-        padded[:n] = flat
-        chunks = [padded[i * chunk : (i + 1) * chunk].copy() for i in range(w)]
+        # single private buffer; chunks are views of it, so ring steps
+        # receive in place and reduce in place — the only full-size copies
+        # are the pad-in and (if dtype widened) the cast back out
+        buf = np.empty(chunk * w, dtype=acc_dtype)
+        buf[:n] = array.ravel()
+        if chunk * w > n:
+            buf[n:] = 0
+        chunks = [buf[i * chunk : (i + 1) * chunk] for i in range(w)]
+        scratch = np.empty(chunk, dtype=acc_dtype)
 
         nxt, prv = (r + 1) % w, (r - 1) % w
         # ring reduce-scatter: after w-1 steps, chunk (r+1)%w is fully reduced
         for step in range(w - 1):
             send_idx = (r - step) % w
             recv_idx = (r - step - 1) % w
-            received = self._exchange(
-                nxt, 100 + step, chunks[send_idx], prv, 100 + step, deadline
+            self._exchange(
+                nxt, 100 + step, chunks[send_idx], prv, 100 + step, deadline,
+                recv_out=scratch,
             )
-            chunks[recv_idx] = reduce_fn(chunks[recv_idx], received)
-        # ring allgather of the reduced chunks
+            inplace_reduce(chunks[recv_idx], scratch, out=chunks[recv_idx])
+        # ring allgather of the reduced chunks, received straight into place
         for step in range(w - 1):
             send_idx = (r - step + 1) % w
             recv_idx = (r - step) % w
-            chunks[recv_idx] = self._exchange(
-                nxt, 200 + step, chunks[send_idx], prv, 200 + step, deadline
+            self._exchange(
+                nxt, 200 + step, chunks[send_idx], prv, 200 + step, deadline,
+                recv_out=chunks[recv_idx],
             )
-        result = np.concatenate(chunks)[:n]
+        result = buf[:n]
         if op == REDUCE_AVG:
-            result = result / w
-        return result.astype(array.dtype).reshape(array.shape)
+            if np.issubdtype(acc_dtype, np.floating):
+                result /= w
+            else:
+                result = result / w
+        return np.asarray(result, dtype=array.dtype).reshape(array.shape)
 
     def allgather(self, array: Any) -> Work:
         np_array = _as_numpy(array)
@@ -642,27 +719,34 @@ class ProcessGroupTCP(ProcessGroup):
                 raise ValueError(
                     f"reduce_scatter dim0 {np_array.shape[0]} not divisible by {w}"
                 )
-            reduce_fn = _REDUCE_FNS[op]
+            inplace_reduce = _REDUCE_UFUNCS[op]
             rows = np_array.shape[0] // w
             acc_dtype = _accumulation_dtype(np_array.dtype)
-            chunks = [
-                np_array[i * rows : (i + 1) * rows].astype(acc_dtype)
-                for i in range(w)
-            ]
+            buf = np.empty(np_array.shape, dtype=acc_dtype)
+            buf[...] = np_array
+            chunks = [buf[i * rows : (i + 1) * rows] for i in range(w)]
+            scratch = np.empty(chunks[0].shape, dtype=acc_dtype)
             nxt, prv = (r + 1) % w, (r - 1) % w
             # Ring schedule shifted by one vs allreduce so each rank ends
             # holding its *own* fully-reduced chunk r.
             for step in range(w - 1):
                 send_idx = (r - step - 1) % w
                 recv_idx = (r - step - 2) % w
-                received = self._exchange(
-                    nxt, 500 + step, chunks[send_idx], prv, 500 + step, deadline
+                self._exchange(
+                    nxt, 500 + step, chunks[send_idx], prv, 500 + step, deadline,
+                    recv_out=scratch,
                 )
-                chunks[recv_idx] = reduce_fn(chunks[recv_idx], received)
+                inplace_reduce(chunks[recv_idx], scratch, out=chunks[recv_idx])
             result = chunks[r]
             if op == REDUCE_AVG:
-                result = result / w
-            return result.astype(np_array.dtype)
+                if np.issubdtype(acc_dtype, np.floating):
+                    result /= w
+                else:
+                    result = result / w
+            # copy: returning a view of chunks[r] would pin the w-times
+            # larger accumulation buffer for as long as the caller holds
+            # the result
+            return np.array(result, dtype=np_array.dtype)
 
         return self._submit(run)
 
