@@ -341,6 +341,50 @@ class TestWrappers:
 
 
 class TestNumerics:
+    def test_bfloat16_allreduce_and_sendrecv(self, store):
+        # bf16 is THE TPU training dtype; ml_dtypes arrays have no buffer-
+        # protocol format char, so the zero-copy wire path must use uint8
+        # views, and accumulation must widen to f32
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        world = 2
+        pgs = make_group(store, world, "bf16")
+
+        def ar(rank, _):
+            x = np.full((4, 3), 1.5 + rank, dtype=bf16)
+            out = pgs[rank].allreduce([x], REDUCE_SUM).wait(timeout=20)
+            return out[0]
+
+        results = run_parallel(world, ar)
+        for res in results:
+            assert res.dtype == bf16
+            np.testing.assert_array_equal(
+                res.astype(np.float32), np.full((4, 3), 4.0, np.float32)
+            )
+
+        def sr(rank, _):
+            if rank == 0:
+                pgs[0].send(np.arange(6, dtype=bf16), dst=1, tag=9).wait(timeout=20)
+                return None
+            return pgs[1].recv(src=0, tag=9).wait(timeout=20)
+
+        got = run_parallel(world, sr)[1]
+        assert got.dtype == bf16
+        np.testing.assert_array_equal(got.astype(np.float32), np.arange(6.0))
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_accumulation_dtype_widens_ml_floats(self):
+        import ml_dtypes
+
+        from torchft_tpu.parallel.process_group import _accumulation_dtype
+
+        assert _accumulation_dtype(np.dtype(ml_dtypes.bfloat16)) == np.float32
+        assert _accumulation_dtype(np.dtype(np.float16)) == np.float32
+        assert _accumulation_dtype(np.dtype(np.float32)) == np.float32
+        assert _accumulation_dtype(np.dtype(np.float64)) == np.float64
+
     def test_int32_allreduce_no_overflow(self, store):
         # Partial ring sums must widen to i64 (values near 2**30, world 3).
         world = 3

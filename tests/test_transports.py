@@ -114,6 +114,47 @@ class TestHTTPTransport:
             sender.shutdown()
             receiver.shutdown()
 
+    def test_inplace_recv_into_live_state(self):
+        sd = sample_state_dict()
+        import jax
+
+        live = jax.tree_util.tree_map(
+            lambda x: np.zeros_like(x) if isinstance(x, np.ndarray) else x, sd
+        )
+        sender = HTTPTransport(timeout=10.0)
+        receiver = HTTPTransport(timeout=10.0, state_dict_fn=lambda: live)
+        try:
+            sender.send_checkpoint([1], step=7, state_dict=sd, timeout=10.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=7, timeout=10.0
+            )
+            assert_state_dicts_equal(out, sd)
+            # numpy leaves were filled in place: same buffers as `live`
+            out_leaves = jax.tree_util.tree_flatten(out)[0]
+            live_leaves = jax.tree_util.tree_flatten(live)[0]
+            for o, l in zip(out_leaves, live_leaves):
+                if isinstance(l, np.ndarray):
+                    assert o is l
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_inplace_mismatch_falls_back(self):
+        sd = sample_state_dict()
+        receiver = HTTPTransport(
+            timeout=10.0, state_dict_fn=lambda: {"wrong": np.zeros(1)}
+        )
+        sender = HTTPTransport(timeout=10.0)
+        try:
+            sender.send_checkpoint([1], step=8, state_dict=sd, timeout=10.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=8, timeout=10.0
+            )
+            assert_state_dicts_equal(out, sd)
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
     def test_wrong_step_404(self):
         sender = HTTPTransport(timeout=5.0)
         try:

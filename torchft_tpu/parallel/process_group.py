@@ -64,6 +64,15 @@ _REDUCE_UFUNCS: Dict[str, Any] = {
 }
 
 
+def _is_float_dtype(dtype: np.dtype) -> bool:
+    """True for numpy floats AND ml_dtypes extension floats (bfloat16,
+    float8_*) — np.issubdtype misses the latter (they register as kind 'V';
+    same pitfall as manager._is_floating, manager.py:67)."""
+    return np.issubdtype(dtype, np.floating) or dtype.name.startswith(
+        ("bfloat", "float8")
+    )
+
+
 def _accumulation_dtype(dtype: np.dtype) -> np.dtype:
     """Accumulation dtype for ring partial sums.
 
@@ -71,10 +80,10 @@ def _accumulation_dtype(dtype: np.dtype) -> np.dtype:
     small, the ring reduces each chunk in a fixed order on exactly one rank
     before allgather, so results are bitwise identical across ranks at any
     precision — and f32 halves the wire bytes vs f64 promotion. Half-width
-    floats widen to f32 for precision; integers widen to 64-bit to avoid
-    silent overflow.
+    floats (f16 and the ml_dtypes TPU types bf16/fp8) widen to f32 for
+    precision; integers widen to 64-bit to avoid silent overflow.
     """
-    if np.issubdtype(dtype, np.floating):
+    if _is_float_dtype(dtype):
         return np.dtype(np.float64) if dtype.itemsize >= 8 else np.dtype(np.float32)
     if np.issubdtype(dtype, np.signedinteger):
         return np.dtype(np.int64)
@@ -521,10 +530,11 @@ class ProcessGroupTCP(ProcessGroup):
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
         peer.sock.sendall(struct.pack(">II", len(header), array.nbytes) + header)
         if array.nbytes:
-            # memoryview: the payload goes to the kernel straight from the
-            # array's buffer, no tobytes() copy (reshape(-1): 0-d arrays
-            # can't cast to 'B')
-            peer.sock.sendall(memoryview(array.reshape(-1)).cast("B"))
+            # uint8 view, not memoryview.cast("B"): ml_dtypes arrays
+            # (bfloat16/fp8 — the TPU training dtypes) have no
+            # buffer-protocol format char and raise in cast(). The payload
+            # still goes to the kernel straight from the array's buffer.
+            peer.sock.sendall(memoryview(array.reshape(-1).view(np.uint8)))
 
     def _recv_msg(
         self,
@@ -562,8 +572,9 @@ class ProcessGroupTCP(ProcessGroup):
                 f"wire {header['shape']}/{header['dtype']}"
             )
         if nbytes:
+            # uint8 view for ml_dtypes compat (see _send_msg)
             self._read_into_sock(
-                peer.sock, memoryview(out.reshape(-1)).cast("B"), deadline
+                peer.sock, memoryview(out.reshape(-1).view(np.uint8)), deadline
             )
         return out
 
