@@ -1,0 +1,153 @@
+"""Ulysses all-to-all sequence parallelism: correctness vs dense reference,
+agreement with ring attention, and model integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torchft_tpu.ops.ring_attention import dense_attention, ring_attention
+from torchft_tpu.ops.ulysses import ulysses_attention
+
+
+def _qkv(b=2, t=16, h=4, d=8, dtype=jnp.float32):
+    key = jax.random.PRNGKey(7)
+    return [
+        jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d), dtype)
+        for i in range(3)
+    ]
+
+
+def _cp_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("cp",))
+
+
+@pytest.mark.parametrize("sp_size", [1, 2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_dense(sp_size, causal):
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, _cp_mesh(sp_size), causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_matches_ring():
+    q, k, v = _qkv(t=32)
+    mesh = _cp_mesh(4)
+    ring = ring_attention(q, k, v, mesh, causal=True)
+    uly = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring), atol=2e-5)
+
+
+def test_batch_sharded_alongside():
+    q, k, v = _qkv(b=4, t=16, h=4, d=8)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "cp"))
+    out = ulysses_attention(q, k, v, mesh, axis_name="cp", batch_axes=("dp",))
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_heads_not_divisible_raises():
+    q, k, v = _qkv(h=3)
+    with pytest.raises(Exception, match="divisible"):
+        ulysses_attention(q, k, v, _cp_mesh(2))
+
+
+def _gqa_qkv(b=2, t=16, h=8, hkv=2, d=8):
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, t, hkv, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, t, hkv, d))
+    return q, k, v
+
+
+def test_gqa_unexpanded_kv_matches_expanded():
+    # kv heads cross the all-to-all unexpanded and broadcast up locally;
+    # result must equal attention over pre-expanded kv
+    q, k, v = _gqa_qkv()
+    mesh = _cp_mesh(2)
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    rep = q.shape[2] // k.shape[2]
+    ref = dense_attention(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_gqa_ring_unexpanded_kv():
+    q, k, v = _gqa_qkv()
+    mesh = _cp_mesh(4)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    rep = q.shape[2] // k.shape[2]
+    ref = dense_attention(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_unknown_attn_impl_raises():
+    from torchft_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=4, d_ff=64,
+        n_layers=1, max_seq_len=16, dtype=jnp.float32, attn_impl="ulyses",
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        tfm.forward(params, tokens, cfg)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = ulysses_attention(q, k, v, _cp_mesh(4))
+    ref = dense_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+def test_grad_flows():
+    q, k, v = _qkv()
+    mesh = _cp_mesh(4)
+
+    def loss(q, k, v):
+        return (ulysses_attention(q, k, v, mesh) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (dense_attention(q, k, v) ** 2).sum()
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, rg in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg), atol=1e-4)
+
+
+def test_transformer_ulysses_matches_dense():
+    from torchft_tpu.models import transformer as tfm
+
+    # n_kv_heads == n_heads here: with tp=2, cp=2 each shard holds 2 query
+    # and 2 kv heads (GQA-with-cp coverage lives in the op-level tests)
+    cfg_dense = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=4, d_ff=64,
+        n_layers=2, max_seq_len=32, dtype=jnp.float32, attn_impl="dense",
+    )
+    cfg_uly = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=4, d_ff=64,
+        n_layers=2, max_seq_len=32, dtype=jnp.float32, attn_impl="ulysses",
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg_dense)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+
+    # cp must divide the per-device head count after tp sharding:
+    # 4 heads / tp=2 -> 2 local heads, cp=2
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 1, 2, 2),
+                ("dp", "fsdp", "cp", "tp"))
+    ref = tfm.forward(params, tokens, cfg_dense)
+    out = tfm.forward(params, tokens, cfg_uly, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=5e-5, rtol=1e-4,
+    )

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchft_tpu.ops.ring_attention import dense_attention, ring_attention_local
+from torchft_tpu.ops.ulysses import ulysses_attention_local
 
 Params = Dict[str, Any]
 
@@ -50,8 +51,15 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
-    # "dense" = single-pass attention (cp must be 1 / unsharded seq);
-    # "ring"  = ring attention, sequence sharded over `cp_axis`.
+    # "dense"   = single-pass attention (cp must be 1 / unsharded seq);
+    # "ring"    = ring attention, sequence sharded over `cp_axis`
+    #             (K/V ppermute ring; memory stays local-T, best for
+    #             extreme sequence lengths);
+    # "ulysses" = all-to-all head-scatter/seq-gather attention over
+    #             `cp_axis`. Needs the PER-TP-SHARD head counts divisible
+    #             by cp: (n_heads/tp) % cp == 0 and (n_kv_heads/tp) % cp
+    #             == 0. One dense attention per head group; best MXU
+    #             utilization at moderate T.
     attn_impl: str = "dense"
     dp_axis: str = "dp"
     fsdp_axis: str = "fsdp"
@@ -161,12 +169,17 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
     act = cfg.dtype
 
     def attention(q, k, v):
-        if cfg.attn_impl == "ring":
+        if cfg.attn_impl in ("ring", "ulysses"):
             if mesh is None:
-                raise ValueError("ring attention requires a mesh")
+                raise ValueError(f"{cfg.attn_impl} attention requires a mesh")
+            local_fn = (
+                ring_attention_local
+                if cfg.attn_impl == "ring"
+                else ulysses_attention_local
+            )
             spec = P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, cfg.tp_axis, None)
             fn = jax.shard_map(
-                lambda q_, k_, v_: ring_attention_local(
+                lambda q_, k_, v_: local_fn(
                     q_, k_, v_, axis_name=cfg.cp_axis, causal=True
                 ),
                 mesh=mesh,
@@ -174,6 +187,11 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 out_specs=spec,
             )
             return fn(q, k, v)
+        if cfg.attn_impl != "dense":
+            raise ValueError(
+                f"unknown attn_impl {cfg.attn_impl!r}; "
+                "expected 'dense', 'ring', or 'ulysses'"
+            )
         return dense_attention(q, k, v, causal=True)
 
     def block(x: jax.Array, p: Params, positions: jax.Array) -> jax.Array:
@@ -184,10 +202,9 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
         v = (h @ p["wv"].astype(act)).reshape(b, t, nkv, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        if nkv != nh:  # GQA: broadcast kv heads up to query heads
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA kv heads stay unexpanded: each attention impl broadcasts them
+        # up AFTER any cross-device transfer (ring ppermute / ulysses
+        # all-to-all move nkv, not nh, heads of K/V)
         attn = attention(q, k, v).reshape(b, t, nh * hd)
         x = x + attn @ p["wo"].astype(act)
 

@@ -40,12 +40,20 @@ def ring_attention_local(
     ``axis_name``; q/k/v are local sequence chunks ``[B, T_local, H, D]``
     (already rotary-embedded with *global* positions by the caller).
 
+    GQA: K/V may carry fewer heads (``H % H_kv == 0``); they rotate around
+    the ring *unexpanded* (H/H_kv fewer ppermute bytes) and are broadcast
+    up to the query heads only inside each tile's einsum.
+
     Returns the local output chunk ``[B, T_local, H, D]`` in q's dtype.
     """
     idx = jax.lax.axis_index(axis_name)
     size = jax.lax.axis_size(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    rep = h // hkv
     scale = 1.0 / math.sqrt(d)
 
     q32 = q.astype(jnp.float32)
@@ -53,12 +61,17 @@ def ring_attention_local(
     def step(carry, s):
         o, m, l, kc, vc = carry
         kv_idx = (idx - s) % size
+        kc32 = kc.astype(jnp.float32)
+        vc32 = vc.astype(jnp.float32)
+        if rep > 1:
+            kc32 = jnp.repeat(kc32, rep, axis=2)
+            vc32 = jnp.repeat(vc32, rep, axis=2)
         # [B, H, Tq, Tk] tile on the MXU; fp32 accumulate.
         scores = (
             jnp.einsum(
                 "bqhd,bkhd->bhqk",
                 q32,
-                kc.astype(jnp.float32),
+                kc32,
                 preferred_element_type=jnp.float32,
             )
             * scale
@@ -79,7 +92,7 @@ def ring_attention_local(
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd",
             p,
-            vc.astype(jnp.float32),
+            vc32,
             preferred_element_type=jnp.float32,
         )
         # Rotate K/V one hop around the ring (neighbor ppermute -> ICI).
@@ -107,8 +120,13 @@ def dense_attention(
 ) -> jax.Array:
     """Plain (single-pass) causal attention over the full sequence,
     ``[B, T, H, D]`` — the cp=1 path; XLA shards it via constraint
-    propagation (batch/head parallel)."""
+    propagation (batch/head parallel). GQA: K/V with fewer heads are
+    broadcast up to the query head count."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scores = (
         jnp.einsum(
             "bqhd,bkhd->bhqk",
