@@ -1,0 +1,29 @@
+"""Actor-supervision example smoke (reference monarch example analog)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_actor_trainer_healthy():
+    out = subprocess.run(
+        [sys.executable, "examples/actor_trainer.py", "--replicas", "2",
+         "--steps", "6"],
+        capture_output=True, text=True, cwd=REPO, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "weights converged bitwise" in out.stdout
+
+
+def test_actor_trainer_chaos_restart():
+    out = subprocess.run(
+        [sys.executable, "examples/actor_trainer.py", "--replicas", "2",
+         "--steps", "12", "--chaos", "--step-time", "0.3"],
+        capture_output=True, text=True, cwd=REPO, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "[chaos] killing trainer" in out.stdout
+    assert "restart 1" in out.stdout
+    assert "weights converged bitwise" in out.stdout

@@ -86,6 +86,27 @@ def test_gqa_ring_unexpanded_kv():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
 
 
+def test_transformer_tp_not_dividing_kv_heads_falls_back():
+    # tp=4 does not divide n_kv_heads=2: the model must pre-expand K/V to a
+    # tp-shardable head count instead of failing in shard_map
+    from torchft_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=8, n_kv_heads=2, d_ff=64,
+        n_layers=1, max_seq_len=16, dtype=jnp.float32, attn_impl="ring",
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 1, 2, 4),
+                ("dp", "fsdp", "cp", "tp"))
+    out = tfm.forward(params, tokens, cfg, mesh=mesh)
+    ref = tfm.forward(
+        params, tokens,
+        tfm.TransformerConfig(**{**cfg.__dict__, "attn_impl": "dense"}),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_unknown_attn_impl_raises():
     from torchft_tpu.models import transformer as tfm
 

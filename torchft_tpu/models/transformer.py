@@ -177,6 +177,16 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 if cfg.attn_impl == "ring"
                 else ulysses_attention_local
             )
+            # K/V normally cross shard_map unexpanded (nkv heads of ppermute
+            # / all-to-all bytes); when tp doesn't divide nkv that layout
+            # isn't shardable, so fall back to pre-expanding to nh heads
+            tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+                cfg.tp_axis, 1
+            )
+            if k.shape[2] % tp_size != 0:
+                rep = nh // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             spec = P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, cfg.tp_axis, None)
             fn = jax.shard_map(
                 lambda q_, k_, v_: local_fn(

@@ -124,6 +124,10 @@ def dense_attention(
     broadcast up to the query head count."""
     d = q.shape[-1]
     if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2] != 0:
+            raise ValueError(
+                f"query heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
+            )
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
@@ -148,6 +152,34 @@ def dense_attention(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def sharded_attention(
+    local_fn,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "cp",
+    causal: bool = True,
+    batch_axes: "Optional[tuple]" = None,
+    head_axis: "Optional[str]" = None,
+) -> jax.Array:
+    """Shared shard_map wrapper for sequence-parallel attention bodies.
+
+    q/k/v: global ``[B, T, H, D]`` with T sharded over ``axis_name``.
+    ``batch_axes``/``head_axis`` name the mesh axes B and H are sharded over
+    (so shard_map's in_specs match the arrays' actual layout). ``local_fn``
+    is a per-shard body with the ring/ulysses signature.
+    """
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(local_fn, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -158,17 +190,9 @@ def ring_attention(
     batch_axes: "Optional[tuple]" = None,
     head_axis: "Optional[str]" = None,
 ) -> jax.Array:
-    """shard_map'd ring attention over ``mesh`` axis ``axis_name``.
-
-    q/k/v: global ``[B, T, H, D]`` with T sharded over ``axis_name``.
-    ``batch_axes``/``head_axis`` name the mesh axes B and H are sharded over
-    (so shard_map's in_specs match the arrays' actual layout).
-    """
-    spec = P(batch_axes, axis_name, head_axis, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    """shard_map'd ring attention over ``mesh`` axis ``axis_name``
+    (see :func:`sharded_attention` for the layout contract)."""
+    return sharded_attention(
+        ring_attention_local, q, k, v, mesh, axis_name, causal,
+        batch_axes, head_axis,
     )
-    return fn(q, k, v)

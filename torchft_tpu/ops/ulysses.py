@@ -20,14 +20,12 @@ mesh/axis contract, so models can switch per config
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from torchft_tpu.ops.ring_attention import dense_attention
+from torchft_tpu.ops.ring_attention import dense_attention, sharded_attention
 
 
 def ulysses_attention_local(
@@ -85,22 +83,13 @@ def ulysses_attention(
     batch_axes: "Optional[tuple]" = None,
     head_axis: "Optional[str]" = None,
 ) -> jax.Array:
-    """shard_map'd Ulysses attention over ``mesh`` axis ``axis_name``.
-
-    Same signature/contract as :func:`ring_attention`: q/k/v are global
-    ``[B, T, H, D]`` with T sharded over ``axis_name``; ``batch_axes`` /
-    ``head_axis`` describe how B and H are additionally sharded.
-    """
-    spec = P(batch_axes, axis_name, head_axis, None)
-    fn = jax.shard_map(
-        functools.partial(
-            ulysses_attention_local, axis_name=axis_name, causal=causal
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    """shard_map'd Ulysses attention over ``mesh`` axis ``axis_name``
+    (same contract as :func:`ring_attention`; see
+    :func:`torchft_tpu.ops.ring_attention.sharded_attention`)."""
+    return sharded_attention(
+        ulysses_attention_local, q, k, v, mesh, axis_name, causal,
+        batch_axes, head_axis,
     )
-    return fn(q, k, v)
 
 
 __all__ = ["ulysses_attention", "ulysses_attention_local"]
