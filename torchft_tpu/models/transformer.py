@@ -61,10 +61,17 @@ class TransformerConfig:
     #             == 0. One dense attention per head group; best MXU
     #             utilization at moderate T.
     attn_impl: str = "dense"
+    # n_experts > 0 replaces the dense FFN with a MoE layer (top-k routed,
+    # experts sharded over `ep_axis`; see torchft_tpu/models/moe.py).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     dp_axis: str = "dp"
     fsdp_axis: str = "fsdp"
     tp_axis: str = "tp"
     cp_axis: str = "cp"
+    ep_axis: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -88,21 +95,48 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
         fan_in = shape[-2]
         return (jax.random.normal(key, shape, pd) / np.sqrt(fan_in)).astype(pd)
 
+    blocks = {
+        "attn_norm": jnp.ones((l, e), pd),
+        "wq": dense(keys[1], l, e, nh * hd),
+        "wk": dense(keys[2], l, e, nkv * hd),
+        "wv": dense(keys[3], l, e, nkv * hd),
+        "wo": dense(keys[4], l, nh * hd, e),
+        "mlp_norm": jnp.ones((l, e), pd),
+    }
+    if cfg.n_experts:
+        from torchft_tpu.models.moe import init_moe_params
+
+        blocks.update(init_moe_params(keys[5], _moe_cfg(cfg), n_layers=l))
+    else:
+        blocks.update(
+            {
+                "w_gate": dense(keys[5], l, e, f),
+                "w_up": dense(keys[6], l, e, f),
+                "w_down": dense(keys[7], l, f, e),
+            }
+        )
     return {
         "embed": jax.random.normal(keys[0], (cfg.vocab_size, e), pd) * 0.02,
-        "blocks": {
-            "attn_norm": jnp.ones((l, e), pd),
-            "wq": dense(keys[1], l, e, nh * hd),
-            "wk": dense(keys[2], l, e, nkv * hd),
-            "wv": dense(keys[3], l, e, nkv * hd),
-            "wo": dense(keys[4], l, nh * hd, e),
-            "mlp_norm": jnp.ones((l, e), pd),
-            "w_gate": dense(keys[5], l, e, f),
-            "w_up": dense(keys[6], l, e, f),
-            "w_down": dense(keys[7], l, f, e),
-        },
+        "blocks": blocks,
         "final_norm": jnp.ones((e,), pd),
     }
+
+
+def _moe_cfg(cfg: TransformerConfig):
+    from torchft_tpu.models.moe import MoEConfig
+
+    return MoEConfig(
+        d_model=cfg.d_model,
+        d_ff=cfg.d_ff,
+        n_experts=cfg.n_experts,
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        ep_axis=cfg.ep_axis,
+        fsdp_axis=cfg.fsdp_axis,
+        tp_axis=cfg.tp_axis,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
 
 
 def param_specs(cfg: TransformerConfig) -> Params:
@@ -110,26 +144,50 @@ def param_specs(cfg: TransformerConfig) -> Params:
     (fsdp x tp); the stacked layer dim stays unsharded so `lax.scan` slices
     locally."""
     fs, tp = cfg.fsdp_axis, cfg.tp_axis
+    blocks = {
+        "attn_norm": P(None, None),
+        "wq": P(None, fs, tp),
+        "wk": P(None, fs, tp),
+        "wv": P(None, fs, tp),
+        "wo": P(None, tp, fs),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.n_experts:
+        from torchft_tpu.models.moe import moe_param_specs
+
+        blocks.update(moe_param_specs(_moe_cfg(cfg), stacked=True))
+    else:
+        blocks.update(
+            {
+                "w_gate": P(None, fs, tp),
+                "w_up": P(None, fs, tp),
+                "w_down": P(None, tp, fs),
+            }
+        )
     return {
         "embed": P(tp, fs),
-        "blocks": {
-            "attn_norm": P(None, None),
-            "wq": P(None, fs, tp),
-            "wk": P(None, fs, tp),
-            "wv": P(None, fs, tp),
-            "wo": P(None, tp, fs),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, fs, tp),
-            "w_up": P(None, fs, tp),
-            "w_down": P(None, tp, fs),
-        },
+        "blocks": blocks,
         "final_norm": P(None),
     }
 
 
-def batch_spec(cfg: TransformerConfig) -> P:
-    """Tokens [B, T]: batch over (dp, fsdp), sequence over cp."""
-    return P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis)
+def _batch_axes(cfg: TransformerConfig, mesh: "Optional[Mesh]") -> tuple:
+    """Mesh axes the batch dim shards over: (dp, fsdp) plus ep when it
+    exists — ep rides the batch dims so non-MoE compute is data-parallel
+    over ep shards instead of replicated; inside the MoE layer the
+    [E, C, d] constraint re-shards tokens expert-wise (the GShard
+    ep-borrowed-from-dp layout)."""
+    axes = [cfg.dp_axis, cfg.fsdp_axis]
+    if (mesh is not None and cfg.ep_axis in mesh.axis_names) or (
+        mesh is None and cfg.n_experts
+    ):
+        axes.append(cfg.ep_axis)
+    return tuple(axes)
+
+
+def batch_spec(cfg: TransformerConfig, mesh: "Optional[Mesh]" = None) -> P:
+    """Tokens [B, T]: batch over (dp, fsdp[, ep]), sequence over cp."""
+    return P(_batch_axes(cfg, mesh), cfg.cp_axis)
 
 
 def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
@@ -187,7 +245,7 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 rep = nh // k.shape[2]
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            spec = P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, cfg.tp_axis, None)
+            spec = P(_batch_axes(cfg, mesh), cfg.cp_axis, cfg.tp_axis, None)
             fn = jax.shard_map(
                 lambda q_, k_, v_: local_fn(
                     q_, k_, v_, axis_name=cfg.cp_axis, causal=True
@@ -204,7 +262,7 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
             )
         return dense_attention(q, k, v, causal=True)
 
-    def block(x: jax.Array, p: Params, positions: jax.Array) -> jax.Array:
+    def block(x: jax.Array, p: Params, positions: jax.Array):
         b, t, e = x.shape
         h = _rms_norm(x, p["attn_norm"])
         q = (h @ p["wq"].astype(act)).reshape(b, t, nh, hd)
@@ -219,10 +277,15 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
         x = x + attn @ p["wo"].astype(act)
 
         h = _rms_norm(x, p["mlp_norm"])
+        if cfg.n_experts:
+            from torchft_tpu.models.moe import moe_ffn
+
+            y, aux = moe_ffn(h, p, _moe_cfg(cfg), mesh=mesh)
+            return x + y, aux
         gate = jax.nn.silu(h @ p["w_gate"].astype(act))
         up = h @ p["w_up"].astype(act)
         x = x + (gate * up) @ p["w_down"].astype(act)
-        return x
+        return x, jnp.zeros((), jnp.float32)
 
     return block
 
@@ -232,12 +295,14 @@ def forward(
     tokens: jax.Array,
     cfg: TransformerConfig,
     mesh: "Optional[Mesh]" = None,
+    return_aux: bool = False,
 ) -> jax.Array:
     """tokens [B, T] int32 -> logits [B, T, vocab] (fp32).
 
     With a mesh, activations get sharding constraints so XLA places the tp
     collectives; without one it is a plain single-device program (the
-    `entry()` compile-check path).
+    `entry()` compile-check path). ``return_aux`` additionally returns the
+    summed MoE load-balance loss (0 for dense FFN configs).
     """
     b, t = tokens.shape
     act = cfg.dtype
@@ -256,7 +321,7 @@ def forward(
 
     if mesh is not None:
         act_spec = NamedSharding(
-            mesh, P((cfg.dp_axis, cfg.fsdp_axis), cfg.cp_axis, None)
+            mesh, P(_batch_axes(cfg, mesh), cfg.cp_axis, None)
         )
         x = jax.lax.with_sharding_constraint(x, act_spec)
 
@@ -264,13 +329,16 @@ def forward(
     if cfg.remat:
         block = jax.checkpoint(block)
 
-    def scan_body(x, layer_params):
-        x = block(x, layer_params, positions)
+    def scan_body(carry, layer_params):
+        x, aux_sum = carry
+        x, aux = block(x, layer_params, positions)
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
-        return x, None
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    )
     x = _rms_norm(x, params["final_norm"])
     # Tied output head: [B,T,E] x [E,V] on the MXU, fp32 logits.
     logits = jnp.einsum(
@@ -279,6 +347,8 @@ def forward(
         params["embed"].astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
+    if return_aux:
+        return logits, aux_sum
     return logits
 
 
@@ -288,12 +358,17 @@ def loss_fn(
     cfg: TransformerConfig,
     mesh: "Optional[Mesh]" = None,
 ) -> jax.Array:
-    """Next-token cross-entropy, mean over all positions but the last."""
-    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    """Next-token cross-entropy, mean over all positions but the last.
+    MoE configs add the weighted load-balance auxiliary loss."""
+    logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    loss = nll.mean()
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------
