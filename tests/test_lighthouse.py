@@ -215,3 +215,24 @@ class TestLighthouse:
             assert status["quorum_id"] == 1
             assert status["prev_quorum"]["participants"][0]["replica_id"] == "s"
             client.close()
+
+
+class TestCoordinationDocs:
+    def test_public_api_documented(self):
+        """Every public coordination class + method carries a docstring
+        (reference: torchft/coordination_test.py:15)."""
+        import inspect
+
+        from torchft_tpu import coordination as c
+
+        classes = [
+            c.LighthouseServer, c.LighthouseClient, c.ManagerServer,
+            c.ManagerClient, c.StoreServer, c.StoreClient,
+            c.Quorum, c.QuorumMember, c.QuorumResult,
+        ]
+        for cls in classes:
+            assert cls.__doc__ and cls.__doc__.strip(), cls
+            for name, fn in inspect.getmembers(cls, predicate=inspect.isfunction):
+                if name.startswith("_"):
+                    continue
+                assert fn.__doc__ and fn.__doc__.strip(), f"{cls.__name__}.{name}"

@@ -63,6 +63,7 @@ class QuorumMember:
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "QuorumMember":
+        """Build from the wire-protocol dict (tolerates missing fields)."""
         return QuorumMember(
             replica_id=d.get("replica_id", ""),
             address=d.get("address", ""),
@@ -75,6 +76,7 @@ class QuorumMember:
         )
 
     def to_dict(self) -> Dict[str, Any]:
+        """Wire-protocol dict for RPC payloads."""
         return {
             "replica_id": self.replica_id,
             "address": self.address,
@@ -95,6 +97,7 @@ class Quorum:
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Quorum":
+        """Build from the wire-protocol dict."""
         return Quorum(
             quorum_id=d.get("quorum_id", 0),
             participants=[QuorumMember.from_dict(p) for p in d.get("participants", [])],
@@ -124,6 +127,7 @@ class QuorumResult:
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "QuorumResult":
+        """Build from the wire-protocol dict."""
         return QuorumResult(
             quorum_id=d.get("quorum_id", 0),
             replica_rank=d.get("replica_rank", 0),
@@ -274,9 +278,11 @@ class _NativeServer:
         )
 
     def address(self) -> str:
+        """``host:port`` the server is listening on (resolves port 0)."""
         return self._address
 
     def shutdown(self) -> None:
+        """Stop the server and release its socket; idempotent."""
         if self._handle is not None:
             _native.get_lib().tft_server_shutdown(self._handle)
             self._handle = None
@@ -390,6 +396,11 @@ class LighthouseClient:
         commit_failures: int = 0,
         data: "Dict[str, Any] | None" = None,
     ) -> Quorum:
+        """Join the next quorum as ``replica_id`` and block until it forms.
+
+        Doubles as an implicit heartbeat (reference src/lighthouse.rs:
+        498-544); ``data`` is an opaque JSON dict carried to all members.
+        """
         member = QuorumMember(
             replica_id=replica_id,
             address=address,
@@ -404,12 +415,15 @@ class LighthouseClient:
         return Quorum.from_dict(result["quorum"])
 
     def heartbeat(self, replica_id: str, timeout: "float | timedelta" = 5.0) -> None:
+        """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms."""
         self._client.call("heartbeat", {"replica_id": replica_id}, timeout)
 
     def status(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
+        """Quorum/participant/heartbeat snapshot (the dashboard's data)."""
         return self._client.call("status", {}, timeout)
 
     def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
         self._client.close()
 
 
@@ -460,6 +474,8 @@ class ManagerClient:
         should_commit: bool,
         timeout: "float | timedelta",
     ) -> bool:
+        """Vote on committing ``step``; blocks until all group ranks vote and
+        returns the AND across them (reference src/manager.rs:423-479)."""
         result = self._client.call(
             "should_commit",
             {"group_rank": group_rank, "step": step, "should_commit": should_commit},
@@ -468,12 +484,14 @@ class ManagerClient:
         return result["should_commit"]
 
     def kill(self, msg: str = "", timeout: "float | timedelta" = 5.0) -> None:
+        """Ask the remote replica's manager to exit its process."""
         try:
             self._client.call("kill", {"msg": msg}, timeout)
         except (TimeoutError, ConnectionError, RpcError):
             pass  # the remote process exits mid-RPC by design
 
     def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
         self._client.close()
 
 
@@ -489,22 +507,27 @@ class StoreClient:
         self._client = _RpcClient(addr, ct)
 
     def set(self, key: str, value: str, timeout: "float | timedelta" = 10.0) -> None:
+        """Publish ``key`` (wakes any blocked ``get(wait=True)``)."""
         self._client.call("set", {"key": key, "value": value}, timeout)
 
     def get(
         self, key: str, timeout: "float | timedelta" = 10.0, wait: bool = True
     ) -> str:
+        """Read ``key``; with ``wait`` blocks until it is set or timeout."""
         result = self._client.call("get", {"key": key, "wait": wait}, timeout)
         return result["value"]
 
     def delete_prefix(self, prefix: str, timeout: "float | timedelta" = 10.0) -> int:
+        """Remove all keys under ``prefix``; returns the count removed."""
         result = self._client.call("delete_prefix", {"prefix": prefix}, timeout)
         return result["removed"]
 
     def num_keys(self, timeout: "float | timedelta" = 10.0) -> int:
+        """Total keys currently stored (tests/diagnostics)."""
         return self._client.call("num_keys", {}, timeout)["count"]
 
     def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
         self._client.close()
 
 

@@ -7,12 +7,16 @@ model family built natively: pure-functional JAX (params are a pytree),
 bfloat16 compute with fp32 master params, RMSNorm + rotary embeddings + GQA
 + SwiGLU, layers stacked and iterated with `lax.scan` (one trace per block,
 fast compiles at depth), optional `jax.checkpoint` rematerialization, and a
-4-D parallelism story expressed as `PartitionSpec`s:
+multi-axis parallelism story expressed as `PartitionSpec`s:
 
 - ``dp``   data-parallel replicas *within* a slice (pure batch dim),
 - ``fsdp`` fully-sharded data parallel (params sharded over it, batch too),
 - ``tp``   tensor parallel (attention heads / MLP hidden),
-- ``cp``   context parallel (sequence; ring attention over this axis).
+- ``cp``   context parallel (sequence; ring or Ulysses attention),
+- ``ep``   expert parallel (MoE experts; rides the batch dims elsewhere).
+
+Pipeline parallelism is a separate composition primitive
+(torchft_tpu/parallel/pipeline.py) for stacked-layer stacks.
 
 The elastic FT replica dimension deliberately does NOT appear here: it lives
 above jit in the Manager (zero-fill + divide-by-participants keeps compiled
@@ -397,7 +401,7 @@ def make_train_step(
 
     pspecs = param_specs(cfg)
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
-    batch_sh = NamedSharding(mesh, batch_spec(cfg))
+    batch_sh = NamedSharding(mesh, batch_spec(cfg, mesh))
     return jax.jit(
         step,
         in_shardings=(param_sh, None, batch_sh),
@@ -421,7 +425,7 @@ def make_grad_step(
         return jax.jit(step)
     pspecs = param_specs(cfg)
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
-    batch_sh = NamedSharding(mesh, batch_spec(cfg))
+    batch_sh = NamedSharding(mesh, batch_spec(cfg, mesh))
     return jax.jit(
         step,
         in_shardings=(param_sh, batch_sh),

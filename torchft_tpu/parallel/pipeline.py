@@ -1,0 +1,162 @@
+"""Pipeline parallelism: a GPipe schedule over a ``pp`` mesh axis.
+
+A TPU-first capability beyond the reference (which has no pipeline
+schedule — SURVEY §2.3: torch pipelining appears there only as a
+model-splitting tool for DiLoCo fragments). Layer-stacked parameters
+``[L, ...]`` are sharded over the ``pp`` axis (each stage holds ``L/S``
+consecutive layers); inside ``shard_map`` the classic GPipe tick loop runs
+as a ``lax.scan``: at tick ``t`` stage ``s`` processes microbatch
+``t - s``, then activations hop one stage forward via neighbor
+``ppermute`` (riding ICI). Reverse-mode AD through the scan + ppermute
+gives the backward schedule for free.
+
+Shapes are fully static: every stage computes every tick (bubble ticks are
+masked with ``where``), so the whole schedule jits once. Bubble overhead is
+the standard ``(S-1)/(M+S-1)`` — pick ``microbatches >= 4*stages`` to
+amortize.
+
+Composes with the other axes: the per-stage ``fn`` may itself use tp/cp
+collectives (its shard_map axis names remain visible), and dp/fsdp shard
+the microbatch dim through ``in_specs``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Any
+
+
+def _stage_apply(
+    fn: "Callable[[jax.Array, Params], jax.Array]",
+    x: jax.Array,
+    stage_params: Params,
+) -> jax.Array:
+    """Run this stage's local layer stack ``[L/S, ...]`` over x."""
+
+    def body(h, layer_params):
+        return fn(h, layer_params), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline_apply_local(
+    params: Params,
+    microbatches: jax.Array,
+    fn: "Callable[[jax.Array, Params], jax.Array]",
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Per-shard GPipe body; must run inside shard_map over ``axis_name``.
+
+    Args:
+        params: this stage's layer stack, pytree with leading ``[L/S]`` dim.
+        microbatches: ``[M, mb, ...]`` — full microbatch set (replicated
+            across stages; only stage 0 feeds it into the pipe).
+        fn: one decoder-layer step ``fn(x, layer_params) -> x``.
+
+    Returns ``[M, mb, ...]`` outputs, identical on every stage (the last
+    stage's results are broadcast back via psum).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    m = microbatches.shape[0]
+    n_ticks = m + size - 1
+    perm_fwd = [(i, i + 1) for i in range(size - 1)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        mb_idx = t - stage
+        active = (mb_idx >= 0) & (mb_idx < m)
+        # stage 0 pulls the next microbatch; later stages consume the
+        # activation that hopped in last tick
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(mb_idx, 0, m - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage == 0, feed, buf)
+        y = _stage_apply(fn, x_in, params)
+        # bubble ticks produce garbage; zero it so the output scatter and
+        # the ppermute hand clean values downstream
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        is_last = stage == size - 1
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(
+                active & is_last,
+                y,
+                jax.lax.dynamic_index_in_dim(
+                    outputs, jnp.clip(mb_idx, 0, m - 1), axis=0, keepdims=False
+                ),
+            ),
+            jnp.clip(mb_idx, 0, m - 1),
+            axis=0,
+        )
+        buf = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return (buf, outputs), None
+
+    # pvary: the carry becomes device-varying after one tick (it depends on
+    # the stage index), so the initial carry must carry the same varying-
+    # axis type or scan rejects the carry signature (shard_map vma rule)
+    _pcast = getattr(jax.lax, "pcast", None)
+    if _pcast is not None:
+        buf0 = _pcast(jnp.zeros_like(microbatches[0]), axis_name, to="varying")
+        out0 = _pcast(jnp.zeros_like(microbatches), axis_name, to="varying")
+    else:  # older jax
+        buf0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
+        out0 = jax.lax.pvary(jnp.zeros_like(microbatches), (axis_name,))
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
+    # only the last stage holds real outputs; broadcast to all stages
+    return jax.lax.psum(
+        jnp.where(stage == size - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+
+
+def pipeline_apply(
+    params: Params,
+    x: jax.Array,
+    fn: "Callable[[jax.Array, Params], jax.Array]",
+    mesh: Mesh,
+    axis_name: str = "pp",
+    microbatches: int = 4,
+    batch_axes: "Optional[tuple]" = None,
+) -> jax.Array:
+    """GPipe-apply a stacked-layer model over the ``pp`` mesh axis.
+
+    Args:
+        params: pytree with leading layer dim ``[L]``; ``L`` must divide by
+            the pp axis size (each stage takes ``L/S`` consecutive layers).
+        x: ``[B, ...]`` activations; ``B`` must divide by ``microbatches``.
+        fn: one layer step ``fn(x_mb, layer_params) -> x_mb``.
+        mesh: mesh containing ``axis_name``.
+        microbatches: GPipe microbatch count M (bubble = (S-1)/(M+S-1)).
+        batch_axes: mesh axes the batch dim is sharded over (dp/fsdp);
+            they shard the *microbatch* dim inside the pipe.
+
+    Returns ``[B, ...]`` outputs with x's sharding.
+    """
+    b = x.shape[0]
+    if b % microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {microbatches}")
+    mb = b // microbatches
+    x_mb = x.reshape((microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), params
+    )
+    data_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+
+    out = jax.shard_map(
+        functools.partial(pipeline_apply_local, fn=fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, data_spec),
+        out_specs=data_spec,
+    )(params, x_mb)
+    return out.reshape(x.shape)
+
+
+__all__ = ["pipeline_apply", "pipeline_apply_local"]
