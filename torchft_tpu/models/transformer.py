@@ -309,18 +309,7 @@ def forward(
     summed MoE load-balance loss (0 for dense FFN configs).
     """
     b, t = tokens.shape
-    act = cfg.dtype
-    if mesh is not None:
-        # One-hot matmul instead of gather: runs on the MXU and partitions
-        # cleanly when embed is sharded (tp, fsdp) — XLA's SPMD partitioner
-        # fully rematerializes a sharded gather.
-        x = jnp.einsum(
-            "btv,ve->bte",
-            jax.nn.one_hot(tokens, cfg.vocab_size, dtype=act),
-            params["embed"].astype(act),
-        )
-    else:
-        x = params["embed"].astype(act)[tokens]
+    x = _embed(params, tokens, cfg, sharded=mesh is not None)
     positions = jnp.arange(t)
 
     if mesh is not None:
@@ -343,17 +332,88 @@ def forward(
     (x, aux_sum), _ = jax.lax.scan(
         scan_body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
     )
+    logits = _head(params, x)
+    if return_aux:
+        return logits, aux_sum
+    return logits
+
+
+def _embed(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig, sharded: bool
+) -> jax.Array:
+    """Token embedding [B, T] -> [B, T, E].
+
+    Sharded path: one-hot matmul instead of gather — runs on the MXU and
+    partitions cleanly when embed is sharded (tp, fsdp); XLA's SPMD
+    partitioner fully rematerializes a sharded gather.
+    """
+    act = cfg.dtype
+    if sharded:
+        return jnp.einsum(
+            "btv,ve->bte",
+            jax.nn.one_hot(tokens, cfg.vocab_size, dtype=act),
+            params["embed"].astype(act),
+        )
+    return params["embed"].astype(act)[tokens]
+
+
+def _head(params: Params, x: jax.Array) -> jax.Array:
+    """Final norm + tied output head: [B,T,E] x [E,V] on the MXU, fp32."""
     x = _rms_norm(x, params["final_norm"])
-    # Tied output head: [B,T,E] x [E,V] on the MXU, fp32 logits.
-    logits = jnp.einsum(
+    return jnp.einsum(
         "bte,ve->btv",
         x.astype(jnp.float32),
         params["embed"].astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
-    if return_aux:
-        return logits, aux_sum
-    return logits
+
+
+def forward_pipelined(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    microbatches: int = 4,
+    pp_axis: str = "pp",
+) -> jax.Array:
+    """Pipeline-parallel forward: decoder blocks GPipe-scheduled over the
+    ``pp`` mesh axis (torchft_tpu/parallel/pipeline.py), embedding/head
+    outside the pipe.
+
+    Each stage holds ``n_layers / pp`` consecutive blocks (the stacked
+    layer dim is sharded over pp). Restrictions of this v1: dense attention
+    and dense FFN only — ring/ulysses/MoE use their own shard_map /
+    sharding constraints, which do not nest inside the pipeline's
+    shard_map.
+    """
+    if cfg.attn_impl != "dense" or cfg.n_experts:
+        raise ValueError(
+            "forward_pipelined supports dense attention + dense FFN only"
+        )
+    from torchft_tpu.parallel.pipeline import pipeline_apply
+
+    t = tokens.shape[1]
+    x = _embed(params, tokens, cfg, sharded=True)
+    positions = jnp.arange(t)
+    block = _make_block(cfg, None)
+
+    def layer_fn(h, layer_params):
+        return block(h, layer_params, positions)[0]
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    # pipeline_apply is partial-manual over pp only: batch (dp/fsdp/ep) and
+    # weight (fsdp/tp) shardings flow automatically from input shardings
+    x = pipeline_apply(
+        params["blocks"],
+        x,
+        layer_fn,
+        mesh,
+        axis_name=pp_axis,
+        microbatches=microbatches,
+    )
+    return _head(params, x)
 
 
 def loss_fn(

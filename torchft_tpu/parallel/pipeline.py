@@ -127,6 +127,12 @@ def pipeline_apply(
 ) -> jax.Array:
     """GPipe-apply a stacked-layer model over the ``pp`` mesh axis.
 
+    The shard_map is *partial-manual* (``axis_names={pp}``): only the
+    pipeline axis is handled manually (the tick loop + ppermutes); every
+    other mesh axis stays automatic, so dp/fsdp batch sharding and fsdp/tp
+    weight sharding flow through from the inputs' shardings with XLA
+    placing the collectives — stage weights are NOT replicated.
+
     Args:
         params: pytree with leading layer dim ``[L]``; ``L`` must divide by
             the pp axis size (each stage takes ``L/S`` consecutive layers).
@@ -134,11 +140,20 @@ def pipeline_apply(
         fn: one layer step ``fn(x_mb, layer_params) -> x_mb``.
         mesh: mesh containing ``axis_name``.
         microbatches: GPipe microbatch count M (bubble = (S-1)/(M+S-1)).
-        batch_axes: mesh axes the batch dim is sharded over (dp/fsdp);
-            they shard the *microbatch* dim inside the pipe.
+        batch_axes: unused (kept for call-site stability); batch sharding
+            over dp/fsdp/ep is automatic in partial-manual mode.
 
     Returns ``[B, ...]`` outputs with x's sharding.
     """
+    del batch_axes  # automatic in partial-manual mode
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    stages = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    n_layers = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if n_layers % stages != 0:
+        raise ValueError(
+            f"layer count {n_layers} not divisible by pp axis size {stages}"
+        )
     b = x.shape[0]
     if b % microbatches != 0:
         raise ValueError(f"batch {b} not divisible by microbatches {microbatches}")
@@ -148,13 +163,14 @@ def pipeline_apply(
     param_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), params
     )
-    data_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    data_spec = P(*([None] * (x.ndim + 1)))
 
     out = jax.shard_map(
         functools.partial(pipeline_apply_local, fn=fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(param_specs, data_spec),
         out_specs=data_spec,
+        axis_names={axis_name},
     )(params, x_mb)
     return out.reshape(x.shape)
 
