@@ -340,6 +340,41 @@ class TestWrappers:
         assert pg.rank() == 0
 
 
+class TestBucketing:
+    def test_many_mixed_leaves_roundtrip(self, store):
+        # mixed dtypes + a leaf above BUCKET_BYTES: bucketing must preserve
+        # order, dtypes, shapes, and values
+        world = 2
+        pgs = make_group(store, world, "bucket")
+        rng = np.random.default_rng(0)
+        big = ProcessGroupTCP.BUCKET_BYTES // 4 + 100  # f32 elems, solo path
+        leaves = [
+            rng.standard_normal((5, 3)).astype(np.float32),
+            (rng.standard_normal(7) * 10).astype(np.int32),
+            rng.standard_normal(big).astype(np.float32),
+            rng.standard_normal((2, 2, 2)).astype(np.float64),
+            rng.standard_normal(11).astype(np.float32),
+            (rng.standard_normal(4) * 10).astype(np.int32),
+        ]
+
+        def run(rank, _):
+            return pgs[rank].allreduce([l.copy() for l in leaves], REDUCE_SUM).wait(
+                timeout=30
+            )
+
+        results = run_parallel(world, run)
+        for res in results:
+            assert len(res) == len(leaves)
+            for out, inp in zip(res, leaves):
+                assert out.dtype == inp.dtype and out.shape == inp.shape
+                np.testing.assert_allclose(
+                    out.astype(np.float64), inp.astype(np.float64) * world,
+                    rtol=1e-6,
+                )
+        for pg in pgs:
+            pg.shutdown()
+
+
 class TestNumerics:
     def test_bfloat16_allreduce_and_sendrecv(self, store):
         # bf16 is THE TPU training dtype; ml_dtypes arrays have no buffer-

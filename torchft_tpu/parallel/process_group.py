@@ -629,9 +629,77 @@ class ProcessGroupTCP(ProcessGroup):
 
         def run() -> List[np.ndarray]:
             deadline = time.monotonic() + deadline_budget
-            return [self._allreduce_one(a, op, deadline) for a in np_arrays]
+            return self._allreduce_coalesced(np_arrays, op, deadline)
 
         return self._submit(run)
+
+    # Pack small same-acc-dtype leaves into buckets up to this many bytes.
+    # Below the cap, coalescing wins (one ring amortizes per-message
+    # latency: measured 10x at 28 tiny leaves); above it, the extra
+    # concat/split memcpy costs more than the saved round trips, so big
+    # leaves ring solo (zero-copy path).
+    BUCKET_BYTES = 4 * 1024 * 1024
+
+    def _allreduce_coalesced(
+        self, arrays: "List[np.ndarray]", op: str, deadline: float
+    ) -> "List[np.ndarray]":
+        """Bucketized allreduce of a gradient pytree's leaves.
+
+        A gradient pytree is many small leaves; ringing each one costs
+        2*(w-1) latency-bound exchanges per leaf. Same-accumulation-dtype
+        leaves pack greedily into <= BUCKET_BYTES buckets that ring once
+        (the reference's bucketized-allreduce idea,
+        TORCHFT_USE_BUCKETIZATION, local_sgd.py:29); oversized leaves ring
+        solo on the zero-copy path. Order-preserving.
+        """
+        if len(arrays) <= 1 or self._world == 1:
+            # world==1: _allreduce_one is a pure copy; skip bucketing work
+            # entirely (the post-failure shrunken-group hot path)
+            return [self._allreduce_one(a, op, deadline) for a in arrays]
+        # greedy same-dtype buckets, capped
+        buckets: "List[Tuple[np.dtype, List[int], int]]" = []  # (acc, idxs, bytes)
+        open_bucket: "Dict[np.dtype, int]" = {}  # acc dtype -> bucket index
+        for i, a in enumerate(arrays):
+            acc = _accumulation_dtype(a.dtype)
+            nbytes = a.size * acc.itemsize
+            if nbytes >= self.BUCKET_BYTES:
+                buckets.append((acc, [i], nbytes))
+                continue
+            bi = open_bucket.get(acc)
+            if bi is not None and buckets[bi][2] + nbytes <= self.BUCKET_BYTES:
+                buckets[bi][1].append(i)
+                buckets[bi] = (acc, buckets[bi][1], buckets[bi][2] + nbytes)
+            else:
+                buckets.append((acc, [i], nbytes))
+                open_bucket[acc] = len(buckets) - 1
+
+        results: "List[Optional[np.ndarray]]" = [None] * len(arrays)
+        for acc_dtype, idxs, _ in buckets:
+            if len(idxs) == 1:
+                i = idxs[0]
+                results[i] = self._allreduce_one(arrays[i], op, deadline)
+                continue
+            # cast leaves individually: mixed input dtypes sharing one acc
+            # dtype (f16+f32, bf16) may not have a numpy promotion rule
+            flat = np.concatenate(
+                [
+                    np.ascontiguousarray(arrays[i])
+                    .ravel()
+                    .astype(acc_dtype, copy=False)
+                    for i in idxs
+                ]
+            )
+            reduced = self._allreduce_one(flat, op, deadline)
+            off = 0
+            for i in idxs:
+                n = arrays[i].size
+                results[i] = (
+                    reduced[off : off + n]
+                    .astype(arrays[i].dtype, copy=False)
+                    .reshape(arrays[i].shape)
+                )
+                off += n
+        return results  # type: ignore[return-value]
 
     def _allreduce_one(self, array: np.ndarray, op: str, deadline: float) -> np.ndarray:
         w, r = self._world, self._rank
