@@ -44,6 +44,7 @@ class DiLoCoRunner:
         n_fragments: int = 2,
         algo: str = "diloco",
         inner_sleep: float = 0.0,
+        quantize: bool = False,
     ) -> None:
         self.replica_id = replica_id
         self.lighthouse_addr = lighthouse_addr
@@ -53,6 +54,7 @@ class DiLoCoRunner:
         self.n_fragments = n_fragments
         self.algo = algo
         self.inner_sleep = inner_sleep
+        self.quantize = quantize
 
     def run(self) -> dict:
         for attempt in range(3):
@@ -101,6 +103,7 @@ class DiLoCoRunner:
                     set_params,
                     optax.sgd(0.5, momentum=0.9, nesterov=True),
                     sync_every=self.sync_every,
+                    should_quantize=self.quantize,
                 )
             else:
                 algo = LocalSGD(manager, get_params, set_params, self.sync_every)
@@ -170,6 +173,21 @@ class TestDiLoCoInteg:
         ]
         results = run_replicas(runners)
         # step counts fragment syncs: 3 rounds x 2 fragments
+        assert all(r["manager_state"]["step"] == 6 for r in results)
+        assert_params_equal(results)
+
+    def test_diloco_quantized_allreduce(self, lighthouse):
+        # int8-quantized pseudogradient exchange: lossy vs f32, but the
+        # dequantized result is identical bytes on every replica, so
+        # cross-replica bitwise equality still holds
+        injector = EventInjector()
+        runners = [
+            DiLoCoRunner(
+                i, lighthouse.address(), injector, outer_syncs=3, quantize=True
+            )
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
         assert all(r["manager_state"]["step"] == 6 for r in results)
         assert_params_equal(results)
 

@@ -107,6 +107,20 @@ def test_transformer_tp_not_dividing_kv_heads_falls_back():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_cp_less_mesh_raises_clearly():
+    from torchft_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=4, d_ff=64,
+        n_layers=1, max_seq_len=16, dtype=jnp.float32, attn_impl="ring",
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("fsdp", "tp"))
+    with pytest.raises(ValueError, match="requires a 'cp' mesh axis"):
+        tfm.forward(params, tokens, cfg, mesh=mesh)
+
+
 def test_unknown_attn_impl_raises():
     from torchft_tpu.models import transformer as tfm
 

@@ -180,18 +180,31 @@ def _batch_axes(cfg: TransformerConfig, mesh: "Optional[Mesh]") -> tuple:
     exists — ep rides the batch dims so non-MoE compute is data-parallel
     over ep shards instead of replicated; inside the MoE layer the
     [E, C, d] constraint re-shards tokens expert-wise (the GShard
-    ep-borrowed-from-dp layout)."""
+    ep-borrowed-from-dp layout).
+
+    With a mesh, axes are filtered to those present and deduped, so
+    partial meshes (e.g. an inner HSDP mesh with only fsdp/tp) and axis
+    aliasing (dp_axis == fsdp_axis) both work.
+    """
     axes = [cfg.dp_axis, cfg.fsdp_axis]
     if (mesh is not None and cfg.ep_axis in mesh.axis_names) or (
         mesh is None and cfg.n_experts
     ):
         axes.append(cfg.ep_axis)
-    return tuple(axes)
+    if mesh is not None:
+        axes = [a for a in axes if a in mesh.axis_names]
+    return tuple(dict.fromkeys(axes))  # dedupe, order-preserving
+
+
+def _seq_axis(cfg: TransformerConfig, mesh: "Optional[Mesh]") -> "Optional[str]":
+    if mesh is not None and cfg.cp_axis not in mesh.axis_names:
+        return None
+    return cfg.cp_axis
 
 
 def batch_spec(cfg: TransformerConfig, mesh: "Optional[Mesh]" = None) -> P:
     """Tokens [B, T]: batch over (dp, fsdp[, ep]), sequence over cp."""
-    return P(_batch_axes(cfg, mesh), cfg.cp_axis)
+    return P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh))
 
 
 def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
@@ -234,6 +247,12 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
         if cfg.attn_impl in ("ring", "ulysses"):
             if mesh is None:
                 raise ValueError(f"{cfg.attn_impl} attention requires a mesh")
+            if cfg.cp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"{cfg.attn_impl} attention requires a {cfg.cp_axis!r} "
+                    f"mesh axis; this mesh has {mesh.axis_names} "
+                    "(use attn_impl='dense' on cp-less meshes)"
+                )
             local_fn = (
                 ring_attention_local
                 if cfg.attn_impl == "ring"
@@ -249,7 +268,7 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 rep = nh // k.shape[2]
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            spec = P(_batch_axes(cfg, mesh), cfg.cp_axis, cfg.tp_axis, None)
+            spec = P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh), cfg.tp_axis, None)
             fn = jax.shard_map(
                 lambda q_, k_, v_: local_fn(
                     q_, k_, v_, axis_name=cfg.cp_axis, causal=True
@@ -314,7 +333,7 @@ def forward(
 
     if mesh is not None:
         act_spec = NamedSharding(
-            mesh, P(_batch_axes(cfg, mesh), cfg.cp_axis, None)
+            mesh, P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh), None)
         )
         x = jax.lax.with_sharding_constraint(x, act_spec)
 
