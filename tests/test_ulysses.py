@@ -115,8 +115,8 @@ def test_cp_less_mesh_raises_clearly():
         n_layers=1, max_seq_len=16, dtype=jnp.float32, attn_impl="ring",
     )
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-    tokens = jnp.zeros((2, 8), jnp.int32)
-    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("fsdp", "tp"))
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("fsdp", "tp"))
     with pytest.raises(ValueError, match="requires a 'cp' mesh axis"):
         tfm.forward(params, tokens, cfg, mesh=mesh)
 
