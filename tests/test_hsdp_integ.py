@@ -26,17 +26,20 @@ N_REPLICAS = 2
 INNER = {"fsdp": 2, "tp": 2}
 
 
-def _cfg():
+def _cfg(**kw):
     # the inner mesh has only fsdp/tp; absent axes (dp, cp) are filtered
     # out of the activation/batch specs automatically
-    return tfm.TransformerConfig(
+    base = dict(
         vocab_size=64, d_model=32, n_heads=4, n_kv_heads=4, d_ff=64,
         n_layers=2, max_seq_len=16, dtype=jnp.float32, attn_impl="dense",
     )
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
 
 
-def _train_replica(replica_id, lighthouse_addr, barrier, steps=3):
-    cfg = _cfg()
+def _train_replica(replica_id, lighthouse_addr, barrier, steps=3,
+                   inner=INNER, cfg=None):
+    cfg = cfg or _cfg()
     devices = jax.devices()[replica_id * 4 : (replica_id + 1) * 4]
     state = {}
 
@@ -59,7 +62,7 @@ def _train_replica(replica_id, lighthouse_addr, barrier, steps=3):
         },
     )
     try:
-        fmesh = ft_init_device_mesh(manager, INNER, devices=devices)
+        fmesh = ft_init_device_mesh(manager, inner, devices=devices)
         mesh = fmesh.mesh
         params = tfm.init_params(jax.random.PRNGKey(0), cfg)
         params = tfm.shard_params(params, mesh, cfg)
@@ -88,7 +91,7 @@ def _train_replica(replica_id, lighthouse_addr, barrier, steps=3):
                         jnp.asarray(x), jax.sharding.NamedSharding(mesh, s)
                     ),
                     state["params"],
-                    tfm.param_specs(cfg),
+                    tfm.param_specs(cfg, mesh),
                 )
                 updates, new_opt = tx.update(
                     jax.tree_util.tree_map(jnp.asarray, avg),
@@ -126,6 +129,31 @@ class TestHSDPInteg:
         assert all(r["step"] == 3 for r in results)
         # despite different per-replica data, averaged grads keep the
         # replicas bitwise identical (the HSDP replicate-dim contract)
+        leaves0 = jax.tree_util.tree_leaves(results[0]["params"])
+        leaves1 = jax.tree_util.tree_leaves(results[1]["params"])
+        for a, b in zip(leaves0, leaves1):
+            np.testing.assert_array_equal(a, b)
+
+    def test_context_parallel_inner_mesh(self):
+        """FT replica dim x inner ring-attention cp mesh: long-context
+        sequence parallelism composes with the elastic quorum."""
+        assert len(jax.devices()) >= 8
+        cfg = _cfg(attn_impl="ring", max_seq_len=32)
+        lighthouse = LighthouseServer(min_replicas=N_REPLICAS, join_timeout_ms=30000)
+        try:
+            barrier = threading.Barrier(N_REPLICAS)
+            with ThreadPoolExecutor(max_workers=N_REPLICAS) as ex:
+                futs = [
+                    ex.submit(
+                        _train_replica, r, lighthouse.address(), barrier,
+                        3, {"cp": 4}, cfg,
+                    )
+                    for r in range(N_REPLICAS)
+                ]
+                results = [f.result(timeout=300) for f in futs]
+        finally:
+            lighthouse.shutdown()
+        assert all(r["step"] == 3 for r in results)
         leaves0 = jax.tree_util.tree_leaves(results[0]["params"])
         leaves1 = jax.tree_util.tree_leaves(results[1]["params"])
         for a, b in zip(leaves0, leaves1):

@@ -143,10 +143,27 @@ def _moe_cfg(cfg: TransformerConfig):
     )
 
 
-def param_specs(cfg: TransformerConfig) -> Params:
+def _filter_spec(spec: P, mesh: "Optional[Mesh]") -> P:
+    """Drop axes the mesh doesn't have (partial meshes, e.g. cp-only or
+    fsdp/tp-only inner HSDP meshes)."""
+    if mesh is None:
+        return spec
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            return kept or None
+        return entry if entry in mesh.axis_names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def param_specs(cfg: TransformerConfig, mesh: "Optional[Mesh]" = None) -> Params:
     """PartitionSpecs matching init_params' tree: 2-D weights sharded
     (fsdp x tp); the stacked layer dim stays unsharded so `lax.scan` slices
-    locally."""
+    locally. With a mesh, axes absent from it are dropped."""
     fs, tp = cfg.fsdp_axis, cfg.tp_axis
     blocks = {
         "attn_norm": P(None, None),
@@ -168,11 +185,15 @@ def param_specs(cfg: TransformerConfig) -> Params:
                 "w_down": P(None, tp, fs),
             }
         )
-    return {
+    specs = {
         "embed": P(tp, fs),
         "blocks": blocks,
         "final_norm": P(None),
     }
+    return jax.tree_util.tree_map(
+        lambda s: _filter_spec(s, mesh), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
 
 
 def _batch_axes(cfg: TransformerConfig, mesh: "Optional[Mesh]") -> tuple:
@@ -211,7 +232,7 @@ def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,
-        param_specs(cfg),
+        param_specs(cfg, mesh),
     )
 
 
@@ -268,7 +289,8 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 rep = nh // k.shape[2]
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            spec = P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh), cfg.tp_axis, None)
+            head_axis = cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None
+            spec = P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh), head_axis, None)
             fn = jax.shard_map(
                 lambda q_, k_, v_: local_fn(
                     q_, k_, v_, axis_name=cfg.cp_axis, causal=True
@@ -478,7 +500,7 @@ def make_train_step(
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
-    pspecs = param_specs(cfg)
+    pspecs = param_specs(cfg, mesh)
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
     batch_sh = NamedSharding(mesh, batch_spec(cfg, mesh))
     return jax.jit(
@@ -502,7 +524,7 @@ def make_grad_step(
 
     if mesh is None:
         return jax.jit(step)
-    pspecs = param_specs(cfg)
+    pspecs = param_specs(cfg, mesh)
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
     batch_sh = NamedSharding(mesh, batch_spec(cfg, mesh))
     return jax.jit(
