@@ -79,7 +79,8 @@ def _train_replica(replica_id, lighthouse_addr, barrier, steps=3,
         while manager.current_step() < steps:
             manager.start_quorum()
             tokens = jnp.asarray(
-                rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+                rng.integers(0, cfg.vocab_size, (4, cfg.max_seq_len)),
+                jnp.int32,
             )
             _, grads = grad_fn(state["params"], tokens)
             host_grads = jax.tree_util.tree_map(np.asarray, grads)
@@ -109,52 +110,43 @@ def _train_replica(replica_id, lighthouse_addr, barrier, steps=3,
         manager.shutdown()
 
 
+def _run_replicas(inner=INNER, cfg=None):
+    """Fan out N_REPLICAS thread-replicas and assert the HSDP contract:
+    all reach the step target and end bitwise identical."""
+    assert len(jax.devices()) >= 8, "needs the 8-device CPU mesh"
+    lighthouse = LighthouseServer(min_replicas=N_REPLICAS, join_timeout_ms=30000)
+    try:
+        barrier = threading.Barrier(N_REPLICAS)
+        with ThreadPoolExecutor(max_workers=N_REPLICAS) as ex:
+            futs = [
+                ex.submit(
+                    _train_replica, r, lighthouse.address(), barrier,
+                    3, inner, cfg,
+                )
+                for r in range(N_REPLICAS)
+            ]
+            results = [f.result(timeout=300) for f in futs]
+    finally:
+        lighthouse.shutdown()
+
+    assert all(r["step"] == 3 for r in results)
+    # despite different per-replica data, averaged grads keep the
+    # replicas bitwise identical (the HSDP replicate-dim contract)
+    leaves0 = jax.tree_util.tree_leaves(results[0]["params"])
+    leaves1 = jax.tree_util.tree_leaves(results[1]["params"])
+    for a, b in zip(leaves0, leaves1):
+        np.testing.assert_array_equal(a, b)
+    return results
+
+
 class TestHSDPInteg:
     def test_two_replicas_inner_fsdp_tp_converge(self):
-        assert len(jax.devices()) >= 8, "needs the 8-device CPU mesh"
-        lighthouse = LighthouseServer(min_replicas=N_REPLICAS, join_timeout_ms=30000)
-        try:
-            barrier = threading.Barrier(N_REPLICAS)
-            with ThreadPoolExecutor(max_workers=N_REPLICAS) as ex:
-                futs = [
-                    ex.submit(
-                        _train_replica, r, lighthouse.address(), barrier
-                    )
-                    for r in range(N_REPLICAS)
-                ]
-                results = [f.result(timeout=300) for f in futs]
-        finally:
-            lighthouse.shutdown()
-
-        assert all(r["step"] == 3 for r in results)
-        # despite different per-replica data, averaged grads keep the
-        # replicas bitwise identical (the HSDP replicate-dim contract)
-        leaves0 = jax.tree_util.tree_leaves(results[0]["params"])
-        leaves1 = jax.tree_util.tree_leaves(results[1]["params"])
-        for a, b in zip(leaves0, leaves1):
-            np.testing.assert_array_equal(a, b)
+        _run_replicas()
 
     def test_context_parallel_inner_mesh(self):
-        """FT replica dim x inner ring-attention cp mesh: long-context
-        sequence parallelism composes with the elastic quorum."""
-        assert len(jax.devices()) >= 8
-        cfg = _cfg(attn_impl="ring", max_seq_len=32)
-        lighthouse = LighthouseServer(min_replicas=N_REPLICAS, join_timeout_ms=30000)
-        try:
-            barrier = threading.Barrier(N_REPLICAS)
-            with ThreadPoolExecutor(max_workers=N_REPLICAS) as ex:
-                futs = [
-                    ex.submit(
-                        _train_replica, r, lighthouse.address(), barrier,
-                        3, {"cp": 4}, cfg,
-                    )
-                    for r in range(N_REPLICAS)
-                ]
-                results = [f.result(timeout=300) for f in futs]
-        finally:
-            lighthouse.shutdown()
-        assert all(r["step"] == 3 for r in results)
-        leaves0 = jax.tree_util.tree_leaves(results[0]["params"])
-        leaves1 = jax.tree_util.tree_leaves(results[1]["params"])
-        for a, b in zip(leaves0, leaves1):
-            np.testing.assert_array_equal(a, b)
+        """FT replica dim x inner ring-attention cp mesh: sequence
+        parallelism composes with the elastic quorum (T=32 over cp=4,
+        longer than the dense test so multi-chunk ring steps are real)."""
+        _run_replicas(
+            inner={"cp": 4}, cfg=_cfg(attn_impl="ring", max_seq_len=32)
+        )

@@ -1,0 +1,170 @@
+"""Multi-local-rank replica groups: 2 groups x 2 ranks through the full stack.
+
+Reference scenario (manager_integ_test.py multi-rank): each replica group
+runs ``group_world_size`` Manager instances (rank 0 hosts the group's
+ManagerServer; others discover it via the shared store); the group's ranks
+hold different state shards (FSDP-style), each rank allreduces its shard
+with same-rank counterparts across groups, and should_commit ANDs the
+votes of all local ranks before any of them commits.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer, StoreServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+N_GROUPS = 2
+GROUP_WORLD = 2
+STEPS = 3
+
+
+class _Kill(Exception):
+    pass
+
+
+def _run_rank(group, rank, lighthouse_addr, store_addr, barrier,
+              kill_at=None):
+    # per-(group, rank) shard, FSDP-style: ranks hold different state
+    state = {"w": np.zeros(64, np.float32)}
+    manager = Manager(
+        pg=ProcessGroupTCP(timeout=20.0),
+        min_replica_size=N_GROUPS,
+        lighthouse_addr=lighthouse_addr,
+        store_addr=store_addr,
+        replica_id=f"mr_{group}",
+        group_rank=rank,
+        group_world_size=GROUP_WORLD,
+        use_async_quorum=False,
+        timeout=30.0,
+        quorum_timeout=30.0,
+        load_state_dict=lambda sd: state.update(
+            {k: np.array(v) for k, v in sd.items()}
+        ),
+        state_dict=lambda: {k: v.copy() for k, v in state.items()},
+    )
+    try:
+        barrier.wait(timeout=60)
+        while manager.current_step() < STEPS:
+            if kill_at is not None and manager.current_step() == kill_at:
+                raise _Kill()
+            manager.start_quorum()
+            # shard gradient differs per group AND per rank
+            grad = np.full(
+                64, float(1 + group) * float(10 + rank), np.float32
+            )
+            avg = manager.allreduce({"w": grad}).wait(timeout=30)
+            if manager.should_commit():
+                state["w"] = state["w"] - 0.1 * avg["w"]
+        return {"group": group, "rank": rank, "w": state["w"].copy(),
+                "step": manager.current_step()}
+    finally:
+        manager.shutdown()
+
+
+class TestMultiRankGroups:
+    def test_two_groups_two_ranks(self):
+        lighthouse = LighthouseServer(min_replicas=N_GROUPS, join_timeout_ms=30000)
+        stores = [StoreServer() for _ in range(N_GROUPS)]
+        try:
+            barrier = threading.Barrier(N_GROUPS * GROUP_WORLD)
+            with ThreadPoolExecutor(max_workers=N_GROUPS * GROUP_WORLD) as ex:
+                futs = {
+                    (g, r): ex.submit(
+                        _run_rank, g, r, lighthouse.address(),
+                        stores[g].address(), barrier,
+                    )
+                    for g in range(N_GROUPS)
+                    for r in range(GROUP_WORLD)
+                }
+                results = {k: f.result(timeout=240) for k, f in futs.items()}
+        finally:
+            lighthouse.shutdown()
+            for s in stores:
+                s.shutdown()
+
+        assert all(res["step"] == STEPS for res in results.values())
+        # same-rank shards must be bitwise identical ACROSS groups
+        # (they averaged together)...
+        for r in range(GROUP_WORLD):
+            np.testing.assert_array_equal(
+                results[(0, r)]["w"], results[(1, r)]["w"]
+            )
+        # ...and differ BETWEEN ranks (they held different shards)
+        assert not np.array_equal(results[(0, 0)]["w"], results[(0, 1)]["w"])
+
+    def test_group_recovery_multi_rank(self):
+        """Group 1 (both ranks) dies mid-run and rejoins: each rank heals
+        its own shard from the same-rank counterpart in the healthy group
+        (reference multi-rank recovery, manager_integ_test.py)."""
+        lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=5000)
+        store0 = StoreServer()
+        extra_stores = []
+        try:
+            # group 0 trains throughout; its 2 ranks never die
+            barrier0 = threading.Barrier(GROUP_WORLD)
+
+            def healthy(rank):
+                return _run_rank(
+                    0, rank, lighthouse.address(), store0.address(), barrier0
+                )
+
+            def victim(rank, attempt_state):
+                # both ranks die at step 1, then restart with a fresh store
+                # (a restarted group gets a fresh rendezvous, as under the
+                # launcher); heal brings them back to the healthy group's
+                # step
+                b = attempt_state["barrier"]
+                try:
+                    return _run_rank(
+                        1, rank, lighthouse.address(),
+                        attempt_state["store"].address(), b,
+                        kill_at=1 if attempt_state["attempt"] == 0 else None,
+                    )
+                except _Kill:
+                    return None
+
+            with ThreadPoolExecutor(max_workers=2 * GROUP_WORLD) as ex:
+                healthy_futs = [ex.submit(healthy, r) for r in range(GROUP_WORLD)]
+
+                attempt_state = {
+                    "attempt": 0,
+                    "store": StoreServer(),
+                    "barrier": threading.Barrier(GROUP_WORLD),
+                }
+                extra_stores.append(attempt_state["store"])
+                victim_futs = [
+                    ex.submit(victim, r, dict(attempt_state))
+                    for r in range(GROUP_WORLD)
+                ]
+                first = [f.result(timeout=240) for f in victim_futs]
+                assert all(v is None for v in first), "kill did not fire"
+
+                attempt_state = {
+                    "attempt": 1,
+                    "store": StoreServer(),
+                    "barrier": threading.Barrier(GROUP_WORLD),
+                }
+                extra_stores.append(attempt_state["store"])
+                victim_futs = [
+                    ex.submit(victim, r, dict(attempt_state))
+                    for r in range(GROUP_WORLD)
+                ]
+                victims = [f.result(timeout=240) for f in victim_futs]
+                healthies = [f.result(timeout=240) for f in healthy_futs]
+        finally:
+            lighthouse.shutdown()
+            store0.shutdown()
+            for s in extra_stores:
+                s.shutdown()
+
+        by_key = {(r["group"], r["rank"]): r for r in victims + healthies}
+        assert all(r["step"] == STEPS for r in by_key.values())
+        for r in range(GROUP_WORLD):
+            np.testing.assert_array_equal(
+                by_key[(0, r)]["w"], by_key[(1, r)]["w"]
+            )

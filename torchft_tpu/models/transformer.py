@@ -29,6 +29,7 @@ reductions accumulate in fp32 (`preferred_element_type`).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -38,6 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchft_tpu.ops.ring_attention import dense_attention, ring_attention_local
 from torchft_tpu.ops.ulysses import ulysses_attention_local
+
+logger = logging.getLogger(__name__)
 
 Params = Dict[str, Any]
 
@@ -190,6 +193,16 @@ def param_specs(cfg: TransformerConfig, mesh: "Optional[Mesh]" = None) -> Params
         "blocks": blocks,
         "final_norm": P(None),
     }
+    if mesh is not None and fs not in mesh.axis_names and tp not in mesh.axis_names:
+        # legitimate for e.g. a cp-only inner mesh (weights replicated by
+        # design), but also the symptom of a cfg/mesh axis-name mismatch —
+        # which would otherwise silently train unsharded
+        logger.warning(
+            "mesh %s has neither the fsdp (%r) nor tp (%r) axis: parameters "
+            "will be fully replicated. If this is unintended, align the "
+            "TransformerConfig *_axis names with the mesh.",
+            mesh.axis_names, fs, tp,
+        )
     return jax.tree_util.tree_map(
         lambda s: _filter_spec(s, mesh), specs,
         is_leaf=lambda s: isinstance(s, P),
@@ -289,8 +302,9 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
                 rep = nh // k.shape[2]
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            head_axis = cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None
-            spec = P(_batch_axes(cfg, mesh), _seq_axis(cfg, mesh), head_axis, None)
+            spec = _filter_spec(
+                P(_batch_axes(cfg, mesh), cfg.cp_axis, cfg.tp_axis, None), mesh
+            )
             fn = jax.shard_map(
                 lambda q_, k_, v_: local_fn(
                     q_, k_, v_, axis_name=cfg.cp_axis, causal=True
