@@ -130,6 +130,7 @@ void ManagerServer::start_serving() {
 
 void ManagerServer::stop() {
   shutdown();
+  wake_blocked();  // unblock the heartbeat cv wait immediately
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   // Detached quorum threads finish within their request timeout.
   while (inflight_quorums_.load() > 0) usleep(10 * 1000);
@@ -151,7 +152,12 @@ void ManagerServer::heartbeat_loop() {
       // Lighthouse unreachable: keep trying; quorum path surfaces errors.
       client.close();
     }
-    usleep(static_cast<useconds_t>(opt_.heartbeat_interval_ms * 1000));
+    // interruptible sleep: stop() must not wait out a full heartbeat
+    // interval (shutdown sits on the recovery-latency critical path), and
+    // the cv wait avoids periodic wakeups during normal operation
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_interval_ms),
+                 [this] { return stopping_.load(); });
   }
 }
 

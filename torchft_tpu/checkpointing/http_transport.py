@@ -132,7 +132,12 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._server = _make_server()
         self._server.transport = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
-            target=self._server.serve_forever, name="torchft_http", daemon=True
+            # small poll interval: shutdown() blocks until the serve loop
+            # polls, and transport teardown sits on the recovery-latency
+            # critical path (default 0.5s poll = up to 0.5s per shutdown)
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            name="torchft_http",
+            daemon=True,
         )
         self._thread.start()
         host = socket.gethostname()

@@ -41,6 +41,7 @@ from torchft_tpu.ops.ring_attention import dense_attention, ring_attention_local
 from torchft_tpu.ops.ulysses import ulysses_attention_local
 
 logger = logging.getLogger(__name__)
+_warned_replicated: set = set()
 
 Params = Dict[str, Any]
 
@@ -196,13 +197,18 @@ def param_specs(cfg: TransformerConfig, mesh: "Optional[Mesh]" = None) -> Params
     if mesh is not None and fs not in mesh.axis_names and tp not in mesh.axis_names:
         # legitimate for e.g. a cp-only inner mesh (weights replicated by
         # design), but also the symptom of a cfg/mesh axis-name mismatch —
-        # which would otherwise silently train unsharded
-        logger.warning(
-            "mesh %s has neither the fsdp (%r) nor tp (%r) axis: parameters "
-            "will be fully replicated. If this is unintended, align the "
-            "TransformerConfig *_axis names with the mesh.",
-            mesh.axis_names, fs, tp,
-        )
+        # which would otherwise silently train unsharded. Warn once per
+        # combination (param_specs sits in training-loop paths).
+        key = (tuple(mesh.axis_names), fs, tp)
+        if key not in _warned_replicated:
+            _warned_replicated.add(key)
+            logger.warning(
+                "mesh %s has neither the fsdp (%r) nor tp (%r) axis: "
+                "parameters will be fully replicated. If this is "
+                "unintended, align the TransformerConfig *_axis names "
+                "with the mesh.",
+                mesh.axis_names, fs, tp,
+            )
     return jax.tree_util.tree_map(
         lambda s: _filter_spec(s, mesh), specs,
         is_leaf=lambda s: isinstance(s, P),

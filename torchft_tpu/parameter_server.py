@@ -81,7 +81,9 @@ class ParameterServer(ABC):
         self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
-            target=self._server.serve_forever, name="tft_param_server", daemon=True
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            name="tft_param_server",
+            daemon=True,
         )
         self._thread.start()
         logger.info("started ParameterServer on %s", self.address())
