@@ -188,6 +188,25 @@ class TestPipelinedTransformer:
         for leaf in jax.tree_util.tree_leaves(grads):
             assert np.isfinite(np.asarray(leaf)).all()
 
+    def test_with_tp_sharded_weights(self):
+        # partial-manual pipeline: tp weight sharding flows automatically
+        # through the pipelined transformer
+        from torchft_tpu.models import transformer as tfm
+
+        cfg = self._cfg(n_kv_heads=4, max_seq_len=16)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+        ref = tfm.forward(params, tokens, cfg)
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("tp", "pp"))
+        sharded = tfm.shard_params(params, mesh, cfg)
+        out = jax.jit(
+            lambda p, t: tfm.forward_pipelined(p, t, cfg, mesh, microbatches=2)
+        )(sharded, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+        )
+
     def test_rejects_moe_and_sp(self):
         from torchft_tpu.models import transformer as tfm
 
@@ -198,3 +217,6 @@ class TestPipelinedTransformer:
             params = tfm.init_params(jax.random.PRNGKey(0), cfg)
             with pytest.raises(ValueError, match="dense"):
                 tfm.forward_pipelined(params, tokens, cfg, mesh)
+
+
+

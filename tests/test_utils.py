@@ -124,3 +124,54 @@ class TestDistributedSampler:
         assert list(iter(a)) == list(iter(b))
         b.set_epoch(4)
         assert list(iter(a)) != list(iter(b))
+
+
+class TestStatefulSampler:
+    def test_position_checkpoint_roundtrip(self):
+        from torchft_tpu.data import StatefulDistributedSampler
+
+        s = StatefulDistributedSampler(
+            100, replica_rank=0, num_replica_groups=2, shuffle=True, seed=3
+        )
+        it = iter(s)
+        consumed = [next(it) for _ in range(10)]
+        sd = s.state_dict()
+        assert sd == {"epoch": 0, "position": 10}
+
+        # a healed replica resumes exactly where the cohort left off
+        s2 = StatefulDistributedSampler(
+            100, replica_rank=0, num_replica_groups=2, shuffle=True, seed=3
+        )
+        s2.load_state_dict(sd)
+        rest = list(iter(s2))
+        full = list(iter(
+            StatefulDistributedSampler(
+                100, replica_rank=0, num_replica_groups=2, shuffle=True, seed=3
+            )
+        ))
+        assert consumed + rest == full
+
+    def test_epoch_reset_clears_position(self):
+        from torchft_tpu.data import StatefulDistributedSampler
+
+        s = StatefulDistributedSampler(20, replica_rank=0, num_replica_groups=1)
+        it = iter(s)
+        next(it), next(it)
+        assert s.state_dict()["position"] == 2
+        s.set_epoch(1)
+        assert s.state_dict() == {"epoch": 1, "position": 0}
+
+    def test_exhaustion_keeps_position_until_new_epoch(self):
+        from torchft_tpu.data import StatefulDistributedSampler
+
+        s = StatefulDistributedSampler(8, replica_rank=0, num_replica_groups=2)
+        list(iter(s))
+        # end-of-epoch checkpoint is distinguishable from a fresh epoch:
+        # resuming it yields an empty remainder, not a replayed epoch
+        assert s.state_dict()["position"] == s.num_samples
+        assert s.remaining == 0
+        assert list(iter(s)) == []
+        assert len(s) == s.num_samples  # stable per-epoch constant
+        s.set_epoch(1)
+        assert s.state_dict() == {"epoch": 1, "position": 0}
+        assert len(list(iter(s))) == s.num_samples

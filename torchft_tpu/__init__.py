@@ -13,7 +13,7 @@ the Optimizer wrapper, FT-DDP, the elastic data sampler, and the concrete
 ProcessGroup backends are importable from the package root.
 """
 
-from torchft_tpu.data import DistributedSampler
+from torchft_tpu.data import DistributedSampler, StatefulDistributedSampler
 from torchft_tpu.ddp import DistributedDataParallel, PureDistributedDataParallel
 from torchft_tpu.local_sgd import DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -45,6 +45,7 @@ __all__ = [
     "ProcessGroupDummy",
     "ProcessGroupTCP",
     "PureDistributedDataParallel",
+    "StatefulDistributedSampler",
     "WorldSizeMode",
 ]
 

@@ -74,3 +74,62 @@ class DistributedSampler:
             indices = np.concatenate([indices, pad])
         shard = indices[self.global_rank : self.total_size : self.global_world_size]
         return iter(shard.tolist())
+
+
+class StatefulDistributedSampler(DistributedSampler):
+    """DistributedSampler with data-position checkpointing.
+
+    The reference defers exact data accounting to torchdata's
+    StatefulDataLoader (reference data.py docstring); this sampler carries
+    the position natively: ``state_dict()/load_state_dict()`` capture
+    (epoch, position) so a healed replica resumes its shard where the
+    cohort left off. Register through the Manager::
+
+        manager.register_state_dict_fn(
+            "sampler", sampler.load_state_dict, sampler.state_dict)
+
+    Accounting contract: ``position`` counts indices *handed to the
+    consumer*, advancing at ``next()``. Resume is exact when each batch is
+    drawn and trained within the same committed step; a loader that
+    prefetches across step boundaries hands out indices before they are
+    trained, so a checkpoint would overcount by the in-flight depth —
+    either keep prefetch within the step or checkpoint the loader's
+    in-flight count alongside.
+
+    At epoch end the position stays at ``num_samples`` (so an end-of-epoch
+    checkpoint is distinguishable from a fresh epoch and resumes to an
+    empty remainder); ``set_epoch`` starts the next epoch at 0.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._position = 0
+
+    def state_dict(self) -> dict:
+        """Checkpointable progress: {epoch, position-within-epoch}."""
+        return {"epoch": self.epoch, "position": self._position}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.epoch = int(sd["epoch"])
+        self._position = int(sd["position"])
+
+    def set_epoch(self, epoch: int) -> None:
+        super().set_epoch(epoch)
+        self._position = 0
+
+    @property
+    def remaining(self) -> int:
+        """Indices left in the current epoch (``__len__`` stays the stable
+        per-epoch constant)."""
+        return max(self.num_samples - self._position, 0)
+
+    def __iter__(self):
+        shard = list(super().__iter__())
+        start = self._position
+
+        def gen():
+            for i, idx in enumerate(shard[start:], start=start):
+                self._position = i + 1
+                yield idx
+
+        return gen()
