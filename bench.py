@@ -87,6 +87,7 @@ class Replica:
         def state_dict():
             return {"params": state["params"].copy()}
 
+        t_init0 = time.perf_counter()
         manager = Manager(
             pg=ProcessGroupTCP(timeout=30.0),
             min_replica_size=1,
@@ -101,6 +102,10 @@ class Replica:
             quorum_timeout=30.0,
         )
         healed = attempt > 0
+        if healed and self.bench.t_killed is not None:
+            log(f"replica {self.replica_id}: teardown+restart took "
+                f"{t_init0 - self.bench.t_killed:.3f}s, manager re-init "
+                f"{time.perf_counter() - t_init0:.3f}s")
         try:
             while manager.current_step() < TOTAL_STEPS:
                 step = manager.current_step()
@@ -126,7 +131,8 @@ class Replica:
                     if healed:
                         self.bench.t_healthy = time.perf_counter()
                         log(f"replica {self.replica_id}: healthy commit at "
-                            f"step {manager.current_step()} after heal")
+                            f"step {manager.current_step()} after heal "
+                            f"(quorum+heal+step {time.perf_counter() - t0:.3f}s)")
                         healed = False
             return {
                 "replica_id": self.replica_id,

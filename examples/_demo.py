@@ -1,0 +1,82 @@
+"""Shared single-machine demo scaffolding for the example trainers.
+
+One process hosts a Lighthouse plus N replica-group threads — the demo
+analog of one-process-per-slice deployment. Unlike bare daemon threads, a
+replica whose train function raises is surfaced: the demo exits nonzero
+with the traceback instead of silently reporting success.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional, Sequence
+
+
+def run_demo(
+    train: "Callable[..., Any]",
+    n_replicas: int,
+    min_replicas: int = 1,
+    replica_prefix: str = "replica",
+    devices_per_replica: "Optional[int]" = None,
+    extra_args: "Sequence[Any]" = (),
+    join_timeout_ms: int = 200,
+) -> int:
+    """Run ``train(replica_id, lighthouse_addr, [devices,] *extra_args)``
+    on one thread per replica group against an in-process Lighthouse.
+
+    ``devices_per_replica``: when set, each replica receives its disjoint
+    slice of ``jax.devices()`` as the third argument (the HSDP pattern).
+    Returns a process exit code (0 iff every replica finished cleanly).
+    """
+    from torchft_tpu.coordination import LighthouseServer
+
+    lighthouse = LighthouseServer(
+        min_replicas=min_replicas, join_timeout_ms=join_timeout_ms
+    )
+    print(f"lighthouse dashboard: http://{lighthouse.address()}/")
+    try:
+        if devices_per_replica is not None:
+            import jax
+
+            devices = jax.devices()
+
+            def call(i: int) -> Any:
+                dev = devices[
+                    i * devices_per_replica : (i + 1) * devices_per_replica
+                ]
+                return train(
+                    f"{replica_prefix}_{i}", lighthouse.address(), dev,
+                    *extra_args,
+                )
+        else:
+            def call(i: int) -> Any:
+                return train(
+                    f"{replica_prefix}_{i}", lighthouse.address(), *extra_args
+                )
+
+        failures = 0
+        with ThreadPoolExecutor(max_workers=n_replicas) as ex:
+            futures = [ex.submit(call, i) for i in range(n_replicas)]
+            for i, f in enumerate(futures):
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001 - surfaced to the operator
+                    import traceback
+
+                    traceback.print_exc()
+                    print(f"replica {i} FAILED")
+                    failures += 1
+        return 1 if failures else 0
+    finally:
+        lighthouse.shutdown()
+
+
+def resolve_lighthouse() -> str:
+    """Deployment mode: the lighthouse address from the environment."""
+    addr = os.environ.get("TORCHFT_LIGHTHOUSE")
+    if not addr:
+        raise SystemExit(
+            "set TORCHFT_LIGHTHOUSE=host:port (or use --local-replicas N)"
+        )
+    return addr

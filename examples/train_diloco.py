@@ -26,7 +26,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -125,44 +124,26 @@ def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
         manager.shutdown()
 
 
-def main(argv=None) -> None:
+def main(argv=None) -> int:
     args = parse_args(argv)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
     if args.local_replicas:
-        from torchft_tpu.coordination import LighthouseServer
+        from _demo import run_demo
 
-        lighthouse = LighthouseServer(
-            min_replicas=args.min_replicas, join_timeout_ms=200
+        return run_demo(
+            train, args.local_replicas, min_replicas=args.min_replicas,
+            replica_prefix="train_diloco", extra_args=(args,),
         )
-        print(f"lighthouse dashboard: http://{lighthouse.address()}/")
-        threads = [
-            threading.Thread(
-                target=train,
-                args=(f"train_diloco_{i}", lighthouse.address(), args),
-                daemon=True,
-            )
-            for i in range(args.local_replicas)
-        ]
-        try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-        finally:
-            lighthouse.shutdown()
-    else:
-        lighthouse_addr = os.environ.get("TORCHFT_LIGHTHOUSE")
-        if not lighthouse_addr:
-            raise SystemExit(
-                "set TORCHFT_LIGHTHOUSE=host:port (or use --local-replicas N)"
-            )
-        replica_id = f"train_diloco_{os.environ.get('REPLICA_GROUP_ID', 0)}"
-        result = train(replica_id, lighthouse_addr, args)
-        print(f"done: {result['outer_steps']} outer steps committed")
+    from _demo import resolve_lighthouse
+
+    replica_id = f"train_diloco_{os.environ.get('REPLICA_GROUP_ID', 0)}"
+    result = train(replica_id, resolve_lighthouse(), args)
+    print(f"done: {result['outer_steps']} outer steps committed")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -87,8 +86,26 @@ def train(replica_id: str, lighthouse_addr: str, devices, args, log=print) -> di
         )
         rng = np.random.default_rng(hash(replica_id) % 2**31)
 
+        def reshard_if_healed():
+            # a heal delivers host numpy arrays via load_state_dict; they
+            # must go back onto the inner mesh BEFORE the jitted grad_fn
+            # touches them (else: recompile + fully-replicated weights).
+            # Steady-state steps skip the device_put entirely.
+            leaves = jax.tree_util.tree_leaves(state["params"])
+            if leaves and not isinstance(leaves[0], jax.Array):
+                state["params"] = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(
+                        jnp.asarray(x), jax.sharding.NamedSharding(mesh, s)
+                    ),
+                    state["params"], pspecs,
+                )
+                state["opt_state"] = jax.tree_util.tree_map(
+                    jnp.asarray, state["opt_state"]
+                )
+
         while manager.current_step() < args.steps:
-            optimizer.begin_step()  # starts the quorum
+            optimizer.begin_step()  # starts the quorum (sync: heal lands here)
+            reshard_if_healed()
             # per-replica batch shape stays FIXED under elastic membership
             # (WorldSizeMode.DYNAMIC semantics): zero-fill + divide-by-live
             # -count absorbs joins/failures without any re-jit
@@ -102,18 +119,10 @@ def train(replica_id: str, lighthouse_addr: str, devices, args, log=print) -> di
             avg = manager.allreduce(
                 jax.tree_util.tree_map(np.asarray, grads)
             ).wait(timeout=30)
-            # healed state arrives as host arrays: re-shard onto the inner
-            # mesh before the optimizer applies the averaged update
-            sharded = jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(
-                    jnp.asarray(x), jax.sharding.NamedSharding(mesh, s)
-                ),
-                state["params"], pspecs,
-            )
             new_params, new_opt, committed = optimizer.step(
-                sharded,
+                state["params"],
                 jax.tree_util.tree_map(jnp.asarray, avg),
-                jax.tree_util.tree_map(jnp.asarray, state["opt_state"]),
+                state["opt_state"],
             )
             if committed:
                 state["params"] = new_params
@@ -128,7 +137,7 @@ def train(replica_id: str, lighthouse_addr: str, devices, args, log=print) -> di
         manager.shutdown()
 
 
-def main(argv=None) -> None:
+def main(argv=None) -> int:
     args = parse_args(argv)
     import jax
 
@@ -136,38 +145,19 @@ def main(argv=None) -> None:
         per = args.fsdp * args.tp
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", per * args.local_replicas)
-        from torchft_tpu.coordination import LighthouseServer
+        from _demo import run_demo
 
-        lighthouse = LighthouseServer(
-            min_replicas=args.min_replicas, join_timeout_ms=200
+        return run_demo(
+            train, args.local_replicas, min_replicas=args.min_replicas,
+            replica_prefix="hsdp", devices_per_replica=per,
+            extra_args=(args,),
         )
-        print(f"lighthouse dashboard: http://{lighthouse.address()}/")
-        devices = jax.devices()
-        threads = [
-            threading.Thread(
-                target=train,
-                args=(f"hsdp_{i}", lighthouse.address(),
-                      devices[i * per:(i + 1) * per], args),
-                daemon=True,
-            )
-            for i in range(args.local_replicas)
-        ]
-        try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-        finally:
-            lighthouse.shutdown()
-    else:
-        lighthouse_addr = os.environ.get("TORCHFT_LIGHTHOUSE")
-        if not lighthouse_addr:
-            raise SystemExit(
-                "set TORCHFT_LIGHTHOUSE=host:port (or use --local-replicas N)"
-            )
-        replica_id = f"hsdp_{os.environ.get('REPLICA_GROUP_ID', 0)}"
-        train(replica_id, lighthouse_addr, jax.local_devices(), args)
+    from _demo import resolve_lighthouse
+
+    replica_id = f"hsdp_{os.environ.get('REPLICA_GROUP_ID', 0)}"
+    train(replica_id, resolve_lighthouse(), jax.local_devices(), args)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

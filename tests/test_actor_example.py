@@ -28,12 +28,3 @@ def test_actor_trainer_chaos_restart():
     assert "restart 1" in out.stdout
     assert "weights converged bitwise" in out.stdout
 
-
-def test_train_hsdp_example():
-    out = subprocess.run(
-        [sys.executable, "examples/train_hsdp.py", "--local-replicas", "2",
-         "--steps", "6"],
-        capture_output=True, text=True, cwd=REPO, timeout=300,
-    )
-    assert out.returncode == 0, out.stderr + out.stdout
-    assert out.stdout.count("done: 6 committed steps") == 2

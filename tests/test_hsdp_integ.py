@@ -150,3 +150,19 @@ class TestHSDPInteg:
         _run_replicas(
             inner={"cp": 4}, cfg=_cfg(attn_impl="ring", max_seq_len=32)
         )
+
+
+def test_train_hsdp_example():
+    """End-to-end smoke of the user-facing HSDP example (demo mode)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "examples/train_hsdp.py", "--local-replicas", "2",
+         "--steps", "6"],
+        capture_output=True, text=True, cwd=repo, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert out.stdout.count("done: 6 committed steps") == 2
