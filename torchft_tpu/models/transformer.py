@@ -278,12 +278,29 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
-    """Returns block(x, layer_params, positions) -> x for one decoder layer."""
+def _make_block(
+    cfg: TransformerConfig, mesh: "Optional[Mesh]", manual_cp: bool = False
+):
+    """Returns block(x, layer_params, positions) -> x for one decoder layer.
+
+    ``manual_cp``: the block runs inside an existing manual shard_map
+    context over ``cp_axis`` (e.g. the pipeline's) — attention calls the
+    local ring body directly instead of opening its own shard_map, and
+    ``positions=None`` makes the block derive global rotary positions from
+    its cp shard index.
+    """
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     act = cfg.dtype
 
     def attention(q, k, v):
+        if manual_cp:
+            if cfg.attn_impl != "ring":
+                raise ValueError(
+                    "manual-cp blocks support ring attention only"
+                )
+            return ring_attention_local(
+                q, k, v, axis_name=cfg.cp_axis, causal=True
+            )
         if cfg.attn_impl in ("ring", "ulysses"):
             if mesh is None:
                 raise ValueError(f"{cfg.attn_impl} attention requires a mesh")
@@ -327,8 +344,13 @@ def _make_block(cfg: TransformerConfig, mesh: "Optional[Mesh]"):
             )
         return dense_attention(q, k, v, causal=True)
 
-    def block(x: jax.Array, p: Params, positions: jax.Array):
+    def block(x: jax.Array, p: Params, positions: "Optional[jax.Array]"):
         b, t, e = x.shape
+        if positions is None:
+            # manual-cp context: x is the local sequence chunk; rotary
+            # embeddings need GLOBAL positions, derived from the shard index
+            offset = jax.lax.axis_index(cfg.cp_axis) * t
+            positions = offset + jnp.arange(t)
         h = _rms_norm(x, p["attn_norm"])
         q = (h @ p["wq"].astype(act)).reshape(b, t, nh, hd)
         k = (h @ p["wk"].astype(act)).reshape(b, t, nkv, hd)
@@ -442,21 +464,30 @@ def forward_pipelined(
     outside the pipe.
 
     Each stage holds ``n_layers / pp`` consecutive blocks (the stacked
-    layer dim is sharded over pp). Restrictions of this v1: dense attention
-    and dense FFN only — ring/ulysses/MoE use their own shard_map /
-    sharding constraints, which do not nest inside the pipeline's
-    shard_map.
+    layer dim is sharded over pp). Supported attention: ``dense``, and
+    ``ring`` when the mesh has a ``cp`` axis — the pipeline shard_map goes
+    manual over (pp, cp) and each stage runs the local ring body, so
+    long-context sequence parallelism composes with the pipeline.
+    MoE/ulysses remain out of scope (their sharding-constraint /
+    all-to-all plumbing doesn't nest here).
     """
-    if cfg.attn_impl != "dense" or cfg.n_experts:
+    ring = cfg.attn_impl == "ring"
+    if cfg.attn_impl not in ("dense", "ring") or cfg.n_experts:
         raise ValueError(
-            "forward_pipelined supports dense attention + dense FFN only"
+            "forward_pipelined supports dense or ring attention with a "
+            "dense FFN only"
+        )
+    if ring and cfg.cp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"ring attention requires a {cfg.cp_axis!r} mesh axis; "
+            f"this mesh has {mesh.axis_names}"
         )
     from torchft_tpu.parallel.pipeline import pipeline_apply
 
     t = tokens.shape[1]
     x = _embed(params, tokens, cfg, sharded=True)
-    positions = jnp.arange(t)
-    block = _make_block(cfg, None)
+    positions = None if ring else jnp.arange(t)
+    block = _make_block(cfg, None, manual_cp=ring)
 
     def layer_fn(h, layer_params):
         return block(h, layer_params, positions)[0]
@@ -464,8 +495,9 @@ def forward_pipelined(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    # pipeline_apply is partial-manual over pp only: batch (dp/fsdp/ep) and
-    # weight (fsdp/tp) shardings flow automatically from input shardings
+    # pipeline_apply is partial-manual over pp (+cp for ring): batch
+    # (dp/fsdp/ep) and weight (fsdp/tp) shardings flow automatically from
+    # input shardings
     x = pipeline_apply(
         params["blocks"],
         x,
@@ -473,6 +505,7 @@ def forward_pipelined(
         mesh,
         axis_name=pp_axis,
         microbatches=microbatches,
+        seq_axis=cfg.cp_axis if ring else None,
     )
     return _head(params, x)
 

@@ -124,11 +124,14 @@ def pipeline_apply(
     axis_name: str = "pp",
     microbatches: int = 4,
     batch_axes: "Optional[tuple]" = None,
+    seq_axis: "Optional[str]" = None,
+    seq_dim: int = 1,
 ) -> jax.Array:
     """GPipe-apply a stacked-layer model over the ``pp`` mesh axis.
 
-    The shard_map is *partial-manual* (``axis_names={pp}``): only the
-    pipeline axis is handled manually (the tick loop + ppermutes); every
+    The shard_map is *partial-manual* (``axis_names={pp[, seq_axis]}``):
+    only the pipeline axis (and, when given, the sequence-parallel axis the
+    stage fn handles itself, e.g. ring attention over cp) is manual; every
     other mesh axis stays automatic, so dp/fsdp batch sharding and fsdp/tp
     weight sharding flow through from the inputs' shardings with XLA
     placing the collectives — stage weights are NOT replicated.
@@ -137,17 +140,25 @@ def pipeline_apply(
         params: pytree with leading layer dim ``[L]``; ``L`` must divide by
             the pp axis size (each stage takes ``L/S`` consecutive layers).
         x: ``[B, ...]`` activations; ``B`` must divide by ``microbatches``.
-        fn: one layer step ``fn(x_mb, layer_params) -> x_mb``.
+        fn: one layer step ``fn(x_mb, layer_params) -> x_mb``. With
+            ``seq_axis`` the fn runs in manual context over that axis too
+            (it may call e.g. ring_attention_local over it) and receives
+            the local sequence chunk.
         mesh: mesh containing ``axis_name``.
         microbatches: GPipe microbatch count M (bubble = (S-1)/(M+S-1)).
         batch_axes: unused (kept for call-site stability); batch sharding
             over dp/fsdp/ep is automatic in partial-manual mode.
+        seq_axis: optional mesh axis the sequence dim is sharded over
+            (manual: the stage fn owns its collectives).
+        seq_dim: which dim of ``x`` is the sequence (default 1, [B, T, E]).
 
     Returns ``[B, ...]`` outputs with x's sharding.
     """
     del batch_axes  # automatic in partial-manual mode
     if axis_name not in mesh.axis_names:
         raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    if seq_axis is not None and seq_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {seq_axis!r} axis: {mesh.axis_names}")
     stages = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     n_layers = jax.tree_util.tree_leaves(params)[0].shape[0]
     if n_layers % stages != 0:
@@ -163,14 +174,18 @@ def pipeline_apply(
     param_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), params
     )
-    data_spec = P(*([None] * (x.ndim + 1)))
+    data_entries: "list" = [None] * (x.ndim + 1)
+    if seq_axis is not None:
+        data_entries[seq_dim + 1] = seq_axis  # +1 for the microbatch dim
+    data_spec = P(*data_entries)
 
+    manual = {axis_name} if seq_axis is None else {axis_name, seq_axis}
     out = jax.shard_map(
         functools.partial(pipeline_apply_local, fn=fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(param_specs, data_spec),
         out_specs=data_spec,
-        axis_names={axis_name},
+        axis_names=manual,
     )(params, x_mb)
     return out.reshape(x.shape)
 
