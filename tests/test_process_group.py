@@ -332,12 +332,17 @@ class TestWrappers:
     def test_managed_rank_when_not_participating(self):
         from unittest.mock import MagicMock
 
-        from torchft_tpu.parallel.process_group import ManagedProcessGroup
+        from torchft_tpu.parallel.process_group import (
+            ManagedProcessGroup,
+            NotParticipatingError,
+        )
 
         manager = MagicMock()
         manager.participating_rank.return_value = None
         pg = ManagedProcessGroup(manager)
-        assert pg.rank() == 0
+        # a healing replica must NOT silently read rank-0's data shard
+        with pytest.raises(NotParticipatingError):
+            pg.rank()
 
 
 class TestBucketing:

@@ -27,6 +27,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -171,6 +172,13 @@ class Manager:
         self._commit_failures = 0
         self._quorum_id = -1
 
+        # Wall-clock spent in each protocol phase since the last
+        # ``pop_phase_times`` — the FT-overhead observability surface
+        # (the reference only exposes these as profiler spans,
+        # torchft/manager.py:385,591,790).
+        self._phase_acc: Dict[str, float] = {}
+        self._phase_lock = threading.Lock()
+
         # --- coordination wiring (reference manager.py:277-325) -----------
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
         if lighthouse_addr is None:
@@ -295,7 +303,9 @@ class Manager:
         assert (
             self._quorum_future is not None
         ), "must call start_quorum before wait_quorum"
+        t0 = time.perf_counter()
         self._quorum_future.result()
+        self._record_phase("quorum_wait", time.perf_counter() - t0)
 
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
@@ -460,10 +470,12 @@ class Manager:
         self.wait_quorum()
         num_participants = self.num_participants()
 
+        t_host = time.perf_counter()
         leaves, treedef = jax.tree_util.tree_flatten(value)
         np_leaves = [np.asarray(x) for x in leaves]
         if not self.is_participating():
             np_leaves = [np.zeros_like(x) for x in np_leaves]
+        self._record_phase("host_sync", time.perf_counter() - t_host)
 
         if reduce_op == REDUCE_AVG:
             if not all(_is_floating(x.dtype) for x in np_leaves):
@@ -475,6 +487,7 @@ class Manager:
             pg_reduce_op = reduce_op
 
         try:
+            t_submit = time.perf_counter()
             if should_quantize:
                 from torchft_tpu.ops.collectives import allreduce_quantized
 
@@ -494,6 +507,7 @@ class Manager:
             out: concurrent.futures.Future = concurrent.futures.Future()
 
             def _done(f: "concurrent.futures.Future[Any]") -> None:
+                self._record_phase("ring", time.perf_counter() - t_submit)
                 exc = f.exception()
                 if exc is not None:
                     self.report_error(
@@ -553,12 +567,14 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
+        t_commit = time.perf_counter()
         should_commit = self._client.should_commit(
             self._group_rank,
             self._step,
             local_should_commit,
             timeout=_to_sec(timeout, self._timeout),
         )
+        self._record_phase("commit", time.perf_counter() - t_commit)
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas}, "
             f"errored={self._errored}"
@@ -597,6 +613,23 @@ class Manager:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+
+    def _record_phase(self, name: str, dt: float) -> None:
+        with self._phase_lock:
+            self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dt
+
+    def pop_phase_times(self) -> "Dict[str, float]":
+        """Wall-clock seconds spent per protocol phase since the last call.
+
+        Keys: ``quorum_wait`` (blocked waiting for the async quorum RPC —
+        the part NOT hidden behind the forward pass), ``host_sync``
+        (device→host materialisation of the allreduce input), ``ring``
+        (collective submit→completion, includes queueing), ``commit``
+        (should_commit RPC barrier).  Resets the accumulator.
+        """
+        with self._phase_lock:
+            out, self._phase_acc = self._phase_acc, {}
+        return out
 
     def current_step(self) -> int:
         return self._step

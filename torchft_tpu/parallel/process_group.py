@@ -273,6 +273,13 @@ class _PGAborted(RuntimeError):
     pass
 
 
+class NotParticipatingError(RuntimeError):
+    """Raised by ``ManagedProcessGroup.rank()`` when the replica has no rank
+    in the current quorum (it is healing or excluded).  Contrast with the
+    reference, whose managed PG always has a local rank (torchft/
+    process_group.py:1233-1266) because healing replicas still hold one."""
+
+
 class ProcessGroupTCP(ProcessGroup):
     """Fault-tolerant collectives over a full TCP mesh of host processes.
 
@@ -1050,8 +1057,22 @@ class ManagedProcessGroup(ProcessGroup):
         return self._manager.errored()
 
     def rank(self) -> int:
+        """Replica rank within the live quorum.
+
+        Raises ``NotParticipatingError`` while this replica is healing /
+        excluded from the current quorum.  Returning a fake 0 here would let
+        a healing replica silently consume rank-0's data shard; callers that
+        can tolerate non-participation should use
+        ``Manager.participating_rank()`` (returns ``None``) or
+        ``ManagedDeviceMesh.global_batch_slice`` (returns the empty slice).
+        """
         r = self._manager.participating_rank()
-        return r if r is not None else 0
+        if r is None:
+            raise NotParticipatingError(
+                "replica is not participating in the current quorum "
+                "(healing or excluded); no rank is defined this step"
+            )
+        return r
 
     def size(self) -> int:
         return self._manager.num_participants()
