@@ -1,34 +1,46 @@
-"""Headline benchmark: recovery-to-healthy-step latency after a replica kill.
+"""Headline benchmark suite: recovery latency, FT overhead, model MFU.
 
-The BASELINE.json north-star metric: a replica group dies mid-run and must
-rejoin with ZERO full-job restart — the survivors keep training, the dead
-replica restarts, heals its weights live from a healthy peer, and commits a
-healthy step.  This run exercises the entire fault-tolerance stack end to
-end on loopback:
+Three measurements, one JSON line:
 
-  C++ Lighthouse (quorum recompute on membership change) -> C++ Manager
-  servers -> quorum-keyed DCN collective reconfigure -> live checkpoint
-  heal over the HTTP transport (16 MB state dict) -> zero-contribution
-  allreduce -> commit vote.
+1. **recovery_to_healthy_step_latency** (primary metric, BASELINE.json
+   north star): a replica group dies mid-run and must rejoin with ZERO
+   full-job restart — the survivors keep training, the dead replica
+   restarts, heals its weights live from a healthy peer, and commits a
+   healthy step.  Exercises the whole FT stack end to end on loopback:
+   C++ Lighthouse (quorum recompute on membership change) -> C++ Manager
+   servers -> quorum-keyed DCN collective reconfigure -> live checkpoint
+   heal over the HTTP transport (16 MB state dict) -> zero-contribution
+   allreduce -> commit vote.
 
-Two replica groups train a DDP loop; replica 1 is killed at a fixed step;
-latency = wall time from the kill to replica 1's next *committed* healthy
-step (includes full Manager re-init, quorum join, heal transfer, one
-training step, commit).
+2. **overhead_pct** (BASELINE.json: "step-time overhead vs non-FT DDP
+   <= 5%"): twin 2-replica DDP loops with IDENTICAL compute and the
+   IDENTICAL ring allreduce — one driven through the Manager protocol
+   (per-step quorum RPC + commit vote + error tracking), one bare
+   ProcessGroupTCP configured once.  overhead = ft/bare - 1.  The
+   per-phase breakdown comes from ``Manager.pop_phase_times()``
+   (quorum_wait / host_sync / ring / commit).  Harness shape mirrors the
+   reference's transport benches (reference:
+   torchft/checkpointing/pg_transport_bench.py:24-95).
 
-Prints ONE JSON line:
-    {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": r}
-``vs_baseline`` = value / 1.0 — a 1-second recovery target we set for
-ourselves (the reference publishes no numbers, BASELINE.md; its embedded
-join_timeout default alone is 100 ms + 100 ms quorum tick).  Values < 1.0
-beat the target; lower is better.  Steady-state throughput and heal
-transfer details go to stderr.
+3. **model.mfu_pct**: the flagship TransformerConfig running
+   ``make_train_step`` (fwd+bwd+adamw, one jit) on the real accelerator,
+   sized to fill a v5e when one is attached.  Params and batches are
+   created ON DEVICE (jitted init) because under the driver the chip sits
+   behind a ~10 MB/s tunnel — only scalars cross the wire.  MFU uses
+   model FLOPs (6*N*tokens + exact attention term; remat recompute NOT
+   counted, per the standard MFU definition), shown in
+   ``docs/benchmarks.md``.  Reference-scale intent:
+   torchft/examples/slurm/runner.py:16-49.
 
-Compute is host-side numpy on purpose: under the driver the one real TPU
-chip sits behind a tunnel whose 7-17 MB/s host<->device link would make
-any device-transfer benchmark a measurement of the tunnel, not the
-framework (the driver compile-checks the TPU model path separately via
-__graft_entry__).
+``vs_baseline`` = recovery latency / 1.0 — a 1-second recovery target we
+set for ourselves (the reference publishes no numbers, BASELINE.md; its
+embedded join_timeout default alone is 100 ms + 100 ms quorum tick).
+Values < 1.0 beat the target; lower is better.
+
+Recovery/overhead compute is host-side numpy on purpose: those benches
+measure the DCN fault-tolerance layer, and routing 16 MB grads through
+the tunnel would measure the tunnel.  The model bench is the one that
+touches the chip.
 """
 
 from __future__ import annotations
@@ -36,24 +48,36 @@ from __future__ import annotations
 import json
 import statistics
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.coordination import LighthouseServer, StoreServer
 from torchft_tpu.manager import Manager
-from torchft_tpu.parallel.process_group import ProcessGroupTCP
+from torchft_tpu.parallel.process_group import (
+    REDUCE_SUM,
+    ProcessGroupTCP,
+)
 
 PARAM_SIZE = 4 * 1024 * 1024  # 4M fp32 = 16 MB state dict
 TOTAL_STEPS = 30
 KILL_AT_STEP = 10
 KILL_REPLICA = 1
 
+OVERHEAD_WARMUP = 5
+OVERHEAD_STEPS = 30
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# 1. recovery-to-healthy-step latency
+# ---------------------------------------------------------------------------
 
 
 class _Kill(Exception):
@@ -61,7 +85,7 @@ class _Kill(Exception):
 
 
 class Replica:
-    def __init__(self, replica_id: int, lighthouse_addr: str, bench: "Bench"):
+    def __init__(self, replica_id: int, lighthouse_addr: str, bench: "RecoveryBench"):
         self.replica_id = replica_id
         self.lighthouse_addr = lighthouse_addr
         self.bench = bench
@@ -143,7 +167,7 @@ class Replica:
             manager.shutdown()
 
 
-class Bench:
+class RecoveryBench:
     def __init__(self) -> None:
         self.t_killed: "Optional[float]" = None
         self.t_healthy: "Optional[float]" = None
@@ -173,19 +197,411 @@ class Bench:
         return self.t_healthy - self.t_killed
 
 
-def main() -> None:
-    latency = Bench().run()
-    print(
-        json.dumps(
-            {
-                "metric": "recovery_to_healthy_step_latency",
-                "value": round(latency, 3),
-                "unit": "s",
-                "vs_baseline": round(latency / 1.0, 3),
-            }
-        ),
-        flush=True,
+# ---------------------------------------------------------------------------
+# 2. FT overhead vs a bare (non-FT) DDP twin
+# ---------------------------------------------------------------------------
+
+
+def _ddp_compute(step: int, rank: int) -> np.ndarray:
+    """The shared per-step 'gradient computation' of both twins."""
+    return np.full(PARAM_SIZE, float(step + 1), dtype=np.float32) * (
+        1.0 + 0.5 * rank
     )
+
+
+def _bare_replica(
+    rank: int, world: int, store_addr: str, barrier: "threading.Barrier",
+    out: "Dict[int, List[float]]",
+) -> None:
+    """Non-FT twin: ProcessGroupTCP configured once, no Manager, no quorum,
+    no commit vote — plain DDP over the identical ring."""
+    pg = ProcessGroupTCP(timeout=30.0)
+    pg.configure(f"{store_addr}/bare", f"bare_{rank}", rank, world)
+    try:
+        params = np.zeros(PARAM_SIZE, dtype=np.float32)
+        times: "List[float]" = []
+        barrier.wait(timeout=30)
+        for step in range(OVERHEAD_WARMUP + OVERHEAD_STEPS):
+            t0 = time.perf_counter()
+            grads = _ddp_compute(step, rank)
+            (summed,) = pg.allreduce([grads], REDUCE_SUM).wait(timeout=30)
+            summed /= world
+            params -= 0.1 * summed
+            times.append(time.perf_counter() - t0)
+        out[rank] = times[OVERHEAD_WARMUP:]
+    finally:
+        pg.shutdown()
+
+
+def _ft_replica(
+    rank: int, lighthouse_addr: str, barrier: "threading.Barrier",
+    out: "Dict[int, List[float]]", phases: "Dict[int, Dict[str, float]]",
+) -> None:
+    """FT twin: same compute, same ring, driven through the full Manager
+    per-step protocol (async quorum + allreduce + commit vote)."""
+    params = np.zeros(PARAM_SIZE, dtype=np.float32)
+    state = {"params": params}
+    manager = Manager(
+        pg=ProcessGroupTCP(timeout=30.0),
+        min_replica_size=2,
+        load_state_dict=lambda sd: state.update(params=np.array(sd["params"])),
+        state_dict=lambda: {"params": state["params"].copy()},
+        lighthouse_addr=lighthouse_addr,
+        replica_id=f"ft_{rank}",
+        group_rank=0,
+        group_world_size=1,
+        use_async_quorum=True,
+        timeout=30.0,
+        quorum_timeout=30.0,
+    )
+    try:
+        times: "List[float]" = []
+        acc: "Dict[str, float]" = {}
+        barrier.wait(timeout=30)
+        step = 0
+        attempts = 0
+        while step < OVERHEAD_WARMUP + OVERHEAD_STEPS:
+            attempts += 1
+            if attempts > 3 * (OVERHEAD_WARMUP + OVERHEAD_STEPS):
+                raise RuntimeError(
+                    f"FT twin stuck: {step} committed after {attempts} attempts"
+                )
+            t0 = time.perf_counter()
+            manager.start_quorum()
+            grads = _ddp_compute(step, rank)
+            avg = manager.allreduce({"g": grads}).wait(timeout=30)
+            if manager.should_commit():
+                state["params"] -= 0.1 * avg["g"]
+                times.append(time.perf_counter() - t0)
+                phase = manager.pop_phase_times()
+                if step >= OVERHEAD_WARMUP:
+                    for k, v in phase.items():
+                        acc[k] = acc.get(k, 0.0) + v
+                step += 1
+        out[rank] = times[OVERHEAD_WARMUP:]
+        phases[rank] = acc
+    finally:
+        manager.shutdown()
+
+
+def _run_bare_twin(world: int) -> float:
+    store = StoreServer()
+    times: "Dict[int, List[float]]" = {}
+    try:
+        barrier = threading.Barrier(world)
+        threads = [
+            threading.Thread(
+                target=_bare_replica,
+                args=(r, world, store.address(), barrier, times),
+                daemon=True,
+            )
+            for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        store.shutdown()
+    assert len(times) == world, "bare twin failed"
+    return statistics.median([t for ts in times.values() for t in ts])
+
+
+def _run_ft_twin(world: int, phase_out: "Dict[str, float]") -> float:
+    """Runs the FT twin; merges this run's mean phase ms/step into
+    ``phase_out`` (caller divides by number of runs)."""
+    lighthouse = LighthouseServer(
+        min_replicas=world, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    times: "Dict[int, List[float]]" = {}
+    phases: "Dict[int, Dict[str, float]]" = {}
+    try:
+        barrier = threading.Barrier(world)
+        threads = [
+            threading.Thread(
+                target=_ft_replica,
+                args=(r, lighthouse.address(), barrier, times, phases),
+                daemon=True,
+            )
+            for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        lighthouse.shutdown()
+    assert len(times) == world, "FT twin failed"
+    for acc in phases.values():
+        for k, v in acc.items():
+            phase_out[k] = phase_out.get(k, 0.0) + v * 1e3 / OVERHEAD_STEPS / len(phases)
+    return statistics.median([t for ts in times.values() for t in ts])
+
+
+def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
+    """FT overhead vs the bare twin, phase-sum estimator.
+
+    The two twins run identical numpy compute and the identical ring
+    allreduce; the FT twin adds exactly the Manager protocol phases, which
+    ``pop_phase_times`` measures per step at perf_counter precision:
+    ``quorum_wait`` + ``commit`` + ``host_sync`` (``ring`` is common to
+    both twins and excluded).  Headline ``overhead_pct`` = added protocol
+    ms / bare step ms.
+
+    The naive estimator — the direct ratio of the two twins' medians — is
+    also reported (``twin_ratio_pct``) but is unreliable on this host: the
+    bench box has ONE CPU core (nproc=1), so the ~50 ms/step twins are
+    thread-scheduling-noise-bound and back-to-back paired runs measured
+    ratios swinging 0.89-1.19 around the ~1.03 truth.  The phase-sum is
+    immune to that noise because it subtracts within the same process,
+    same steps.
+    """
+    world = 2
+    pairs: "List[tuple]" = []
+    phase_runs: "List[Dict[str, float]]" = []
+    for _ in range(rounds):
+        b = _run_bare_twin(world)
+        phases: "Dict[str, float]" = {}
+        f = _run_ft_twin(world, phases)
+        pairs.append((b, f))
+        phase_runs.append(phases)
+
+    bare_ms = min(b for b, _ in pairs) * 1e3
+    ft_ms = min(f for _, f in pairs) * 1e3
+    # quietest-round protocol cost (load inflates RPC latency too)
+    protocol_ms = min(
+        p.get("quorum_wait", 0.0) + p.get("commit", 0.0) + p.get("host_sync", 0.0)
+        for p in phase_runs
+    )
+    overhead_pct = protocol_ms / bare_ms * 100.0
+    twin_ratio_pct = (
+        statistics.median([f / b for b, f in pairs]) - 1.0
+    ) * 100.0
+    n = len(phase_runs)
+    phase_ms = {
+        k: round(sum(p.get(k, 0.0) for p in phase_runs) / n, 3)
+        for k in sorted({k for p in phase_runs for k in p})
+    }
+
+    log(
+        f"overhead: bare {bare_ms:.2f} ms/step, protocol +{protocol_ms:.3f} ms "
+        f"-> {overhead_pct:+.2f}% (twin-ratio cross-check {twin_ratio_pct:+.2f}%) | "
+        f"phases ms/step {phase_ms} | pair ratios "
+        f"{[round(f / b, 4) for b, f in pairs]}"
+    )
+    return {
+        "overhead_pct": round(overhead_pct, 2),
+        "protocol_ms_per_step": round(protocol_ms, 3),
+        "ft_step_ms": round(ft_ms, 3),
+        "nonft_step_ms": round(bare_ms, 3),
+        "twin_ratio_pct": round(twin_ratio_pct, 2),
+        "phases_ms_per_step": phase_ms,
+    }
+
+
+# ---------------------------------------------------------------------------
+# 3. flagship model MFU on the attached accelerator
+# ---------------------------------------------------------------------------
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets).
+_PEAK_TFLOPS = (
+    ("v6", 918.0),       # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),  # v5e device_kind is "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _peak_flops(device_kind: str) -> "Optional[float]":
+    kind = device_kind.lower()
+    for key, tf in _PEAK_TFLOPS:
+        if key in kind:
+            return tf * 1e12
+    return None
+
+
+def _model_flops_per_step(cfg, batch: int, seq: int) -> "Dict[str, float]":
+    """Model FLOPs (fwd+bwd = 3x fwd) per optimizer step.
+
+    matmul params N: block weights + tied head (embedding gather is not a
+    matmul; the tied head IS one).  attention: QK^T and AV are each
+    2*B*T^2*d fwd (full causal scores — the kernel does not skip the
+    masked half), x3 for bwd.  Remat recompute is deliberately NOT
+    counted: MFU is defined over model FLOPs (vs HFU).
+    """
+    e, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    n_block = l * (e * nh * hd + 2 * e * nkv * hd + nh * hd * e + 3 * e * f)
+    n_head = cfg.vocab_size * e
+    tokens = batch * seq
+    mm = 6 * (n_block + n_head) * tokens
+    attn = 3 * (2 * 2 * batch * seq * seq * e) * l
+    return {
+        "params_matmul": float(n_block + n_head),
+        "flops": float(mm + attn),
+        "tokens": float(tokens),
+    }
+
+
+def bench_model() -> "Dict[str, Any]":
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        make_train_step,
+    )
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        # ~220M params, sized so one v5e step is MXU-bound at bf16.
+        base = dict(
+            vocab_size=32000, d_model=1024, n_heads=16, n_kv_heads=8,
+            d_ff=2816, n_layers=16, max_seq_len=1024, attn_impl="dense",
+        )
+        seq, timed_steps = 1024, 20
+        # (remat, batch): no-remat is the MFU-honest config but holds all
+        # [B,nh,T,T] score tensors for bwd; remat trades recompute for a
+        # bigger batch.  B2 no-remat fits 16 GB HBM; B4 measured OOM.
+        attempts = [(False, 2), (True, 8), (True, 4)]
+    else:
+        base = dict(
+            vocab_size=512, d_model=128, n_heads=4, n_kv_heads=2,
+            d_ff=384, n_layers=2, max_seq_len=128, attn_impl="dense",
+        )
+        seq, timed_steps = 128, 5
+        attempts = [(False, 2)]
+
+    def run(remat: bool, batch: int) -> "Dict[str, Any]":
+        import jax.numpy as jnp
+        from jax import lax
+
+        from torchft_tpu.models.transformer import loss_fn
+
+        cfg = TransformerConfig(remat=remat, **base)
+        optimizer = optax.adamw(3e-4)
+        # One dispatch runs n fused train steps (dynamic trip count -> one
+        # compile).  Under the driver the chip sits behind a tunnel with
+        # ~200 ms RTT per dispatch and no cross-dispatch pipelining
+        # (measured; and its block_until_ready returns early), so per-step
+        # time comes from the DIFFERENCE between an n-step and a 1-step
+        # dispatch, each synced by fetching the scalar loss — the RTT and
+        # dispatch cost cancel.
+        @jax.jit
+        def multi_step(params, opt_state, tokens, n):
+            def body(i, carry):
+                params, opt_state, _ = carry
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, tokens, cfg, None
+                )
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: p + u, params, updates
+                )
+                return (params, opt_state, loss)
+            init = (params, opt_state, jnp.zeros((), jnp.float32))
+            return lax.fori_loop(0, n, body, init)
+
+        # Init params/opt-state/batch ON device: only PRNG seeds cross the
+        # host<->device link.
+        params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+        opt_state = jax.jit(optimizer.init)(params)
+        tokens = jax.jit(
+            lambda k: jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+        )(jax.random.PRNGKey(1))
+
+        def timed(n: int) -> float:
+            t0 = time.perf_counter()
+            _, _, loss = multi_step(params, opt_state, tokens, n)
+            assert np.isfinite(float(loss)), "non-finite loss"
+            return time.perf_counter() - t0
+
+        t_c0 = time.perf_counter()
+        timed(1)  # compile + warm
+        compile_s = time.perf_counter() - t_c0
+        # best-of-3 for each to cut tunnel-latency variance
+        t_one = min(timed(1) for _ in range(3))
+        t_many = min(timed(1 + timed_steps) for _ in range(3))
+        step_s = (t_many - t_one) / timed_steps
+
+        fl = _model_flops_per_step(cfg, batch, seq)
+        peak = _peak_flops(dev.device_kind) if on_tpu else None
+        achieved = fl["flops"] / step_s
+        out = {
+            "platform": platform,
+            "device_kind": dev.device_kind,
+            "config": (
+                f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads}/{cfg.n_kv_heads} "
+                f"ff{cfg.d_ff} V{cfg.vocab_size} B{batch} T{seq} "
+                f"remat={'on' if remat else 'off'}"
+            ),
+            "params_matmul_m": round(fl["params_matmul"] / 1e6, 1),
+            "step_ms": round(step_s * 1e3, 2),
+            "compile_s": round(compile_s, 1),
+            "tokens_per_s": round(fl["tokens"] / step_s),
+            "tflops_per_s": round(achieved / 1e12, 1),
+            "mfu_pct": round(100.0 * achieved / peak, 1) if peak else None,
+        }
+        log(f"model bench: {out}")
+        return out
+
+    import gc
+
+    last_err: "Optional[str]" = None
+    for remat, batch in attempts:
+        # An OOM crash can wedge the device into FAILED_PRECONDITION for a
+        # little while (measured under the driver tunnel); give each config
+        # a settle-and-retry before moving to the next.
+        for retry in range(3):
+            try:
+                return run(remat, batch)
+            except Exception as e:  # noqa: BLE001 - OOM etc: try next config
+                log(f"model bench remat={remat} B{batch} failed: {e!r}")
+                last_err = repr(e)
+                retryable = "FAILED_PRECONDITION" in repr(e)
+            # The raised exception's traceback pins the failed attempt's
+            # device buffers via frame refs; collect before the next try.
+            gc.collect()
+            if not retryable:
+                break
+            time.sleep(15)
+    raise RuntimeError(f"model bench failed in all configs: {last_err}")
+
+
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    latency = RecoveryBench().run()
+    # The secondary benches must never cost the driver the primary metric:
+    # degrade to an "error" field instead of dying without the JSON line.
+    try:
+        overhead = bench_overhead()
+    except Exception as e:  # noqa: BLE001
+        log(f"overhead bench failed: {e!r}")
+        overhead = {"overhead_error": repr(e)}
+    try:
+        model: "Dict[str, Any]" = bench_model()
+    except Exception as e:  # noqa: BLE001
+        log(f"model bench failed: {e!r}")
+        model = {"error": repr(e)}
+    result = {
+        "metric": "recovery_to_healthy_step_latency",
+        "value": round(latency, 3),
+        "unit": "s",
+        "vs_baseline": round(latency / 1.0, 3),
+        **overhead,
+        "model": model,
+    }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,12 @@ def parse_args(argv=None):
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace here (Perfetto-compatible)")
+    p.add_argument("--save-dir", default=None,
+                   help="write durable checkpoints here (cold-start resume)")
+    p.add_argument("--save-every", type=int, default=10,
+                   help="checkpoint every N committed steps")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --save-dir")
     return p.parse_args(argv)
 
 
@@ -76,6 +82,20 @@ def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
     ddp = ft.DistributedDataParallel(manager)
     optimizer = ft.Optimizer(manager, optax.adamw(args.lr))
     state["opt_state"] = optimizer.init(params)
+
+    # Durable resume (total-failure case: no live peer to heal from).
+    # Restores user state AND the torchft step so the quorum resumes from
+    # the checkpointed step (reference: train_ddp.py:201-208).
+    if args.resume and args.save_dir:
+        from torchft_tpu.checkpointing import latest_checkpoint, load_checkpoint
+
+        path = latest_checkpoint(args.save_dir)
+        if path is not None:
+            ckpt = load_checkpoint(path)
+            state.update(ckpt["user"])
+            manager.load_state_dict(ckpt["torchft"])
+            log(f"[{replica_id}] resumed from {path} "
+                f"at step {manager.current_step()}")
 
     def loss_fn(params, images, labels):
         logits = cnn.forward(params, images)
@@ -111,6 +131,27 @@ def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
                 log(f"[{replica_id} step {manager.current_step()}] "
                     f"loss={float(loss):.4f} "
                     f"participants={manager.num_participants()}")
+            if (
+                committed
+                and args.save_dir
+                and manager.current_step() % args.save_every == 0
+                and manager.participating_rank() == 0
+            ):
+                # single-writer: the participating-rank-0 replica saves the
+                # composite {user, torchft} dict (others would write the
+                # same bytes)
+                from torchft_tpu.checkpointing import save_checkpoint
+
+                path = save_checkpoint(
+                    args.save_dir,
+                    manager.current_step(),
+                    {
+                        "user": {"params": state["params"],
+                                 "opt_state": state["opt_state"]},
+                        "torchft": manager.state_dict(),
+                    },
+                )
+                log(f"[{replica_id}] saved checkpoint {path}")
         return {"params": state["params"], "step": manager.current_step()}
     finally:
         manager.shutdown()

@@ -1,0 +1,230 @@
+"""Multi-host replica groups: FT-DDP across groups, jit mesh within each.
+
+The deployment shape of a real multi-host pod (reference wiring:
+torchft/manager.py:277-325 store handoff, torchft/fsdp_test.py:96-120
+spawned workers):
+
+- each replica GROUP is ``--procs-per-group`` real OS processes forming one
+  jax multi-controller runtime (``jax.distributed.initialize``) — the inner
+  data-parallel mean runs as a compiled XLA collective over the group's
+  global mesh;
+- each process runs one ``Manager`` with ``group_rank = process id``,
+  sharing the group's store: rank 0 hosts the ManagerServer, other ranks
+  discover it through the store handoff; quorum and commit votes aggregate
+  across ranks inside the group's server;
+- ACROSS groups, same-rank peers form the elastic ``ProcessGroupTCP`` ring
+  that averages gradients — groups can die and rejoin without recompiling
+  anything.
+
+Self-launching demo (spawns groups x procs real processes on CPU):
+
+    python examples/train_multihost.py --groups 2 --procs-per-group 2 --steps 4
+
+Real deployment: run one process per host with the env/flags below, a
+shared Lighthouse, one store + one coordinator per group:
+
+    python examples/train_multihost.py --worker \
+        --group-id 0 --process-id $HOST_IDX --procs-per-group 4 \
+        --coordinator host0:1234 --store-addr host0:2345 \
+        --lighthouse host:port
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--groups", type=int, default=2)
+    p.add_argument("--procs-per-group", type=int, default=2)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--cpu-devices", type=int, default=2,
+                   help="virtual CPU devices per process (test mode)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    # worker mode (spawned by the launcher above, or run per-host manually)
+    p.add_argument("--worker", action="store_true")
+    p.add_argument("--group-id", type=int, default=0)
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--store-addr", default=None)
+    p.add_argument("--lighthouse", default=None)
+    return p.parse_args(argv)
+
+
+def worker(args) -> int:
+    from torchft_tpu.parallel.multihost import (
+        host_sharded_array,
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=args.coordinator,
+        num_processes=args.procs_per_group,
+        process_id=args.process_id,
+        platform="cpu",
+        cpu_devices_per_process=args.cpu_devices,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import torchft_tpu as ft
+
+    gid, pid = args.group_id, args.process_id
+    tag = f"g{gid}p{pid}"
+
+    # ---- inner parallelism: one global mesh over the whole group --------
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    repl = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, P("dp"))
+
+    dim, batch = 8, 4 * len(jax.devices())
+    params = {"w": jnp.zeros((dim,), jnp.float32)}
+    state = {"params": params}
+
+    # ---- FT layer: one Manager per process, group store shared ---------
+    manager = ft.Manager(
+        pg=ft.ProcessGroupTCP(timeout=20.0),
+        min_replica_size=args.min_replicas,
+        load_state_dict=lambda sd: state.update(params=sd["params"]),
+        state_dict=lambda: {"params": state["params"]},
+        lighthouse_addr=args.lighthouse,
+        replica_id=f"mh_group_{gid}",
+        group_rank=pid,
+        group_world_size=args.procs_per_group,
+        store_addr=args.store_addr,
+        use_async_quorum=True,
+        timeout=20.0,
+        quorum_timeout=20.0,
+        init_sync=False,
+    )
+
+    def _grad_step(params, xs, ys):
+        def loss_fn(p):
+            pred = xs @ p["w"]
+            return jnp.mean((pred - ys) ** 2)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    grad_step = jax.jit(
+        _grad_step,
+        in_shardings=(repl, batched, batched),
+        out_shardings=(None, repl),
+    )
+
+    rng = np.random.default_rng(1000 + gid)  # same data on every group rank
+    try:
+        while manager.current_step() < args.steps:
+            step = manager.current_step()
+            xs_np = rng.standard_normal((batch, dim)).astype(np.float32)
+            ys_np = xs_np @ np.arange(dim, dtype=np.float32)
+            # every process contributes only its addressable shards of the
+            # group-global batch
+            xs = host_sharded_array(
+                (batch, dim), batched, lambda idx: xs_np[idx]
+            )
+            ys = host_sharded_array((batch,), batched, lambda idx: ys_np[idx])
+
+            manager.start_quorum()
+            # loss/grads: dp-mean over the group's mesh (compiled XLA
+            # collective spanning the group's processes)
+            loss, grads = grad_step(state["params"], xs, ys)
+            # cross-group: elastic FT ring between same-rank peers
+            avg = manager.allreduce({"w": np.asarray(grads["w"])}).wait(
+                timeout=30
+            )
+            if manager.should_commit():
+                state["params"] = {
+                    "w": state["params"]["w"] - 0.1 * jnp.asarray(avg["w"])
+                }
+        digest = hashlib.sha256(
+            np.asarray(state["params"]["w"]).tobytes()
+        ).hexdigest()[:16]
+        print(f"[{tag}] done step={manager.current_step()} "
+              f"loss={float(loss):.5f} params_sha={digest}", flush=True)
+        return 0
+    finally:
+        manager.shutdown()
+        jax.distributed.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def launch(args) -> int:
+    """Spawn groups x procs real worker processes against one Lighthouse."""
+    from torchft_tpu.coordination import LighthouseServer, StoreServer
+
+    # quorum formation waits for every group — otherwise a fast-starting
+    # group trains (and finishes) solo before the others join
+    lighthouse = LighthouseServer(
+        min_replicas=args.groups, join_timeout_ms=200
+    )
+    stores = [StoreServer() for _ in range(args.groups)]
+    procs = []
+    try:
+        for g in range(args.groups):
+            coord = f"127.0.0.1:{_free_port()}"
+            for p in range(args.procs_per_group):
+                cmd = [
+                    sys.executable, os.path.abspath(__file__), "--worker",
+                    "--group-id", str(g), "--process-id", str(p),
+                    "--procs-per-group", str(args.procs_per_group),
+                    "--cpu-devices", str(args.cpu_devices),
+                    "--steps", str(args.steps),
+                    "--min-replicas", str(args.min_replicas),
+                    "--coordinator", coord,
+                    "--store-addr", stores[g].address(),
+                    "--lighthouse", lighthouse.address(),
+                ]
+                procs.append(subprocess.Popen(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                ))
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        rc = max(p.returncode for p in procs)
+        hashes = set()
+        for out in outs:
+            print(out, end="")
+            for line in out.splitlines():
+                if "params_sha=" in line:
+                    hashes.add(line.rsplit("params_sha=", 1)[1].strip())
+        if rc == 0 and len(hashes) == 1 and outs:
+            n = args.groups * args.procs_per_group
+            print(f"params converged bitwise across {n} processes "
+                  f"({args.groups} groups x {args.procs_per_group} hosts)")
+        elif rc == 0:
+            print(f"ERROR: divergent params across processes: {hashes}")
+            rc = 1
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in stores:
+            s.shutdown()
+        lighthouse.shutdown()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.worker:
+        return worker(args)
+    return launch(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -237,3 +237,82 @@ class TestProcessGroupBabyTCP:
         finally:
             for pg in pgs:
                 pg.shutdown()
+
+
+class TestShmDataPath:
+    def test_large_allreduce_uses_shm_and_is_correct(self, store):
+        """Arrays >= 1 MiB cross the pipe as shared-memory refs (zero pickle
+        of the payload); results must match the direct-PG math exactly."""
+        pgs = _configure_pair(store, "shm")
+        try:
+            n = 2 * 1024 * 1024  # 8 MB f32, well over _SHM_MIN_BYTES
+            data = [np.full(n, 1.0 + r, dtype=np.float32) for r in range(2)]
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(
+                        lambda r: pgs[r].allreduce([data[r]], "sum").wait(timeout=60),
+                        r,
+                    )
+                    for r in range(2)
+                ]
+                results = [f.result(timeout=90) for f in futs]
+            for (got,) in results:
+                np.testing.assert_array_equal(got, np.full(n, 3.0, np.float32))
+            # no leaked segments
+            import glob
+            assert not glob.glob("/dev/shm/psm_*"), glob.glob("/dev/shm/*")
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_mixed_small_and_large_leaves(self, store):
+        pgs = _configure_pair(store, "shmmix")
+        try:
+            small = np.arange(16, dtype=np.float32)
+            big = np.full(512 * 1024, 2.0, dtype=np.float32)  # 2 MB
+
+            def run(r):
+                return pgs[r].allreduce([small.copy(), big.copy()], "sum").wait(
+                    timeout=60
+                )
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                results = [f.result(timeout=90)
+                           for f in [ex.submit(run, r) for r in range(2)]]
+            for got_small, got_big in results:
+                np.testing.assert_array_equal(got_small, 2 * small)
+                np.testing.assert_array_equal(got_big, 2 * big)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_backpressure_bounds_inflight_ops(self, store):
+        """max_active_work caps queued ops; submissions past the cap wait
+        and everything still completes in order."""
+        pgs = [ProcessGroupBabyTCP(timeout=30.0, max_active_work=2) for _ in range(2)]
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [
+                ex.submit(
+                    pgs[r].configure, f"{store.address()}/bp", f"rank{r}", r, 2
+                )
+                for r in range(2)
+            ]
+            for f in futs:
+                f.result(timeout=60)
+        try:
+            def run(r):
+                works = [
+                    pgs[r].allreduce([np.full(1024, float(i), np.float32)], "sum")
+                    for i in range(8)
+                ]
+                return [w.wait(timeout=60)[0][0] for w in works]
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                results = [f.result(timeout=90)
+                           for f in [ex.submit(run, r) for r in range(2)]]
+            for vals in results:
+                assert vals == [2.0 * i for i in range(8)]
+        finally:
+            for pg in pgs:
+                pg.shutdown()

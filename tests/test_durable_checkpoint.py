@@ -1,0 +1,82 @@
+"""Durable (on-disk) checkpoint + cold-start resume.
+
+Covers the total-failure case live healing can't: every replica died, the
+job restarts from disk (reference demonstrates the save path in
+train_ddp.py:201-208; the resume leg is this framework's addition).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from torchft_tpu.checkpointing import (
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDurable:
+    def test_roundtrip(self, tmp_path):
+        sd = {
+            "user": {
+                "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)},
+                "opt_state": {"mu": np.ones(5), "count": 3},
+            },
+            "torchft": {"step": 7, "batches_committed": 14},
+        }
+        path = save_checkpoint(str(tmp_path), 7, sd)
+        assert os.path.basename(path) == "ckpt_step7.tft"
+        out = load_checkpoint(path)
+        np.testing.assert_array_equal(
+            out["user"]["params"]["w"], sd["user"]["params"]["w"]
+        )
+        assert out["torchft"] == sd["torchft"]
+        # no tmp litter: the write is atomic
+        assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+
+    def test_latest_and_prune(self, tmp_path):
+        for step in (2, 4, 6, 8):
+            save_checkpoint(str(tmp_path), step, {"s": step}, keep_last=2)
+        steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+        assert steps == [6, 8]
+        latest = latest_checkpoint(str(tmp_path))
+        assert latest is not None and latest.endswith("ckpt_step8.tft")
+        assert load_checkpoint(latest)["s"] == 8
+
+    def test_latest_empty(self, tmp_path):
+        assert latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+class TestTrainDDPResume:
+    def test_save_then_resume_continues_step(self, tmp_path):
+        """train_ddp with --save-dir, then a fresh run with --resume: the
+        resumed job must continue from the checkpointed step, not step 0."""
+        save_dir = str(tmp_path / "ckpts")
+        common = [
+            sys.executable, "examples/train_ddp.py", "--cpu",
+            "--local-replicas", "2", "--min-replicas", "2",
+            "--batch-size", "4", "--save-dir", save_dir, "--save-every", "2",
+        ]
+        first = subprocess.run(
+            common + ["--steps", "6"],
+            capture_output=True, text=True, cwd=REPO, timeout=240,
+        )
+        assert first.returncode == 0, first.stderr + first.stdout
+        assert "saved checkpoint" in first.stdout
+        steps = [s for s, _ in list_checkpoints(save_dir)]
+        assert steps and steps[-1] == 6
+
+        second = subprocess.run(
+            common + ["--steps", "10", "--resume"],
+            capture_output=True, text=True, cwd=REPO, timeout=240,
+        )
+        assert second.returncode == 0, second.stderr + second.stdout
+        assert "resumed from" in second.stdout and "at step 6" in second.stdout
+        steps = [s for s, _ in list_checkpoints(save_dir)]
+        assert steps[-1] == 10

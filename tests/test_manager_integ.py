@@ -226,3 +226,52 @@ class TestDDPInteg:
         assert injector.count == 2
         assert all(r["manager_state"]["step"] == 5 for r in results)
         assert_bitwise_equal(results)
+
+
+class TestEventExport:
+    def test_events_file_written_on_replica_kill(self, lighthouse, tmp_path, monkeypatch):
+        """The persistent JSONL sink (TORCHFT_EVENTS_FILE) must capture the
+        quorum churn and the post-heal commits of a replica-kill run — the
+        crash-durable analog of the reference's OTLP exporter
+        (reference torchft/otel.py:42-86)."""
+        import json
+
+        events_file = tmp_path / "events.jsonl"
+        monkeypatch.setenv("TORCHFT_EVENTS_FILE", str(events_file))
+
+        injector = EventInjector().fail_at(replica=1, step=2)
+        runners = [
+            Runner(i, lighthouse.address(), injector, total_steps=5, min_replica_size=1)
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert injector.count == 1
+        assert_bitwise_equal(results)
+
+        lines = events_file.read_text().strip().splitlines()
+        events = [json.loads(line) for line in lines]
+        kinds = {e["kind"] for e in events}
+        assert "quorum" in kinds and "commit" in kinds
+        # quorum changed at least twice: initial formation + post-kill rejoin
+        assert sum(1 for e in events if e["kind"] == "quorum") >= 2
+        # the killed replica's post-heal commits are present
+        assert any(
+            e["kind"] == "commit" and str(e.get("replica_id", "")).startswith("replica_1")
+            for e in events
+        )
+        # every record carries the structured context fields and a timestamp
+        for e in events:
+            assert {"ts", "kind", "message", "replica_id", "step"} <= set(e)
+
+    def test_events_file_rotation(self, tmp_path, monkeypatch):
+        from torchft_tpu.utils.logging import log_event
+
+        events_file = tmp_path / "ring.jsonl"
+        monkeypatch.setenv("TORCHFT_EVENTS_FILE", str(events_file))
+        monkeypatch.setenv("TORCHFT_EVENTS_MAX_BYTES", "2000")
+        for i in range(100):
+            log_event("commit", "x" * 50, replica_id="r", rank=0, step=i)
+        assert events_file.exists()
+        rotated = events_file.with_name(events_file.name + ".1")
+        assert rotated.exists()
+        assert events_file.stat().st_size <= 2000 + 200

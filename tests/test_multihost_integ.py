@@ -1,0 +1,32 @@
+"""Multi-host (real spawned processes) integration.
+
+VERDICT r2 item #4: per-host Manager ranks over a jax multi-process mesh —
+real OS processes, one jit mesh spanning each group's processes (CPU
+backend, Gloo collectives), the elastic FT ring between groups.
+Reference wiring: torchft/manager.py:277-325, torchft/fsdp_test.py:96-120.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_groups_of_two_processes_converge():
+    """2 replica groups x 2 processes each: every process runs a Manager
+    rank (rank 0 hosts the group server, rank 1 discovers it via the store
+    handoff); the jit dp-mean spans each group's two processes; the
+    cross-group ring averages gradients.  All four processes must end
+    bitwise identical."""
+    out = subprocess.run(
+        [sys.executable, "examples/train_multihost.py",
+         "--groups", "2", "--procs-per-group", "2", "--steps", "3"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "params converged bitwise across 4 processes" in out.stdout
+    # each group's rank-1 process reached its server through the store
+    # handoff and committed every step
+    for tag in ("g0p0", "g0p1", "g1p0", "g1p1"):
+        assert f"[{tag}] done step=3" in out.stdout, out.stdout

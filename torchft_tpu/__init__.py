@@ -21,6 +21,7 @@ from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.parallel.process_group import (
     ErrorSwallowingProcessGroupWrapper,
     ManagedProcessGroup,
+    NotParticipatingError,
     ProcessGroup,
     ProcessGroupBabyTCP,
     ProcessGroupDummy,
@@ -38,6 +39,7 @@ __all__ = [
     "LocalSGD",
     "ManagedProcessGroup",
     "Manager",
+    "NotParticipatingError",
     "Optimizer",
     "OptimizerWrapper",
     "ProcessGroup",

@@ -472,13 +472,27 @@ class Manager:
 
         t_host = time.perf_counter()
         leaves, treedef = jax.tree_util.tree_flatten(value)
-        np_leaves = [np.asarray(x) for x in leaves]
-        if not self.is_participating():
-            np_leaves = [np.zeros_like(x) for x in np_leaves]
+        if should_quantize and self.is_participating():
+            # Leave device arrays on device: the quantized collective runs
+            # the Pallas quantize kernel on-chip (when on TPU) so only the
+            # int8 payload + row scales cross the device→host boundary
+            # (reference wires its Triton kernels the same way,
+            # torchft/collectives.py:297-415).  The device→host hop is then
+            # inside the collective and counted in the ``ring`` phase.
+            # Non-array leaves (Python scalars) still need numpy wrapping
+            # for the dtype checks below.
+            send_leaves: "List[Any]" = [
+                x if isinstance(x, (np.ndarray, jax.Array)) else np.asarray(x)
+                for x in leaves
+            ]
+        elif not self.is_participating():
+            send_leaves = [np.zeros_like(np.asarray(x)) for x in leaves]
+        else:
+            send_leaves = [np.asarray(x) for x in leaves]
         self._record_phase("host_sync", time.perf_counter() - t_host)
 
         if reduce_op == REDUCE_AVG:
-            if not all(_is_floating(x.dtype) for x in np_leaves):
+            if not all(_is_floating(x.dtype) for x in send_leaves):
                 raise ValueError(
                     "average reduce op is only supported for floating point arrays"
                 )
@@ -491,9 +505,9 @@ class Manager:
             if should_quantize:
                 from torchft_tpu.ops.collectives import allreduce_quantized
 
-                work = allreduce_quantized(np_leaves, pg_reduce_op, self._pg)
+                work = allreduce_quantized(send_leaves, pg_reduce_op, self._pg)
             else:
-                work = self._pg.allreduce(np_leaves, pg_reduce_op)
+                work = self._pg.allreduce(send_leaves, pg_reduce_op)
 
             def _postprocess(reduced: "List[np.ndarray]") -> Any:
                 if reduce_op == REDUCE_AVG:
@@ -514,13 +528,19 @@ class Manager:
                         exc if isinstance(exc, Exception) else RuntimeError(str(exc))
                     )
                     out.set_result(
-                        jax.tree_util.tree_unflatten(treedef, np_leaves)
+                        jax.tree_util.tree_unflatten(treedef, send_leaves)
                     )
                 else:
                     out.set_result(f.result())
 
             chained.get_future().add_done_callback(_done)
-            return Work(out)
+            managed = Work(out)
+            # surface the quantized path's wire accounting on the returned
+            # handle (set synchronously by allreduce_quantized)
+            for attr in ("wire_bytes", "unquantized_wire_bytes", "device_quantized"):
+                if hasattr(work, attr):
+                    setattr(managed, attr, getattr(work, attr))
+            return managed
         except Exception as e:  # noqa: BLE001 - captured into the protocol
             self._logger.exception(f"got exception in allreduce -- skipping: {e}")
             self.report_error(e)
@@ -552,12 +572,15 @@ class Manager:
         value (reference manager.py:790-878)."""
         # recovery (send/recv checkpoint) must be complete before committing
         if self._quorum_future is not None:
+            t_q = time.perf_counter()
             try:
                 self._quorum_future.result()
             except Exception as e:  # noqa: BLE001
                 self.report_error(
                     e if isinstance(e, Exception) else RuntimeError(str(e))
                 )
+            finally:
+                self._record_phase("quorum_wait", time.perf_counter() - t_q)
 
         if (err := self._pg.errored()) is not None:
             self.report_error(err)
@@ -622,10 +645,12 @@ class Manager:
         """Wall-clock seconds spent per protocol phase since the last call.
 
         Keys: ``quorum_wait`` (blocked waiting for the async quorum RPC —
-        the part NOT hidden behind the forward pass), ``host_sync``
-        (device→host materialisation of the allreduce input), ``ring``
-        (collective submit→completion, includes queueing), ``commit``
-        (should_commit RPC barrier).  Resets the accumulator.
+        the part NOT hidden behind the forward pass; includes the wait in
+        ``should_commit``), ``host_sync`` (device→host materialisation of
+        the allreduce input), ``ring`` (collective submit→completion,
+        includes queueing and the host-side AVG division chained after the
+        raw collective), ``commit`` (should_commit RPC barrier).  Resets
+        the accumulator.
         """
         with self._phase_lock:
             out, self._phase_acc = self._phase_acc, {}

@@ -7,16 +7,28 @@ Analog of the reference's quantized collectives
 gradients (int8 payload + f32 row scales) at the cost of quantization error
 — the DiLoCo outer-gradient path is tolerant to this by design.
 
+Two bit-compatible quantizers feed the same wire format (the analog of the
+reference wiring its Triton kernels into the collective,
+reference collectives.py:297-415):
+
+- **device path** (default for jax arrays on a TPU backend): the Pallas
+  fused absmax-quantize kernel (torchft_tpu/ops/pallas_quant.py) runs
+  *before* the device→host copy, so only int8 payload + f32 row scales
+  cross PCIe/host memory — ~4x fewer device→host AND wire bytes;
+- **host path** (numpy codec, torchft_tpu/ops/quantization.py) for host
+  arrays or non-TPU backends.
+
 SUM and AVG only, floating-point inputs only (parity: reference
 collectives.py:336-344).
 """
 
 from __future__ import annotations
 
-from typing import Any, List
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from torchft_tpu.ops import quantization as q
@@ -40,52 +52,101 @@ def _slice_rows(rows: int, world: int) -> "List[tuple[int, int]]":
     return bounds
 
 
+def _device_send_bufs(
+    arrays: "List[Any]", bounds: "List[tuple[int, int]]", rows: int, cols: int
+) -> "List[np.ndarray]":
+    """Quantize the whole flattened matrix ON DEVICE (one Pallas launch),
+    then copy only the int8 payload + f32 scales to the host and pack
+    per-destination row-slices in the shared wire layout.  Quantization is
+    per-row, so slicing after the kernel is bit-identical to quantizing
+    each slice — and costs one device→host round trip instead of
+    ``world``."""
+    from torchft_tpu.ops import pallas_quant as pq
+
+    flat = jnp.concatenate(
+        [jnp.ravel(a).astype(jnp.float32) for a in arrays]
+    )
+    mat = jnp.zeros((rows * cols,), jnp.float32).at[: flat.size].set(flat)
+    scales, payload = pq.fused_quantize_into_int8(mat.reshape(rows, cols))
+    scales_np, payload_np = np.asarray(scales), np.asarray(payload)
+    return [
+        q.pack(scales_np[start:end], payload_np[start:end])
+        for start, end in bounds
+    ]
+
+
 def allreduce_quantized(
-    arrays: "List[Any]", op: str, pg: ProcessGroup, average_by: "int | None" = None
+    arrays: "List[Any]",
+    op: str,
+    pg: ProcessGroup,
+    average_by: "int | None" = None,
+    device_quantize: "Optional[bool]" = None,
 ) -> Work:
     """8-bit quantized allreduce of a list of float arrays.
 
     Returns a Work resolving to the dequantized reduced arrays (f32
-    precision loss ~1e-2 relative; see tests for bounds).
+    precision loss ~1e-2 relative; see tests for bounds).  The Work
+    carries ``wire_bytes`` / ``unquantized_wire_bytes`` attributes with
+    the measured per-rank alltoall payload size.
 
     Args:
         average_by: divide the sum by this count (fused into the requant
             step); defaults to pg.size() when op is AVG.
+        device_quantize: quantize on-device with the Pallas kernel before
+            the device→host copy.  Default: auto — on when every input is
+            a jax array and the default backend is TPU.
     """
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
-    np_arrays = [np.asarray(a) for a in arrays]
-    for a in np_arrays:
+    # normalize non-array inputs (lists, Python scalars) without touching
+    # device arrays
+    arrays = [a if isinstance(a, jax.Array) else np.asarray(a) for a in arrays]
+    for a in arrays:
         if not jnp.issubdtype(a.dtype, jnp.floating):
             raise ValueError("quantized allreduce requires floating point arrays")
+    if device_quantize is None:
+        device_quantize = jax.default_backend() == "tpu" and all(
+            isinstance(a, jax.Array) for a in arrays
+        )
+
+    shapes = [a.shape for a in arrays]
+    sizes = [int(a.size) for a in arrays]
+    out_dtypes = [a.dtype for a in arrays]
 
     world = pg.size()
     if world <= 1:
-        out = [a.copy() for a in np_arrays]
+        out = [np.array(a) for a in arrays]
         if op == REDUCE_AVG and average_by:
             out = [a / average_by for a in out]
-        return completed_work(out)
+        solo = completed_work(out)
+        solo.wire_bytes = 0  # nothing crosses the wire at world 1
+        solo.unquantized_wire_bytes = 0
+        solo.device_quantized = False
+        return solo
     divisor = average_by if average_by is not None else (world if op == REDUCE_AVG else 0)
 
     # Flatten all arrays into one (rows, cols) matrix of quantization rows so
     # a single alltoall/allgather round covers every gradient (the reference
     # fuses arrays into one comm buffer the same way).
-    shapes = [a.shape for a in np_arrays]
-    sizes = [a.size for a in np_arrays]
-    flat = np.concatenate([a.astype(np.float32).ravel() for a in np_arrays])
-    cols = 2048 if flat.size >= 2048 else max(flat.size, 1)
-    rows = -(-flat.size // cols)
+    total = sum(sizes)
+    cols = 2048 if total >= 2048 else max(total, 1)
+    rows = -(-total // cols)
     # pad rows to a multiple of world so row-slices are even
     rows = -(-rows // world) * world
-    mat = np.zeros((rows, cols), dtype=np.float32)
-    mat.ravel()[: flat.size] = flat
-
     bounds = _slice_rows(rows, world)
-    # quantize each destination rank's row-slice separately
-    send_bufs = []
-    for start, end in bounds:
-        scales, payload = q.quantize(mat[start:end])
-        send_bufs.append(q.pack(scales, payload))
+
+    if device_quantize:
+        send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
+    else:
+        np_arrays = [np.asarray(a) for a in arrays]
+        flat = np.concatenate([a.astype(np.float32).ravel() for a in np_arrays])
+        mat = np.zeros((rows, cols), dtype=np.float32)
+        mat.ravel()[: flat.size] = flat
+        # quantize each destination rank's row-slice separately
+        send_bufs = []
+        for start, end in bounds:
+            scales, payload = q.quantize(mat[start:end])
+            send_bufs.append(q.pack(scales, payload))
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
         my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
@@ -98,11 +159,11 @@ def allreduce_quantized(
             n_rows = bounds[r][1] - bounds[r][0]
             scales, payload = q.unpack(buf, n_rows, cols)
             pieces.append(q.dequantize(scales, payload, (n_rows, cols), np.float32))
-        full = np.concatenate(pieces).ravel()[: flat.size]
+        full = np.concatenate(pieces).ravel()[:total]
         out = []
         offset = 0
-        for shape, size, arr in zip(shapes, sizes, np_arrays):
-            out.append(full[offset : offset + size].reshape(shape).astype(arr.dtype))
+        for shape, size, dtype in zip(shapes, sizes, out_dtypes):
+            out.append(full[offset : offset + size].reshape(shape).astype(dtype))
             offset += size
         return out
 
@@ -136,7 +197,13 @@ def allreduce_quantized(
             out_fut.set_exception(e)
 
     work.get_future().add_done_callback(_stage2)
-    return Work(out_fut)
+    out_work = Work(out_fut)
+    # Observability: measured wire bytes vs the unquantized f32 equivalent
+    # (the ~4x reduction the codec exists for).
+    out_work.wire_bytes = sum(b.nbytes for b in send_bufs)
+    out_work.unquantized_wire_bytes = 4 * total
+    out_work.device_quantized = bool(device_quantize)
+    return out_work
 
 
 def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:

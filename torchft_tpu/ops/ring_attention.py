@@ -19,6 +19,7 @@ inside a larger shard_mapped step via :func:`ring_attention_local`.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 from typing import Optional
 
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30
+_warned_dense: set = set()
 
 
 def ring_attention_local(
@@ -121,8 +123,24 @@ def dense_attention(
     """Plain (single-pass) causal attention over the full sequence,
     ``[B, T, H, D]`` — the cp=1 path; XLA shards it via constraint
     propagation (batch/head parallel). GQA: K/V with fewer heads are
-    broadcast up to the query head count."""
+    broadcast up to the query head count.
+
+    Materializes the full ``[B, H, T, T]`` score matrix — O(T^2) HBM.
+    Warns once per (B, H, T) at trace time beyond 4k context; use
+    ``attn_impl='ring'`` (or 'ulysses') for long sequences."""
     d = q.shape[-1]
+    t_full = q.shape[1]
+    if t_full > 4096:
+        key = (q.shape[0], q.shape[2], t_full)
+        if key not in _warned_dense:
+            _warned_dense.add(key)
+            score_gb = q.shape[0] * q.shape[2] * t_full * t_full * 4 / 1024**3
+            logging.getLogger(__name__).warning(
+                "dense_attention at T=%d materializes a [%d, %d, %d, %d] f32 "
+                "score matrix (~%.1f GiB); use attn_impl='ring' or 'ulysses' "
+                "for long context",
+                t_full, q.shape[0], q.shape[2], t_full, t_full, score_gb,
+            )
     if k.shape[2] != q.shape[2]:
         if q.shape[2] % k.shape[2] != 0:
             raise ValueError(

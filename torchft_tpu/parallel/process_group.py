@@ -1104,6 +1104,198 @@ class ManagedProcessGroup(ProcessGroup):
 # Subprocess-isolated ("Baby") process groups
 # ---------------------------------------------------------------------------
 
+# Arrays >= this cross the parent<->worker boundary as POSIX shared-memory
+# segments instead of pickled pipe bytes: the pipe path costs two full
+# serializations plus 2x the payload in 64 KiB pipe writes per direction
+# (reference streams tensors with backpressure instead of pickling,
+# torchft/process_group.py:1602-1645).
+_SHM_MIN_BYTES = 1 << 20
+
+
+class _ShmRef:
+    """Pickle-tiny stand-in for an array staged in shared memory."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name: str, shape: "Tuple[int, ...]", dtype: str) -> None:
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+    def __getstate__(self):
+        return (self.name, self.shape, self.dtype)
+
+    def __setstate__(self, state):
+        self.name, self.shape, self.dtype = state
+
+
+def _shm_untrack(shm: Any) -> None:
+    """Drop the resource-tracker claim on a segment.
+
+    Parent and spawned workers share ONE tracker process whose cache is a
+    set of names, and this Python registers on attach as well as create —
+    so cross-process register/unregister pairs can't be balanced per
+    process.  Protocol instead: every create/attach untracks immediately
+    (the set stays empty of our names) and :func:`_shm_unlink_balanced`
+    re-registers just before the final unlink so unlink's internal
+    unregister finds the entry.  Tradeoff: the tracker won't clean our
+    segments if a process dies mid-op — the Baby design's parent survives
+    and does (``_release_shms`` / the view finalizers)."""
+    from multiprocessing import resource_tracker
+
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:  # noqa: BLE001 - tracker API is version-dependent
+        pass
+
+
+def _shm_unlink_balanced(shm: Any) -> None:
+    """Unlink with tracker bookkeeping balanced (see :func:`_shm_untrack`);
+    safe when another handle already unlinked the name."""
+    from multiprocessing import resource_tracker
+
+    try:
+        resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        shm.unlink()  # internal unregister consumes the registration
+    except FileNotFoundError:
+        _shm_untrack(shm)
+
+
+def _finalize_shm_view(shm: Any) -> None:
+    shm.close()
+    _shm_unlink_balanced(shm)
+
+
+class _ShmIn:
+    """A resolved input segment inside the worker: kept open for the op's
+    lifetime and reusable as the (already warm) result buffer."""
+
+    __slots__ = ("ref", "shm", "view", "used")
+
+    def __init__(self, ref: "_ShmRef", shm: Any, view: np.ndarray) -> None:
+        self.ref = ref
+        self.shm = shm
+        self.view = view
+        self.used = False
+
+
+def _shm_stage_value(value: Any, created: "List[Any]") -> Any:
+    """Replace large arrays in ``value`` (an array or list of arrays) with
+    ``_ShmRef``s backed by fresh segments appended to ``created``."""
+    from multiprocessing import shared_memory
+
+    def stage(a: Any) -> Any:
+        if not isinstance(a, np.ndarray) or a.nbytes < _SHM_MIN_BYTES:
+            return a
+        a = np.ascontiguousarray(a)
+        shm = shared_memory.SharedMemory(create=True, size=a.nbytes)
+        _shm_untrack(shm)
+        dst = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf)
+        dst[...] = a
+        created.append(shm)
+        return _ShmRef(shm.name, a.shape, str(a.dtype))
+
+    if isinstance(value, list):
+        return [stage(a) for a in value]
+    return stage(value)
+
+
+def _shm_resolve_value(value: Any, opened: "List[_ShmIn]") -> Any:
+    """Inverse of :func:`_shm_stage_value`: materialize ``_ShmRef``s as
+    zero-copy views; the backing segments are appended to ``opened`` and
+    must outlive the views."""
+    from multiprocessing import shared_memory
+
+    def resolve(a: Any) -> Any:
+        if not isinstance(a, _ShmRef):
+            return a
+        shm = shared_memory.SharedMemory(name=a.name)
+        _shm_untrack(shm)  # the parent owns (and unlinks) input segments
+        view = np.ndarray(a.shape, dtype=np.dtype(a.dtype), buffer=shm.buf)
+        opened.append(_ShmIn(a, shm, view))
+        return view
+
+    if isinstance(value, list):
+        return [resolve(a) for a in value]
+    return resolve(value)
+
+
+def _shm_stage_result(value: Any, inputs: "List[_ShmIn]") -> Any:
+    """Worker-side result staging: write each large result array into a
+    matching (shape+dtype) input segment — already-warm pages, no fresh
+    allocation — falling back to a fresh segment.  Small values pickle."""
+    from multiprocessing import shared_memory
+
+    def stage(a: Any) -> Any:
+        if not isinstance(a, np.ndarray) or a.nbytes < _SHM_MIN_BYTES:
+            return a
+        for inp in inputs:
+            if (
+                not inp.used
+                and inp.view.shape == a.shape
+                and inp.view.dtype == a.dtype
+            ):
+                inp.used = True
+                if inp.view is not a and not np.shares_memory(inp.view, a):
+                    inp.view[...] = a
+                return inp.ref
+        shm = shared_memory.SharedMemory(create=True, size=a.nbytes)
+        _shm_untrack(shm)  # ownership passes to the parent (it unlinks)
+        np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf)[...] = a
+        shm.close()
+        return _ShmRef(shm.name, a.shape, str(a.dtype))
+
+    if isinstance(value, list):
+        return [stage(a) for a in value]
+    return stage(value)
+
+
+def _shm_discard_value(value: Any) -> None:
+    """Reclaim result segments whose message will never be consumed (reader
+    superseded by reconfigure, future already failed): worker-created
+    segments are untracked, so dropping their refs without unlinking would
+    pin the payload in /dev/shm forever."""
+    from multiprocessing import shared_memory
+
+    refs = value if isinstance(value, list) else [value]
+    for a in refs:
+        if not isinstance(a, _ShmRef):
+            continue
+        try:
+            shm = shared_memory.SharedMemory(name=a.name)
+        except FileNotFoundError:
+            continue  # an input-reused segment the parent already unlinked
+        _shm_untrack(shm)
+        shm.close()
+        _shm_unlink_balanced(shm)
+
+
+def _shm_wrap_value(value: Any) -> Any:
+    """Parent-side result decode: materialize each ``_ShmRef`` as a ZERO-
+    COPY view of its segment; a GC finalizer on the array closes (and, for
+    worker-created segments, unlinks) the mapping.  Must run before the
+    parent unlinks the op's input segments (attach needs the name; the
+    mapping survives the unlink)."""
+    import weakref
+
+    from multiprocessing import shared_memory
+
+    def wrap(a: Any) -> Any:
+        if not isinstance(a, _ShmRef):
+            return a
+        shm = shared_memory.SharedMemory(name=a.name)
+        _shm_untrack(shm)
+        arr = np.ndarray(a.shape, dtype=np.dtype(a.dtype), buffer=shm.buf)
+        weakref.finalize(arr, _finalize_shm_view, shm)
+        return arr
+
+    if isinstance(value, list):
+        return [wrap(a) for a in value]
+    return wrap(value)
+
 
 def _baby_worker(
     pg_cls: type,
@@ -1150,13 +1342,23 @@ def _baby_worker(
             except (BrokenPipeError, OSError):
                 pass
 
-    def _finish(op_id: int, work: Any) -> None:
+    def _finish(op_id: int, work: Any, opened: "List[_ShmIn]") -> None:
         try:
-            value = work.wait(timeout=timeout) if isinstance(work, Work) else work
-        except Exception as e:  # noqa: BLE001 - shipped to parent
-            _send(op_id, e)
-            return
-        _send(op_id, value)
+            try:
+                value = (
+                    work.wait(timeout=timeout) if isinstance(work, Work) else work
+                )
+            except Exception as e:  # noqa: BLE001 - shipped to parent
+                _send(op_id, e)
+                return
+            # stage results into the warm input segments where shapes
+            # match (allreduce/broadcast/alltoall), fresh segments
+            # otherwise; the parent owns every segment from here
+            value = _shm_stage_result(value, opened)
+            _send(op_id, value)
+        finally:
+            for inp in opened:
+                inp.shm.close()
 
     try:
         while True:
@@ -1171,12 +1373,16 @@ def _baby_worker(
             # (pipelined collectives must match across ranks); only the
             # wait() moves to the pool so an in-flight op can't block the
             # command loop.
+            opened: "List[_ShmIn]" = []
             try:
+                args = [_shm_resolve_value(a, opened) for a in args]
                 work = getattr(pg, func)(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 - shipped to parent
+                for inp in opened:
+                    inp.shm.close()
                 _send(op_id, e)
                 continue
-            pool.submit(_finish, op_id, work)
+            pool.submit(_finish, op_id, work, opened)
     finally:
         pool.shutdown(wait=False)
         try:
@@ -1202,7 +1408,11 @@ class ProcessGroupBaby(ProcessGroup):
 
     PG_CLASS: type = None  # set by subclasses
 
-    def __init__(self, timeout: float = 60.0) -> None:
+    def __init__(self, timeout: float = 60.0, max_active_work: int = 16) -> None:
+        """``max_active_work``: backpressure cap on in-flight ops — each op
+        can hold staged shared-memory payloads, so an unbounded submitter
+        would pin unbounded host memory (reference num_active_work,
+        torchft/process_group.py:1602-1645).  0 disables the cap."""
         super().__init__(timeout)
         self._proc: Optional[Any] = None
         self._pipe: Optional[Any] = None
@@ -1212,7 +1422,10 @@ class ProcessGroupBaby(ProcessGroup):
         self._next_op_id = 0
         self._gen = 0  # bumped per configure; guards against stale readers
         self._pending: Dict[int, Future] = {}
+        self._pending_shm: "Dict[int, List[Any]]" = {}
+        self._max_active_work = max_active_work
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._reader: Optional[threading.Thread] = None
 
     def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
@@ -1289,15 +1502,38 @@ class ProcessGroupBaby(ProcessGroup):
                 return
             with self._lock:
                 if gen != self._gen:
-                    return  # reconfigured under us; results no longer ours
+                    # reconfigured under us; results no longer ours — but
+                    # any worker-created result segments still need reaping
+                    _shm_discard_value(value)
+                    return
                 fut = self._pending.pop(op_id, None)
+                in_shms = self._pending_shm.pop(op_id, [])
                 if fut is not None and isinstance(value, Exception):
                     self._errored_exc = self._errored_exc or value
-            if fut is not None:
-                if isinstance(value, Exception):
+                self._cond.notify_all()
+            if fut is None or isinstance(value, Exception):
+                self._release_shms(in_shms)
+                if not isinstance(value, Exception):
+                    _shm_discard_value(value)
+                if fut is not None:
                     fut.set_exception(value)
-                else:
-                    fut.set_result(value)
+                continue
+            # decode BEFORE unlinking inputs: results may live in reused
+            # input segments (attach needs the name; mappings survive)
+            try:
+                result = _shm_wrap_value(value)
+            except Exception as e:  # noqa: BLE001 - decode failure
+                self._release_shms(in_shms)
+                fut.set_exception(e)
+                continue
+            self._release_shms(in_shms)
+            fut.set_result(result)
+
+    @staticmethod
+    def _release_shms(shms: "List[Any]") -> None:
+        for shm in shms:
+            shm.close()
+            _shm_unlink_balanced(shm)
 
     def _fail_all(self, exc: Exception, gen: "Optional[int]" = None) -> None:
         with self._lock:
@@ -1305,6 +1541,10 @@ class ProcessGroupBaby(ProcessGroup):
                 return  # stale reader of a pre-reconfigure worker
             self._errored_exc = self._errored_exc or exc
             pending, self._pending = self._pending, {}
+            pending_shm, self._pending_shm = self._pending_shm, {}
+            self._cond.notify_all()
+        for shms in pending_shm.values():
+            self._release_shms(shms)
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(exc)
@@ -1338,6 +1578,23 @@ class ProcessGroupBaby(ProcessGroup):
 
     def _submit(self, func: str, *args: Any, **kwargs: Any) -> Work:
         with self._lock:
+            # backpressure: bound in-flight ops (each may pin staged shm)
+            if self._max_active_work > 0:
+                deadline = time.monotonic() + self._timeout
+                while (
+                    len(self._pending) >= self._max_active_work
+                    and self._errored_exc is None
+                    and self._pipe is not None
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                        return failed_work(
+                            TimeoutError(
+                                f"{len(self._pending)} ops in flight >= "
+                                f"max_active_work={self._max_active_work} "
+                                f"for {self._timeout}s"
+                            )
+                        )
             if self._errored_exc is not None:
                 return failed_work(self._errored_exc)
             if self._pipe is None:
@@ -1347,11 +1604,32 @@ class ProcessGroupBaby(ProcessGroup):
             fut: Future = Future()
             self._pending[op_id] = fut
             pipe = self._pipe  # local ref: abort() may null the attribute
+        # stage large payloads outside the lock (memcpy can be tens of ms);
+        # the segments stay alive until the op resolves
+        created: "List[Any]" = []
+        try:
+            args = tuple(_shm_stage_value(a, created) for a in args)
+        except Exception as e:  # noqa: BLE001 - staging failure fails the op
+            self._release_shms(created)
+            with self._lock:
+                self._pending.pop(op_id, None)
+                self._cond.notify_all()
+            return failed_work(e)
+        with self._lock:
+            if op_id in self._pending:
+                self._pending_shm[op_id] = created
+            else:
+                # failed/aborted while staging; nothing will clean these
+                self._release_shms(created)
+                created = []
         try:
             pipe.send((op_id, func, args, kwargs))
         except (BrokenPipeError, OSError) as e:
             with self._lock:
                 self._pending.pop(op_id, None)
+                shms = self._pending_shm.pop(op_id, [])
+                self._cond.notify_all()
+            self._release_shms(shms)
             self._errored_exc = self._errored_exc or e
             return failed_work(e)
         return Work(fut).with_timeout(self._timeout)

@@ -4,18 +4,28 @@ Analog of the reference's structured-event pipeline (reference:
 torchft/otel.py:42-86 and manager.py:659-669,848-858): three well-known
 loggers receive one record per protocol event, each carrying
 ``extra={job_id, replica_id, rank, quorum_id, step, ...}``.  OTLP export is
-out of scope for this environment (zero egress); the pipeline here writes
-structured records to stdlib logging with the extras rendered inline, and an
-in-memory ring of recent events that the lighthouse dashboard and tests can
-inspect.
+out of scope for this environment (zero egress); the pipeline here has
+three sinks:
+
+- stdlib logging with the extras rendered inline;
+- an in-memory ring of recent events that the lighthouse dashboard and
+  tests can inspect;
+- a **persistent JSONL file** (the crash-durable sink — an FT system's
+  logs matter most when the process dies): set ``TORCHFT_EVENTS_FILE`` to
+  a path and every event is appended as one JSON line, flushed per event,
+  with size-based rotation to ``<path>.1`` at ``TORCHFT_EVENTS_MAX_BYTES``
+  (default 16 MiB).
 """
 
 from __future__ import annotations
 
 import collections
+import json
 import logging
+import os
 import threading
-from typing import Any, Deque, Dict
+import time
+from typing import Any, Deque, Dict, Optional, TextIO
 
 _EVENT_RING_SIZE = 256
 
@@ -34,6 +44,83 @@ _LOGGERS = {
 }
 
 
+class _FileExporter:
+    """Append-per-event JSONL writer with size-based rotation.
+
+    Flushes after every event: a SIGKILLed replica must leave its last
+    quorum/commit/error on disk (reference's OTLP exporter flushes per
+    batch for the same reason, torchft/otel.py:42-86).
+    """
+
+    def __init__(self, path: str, max_bytes: int) -> None:
+        self._path = path
+        self._max_bytes = max_bytes
+        self._fh: "Optional[TextIO]" = None
+
+    def write(self, record: "Dict[str, Any]") -> None:
+        try:
+            if self._fh is None:
+                self._fh = open(self._path, "a", encoding="utf-8")
+            elif self._stale():
+                # another process rotated the shared file out from under us
+                # (WatchedFileHandler pattern): reopen before writing so we
+                # never keep appending to the rotated inode
+                self._fh.close()
+                self._fh = open(self._path, "a", encoding="utf-8")
+            if self._fh.tell() > self._max_bytes:
+                self._fh.close()
+                self._fh = None
+                # racing rotators: os.replace is atomic, and the loser's
+                # reopen lands on the fresh file via the _stale() check
+                os.replace(self._path, self._path + ".1")
+                self._fh = open(self._path, "a", encoding="utf-8")
+            json.dump(record, self._fh, default=str)
+            self._fh.write("\n")
+            self._fh.flush()
+        except OSError as e:  # never take down training for a log sink
+            logging.getLogger(__name__).warning(
+                "event file write failed (%s): %s", self._path, e
+            )
+
+    def _stale(self) -> bool:
+        assert self._fh is not None
+        try:
+            disk = os.stat(self._path)
+        except FileNotFoundError:
+            return True
+        ours = os.fstat(self._fh.fileno())
+        return (disk.st_ino, disk.st_dev) != (ours.st_ino, ours.st_dev)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+_exporter: "Optional[_FileExporter]" = None
+_exporter_env: "Optional[str]" = None  # env value the exporter was built for
+
+
+def _file_exporter() -> "Optional[_FileExporter]":
+    """Resolve the JSONL exporter from ``TORCHFT_EVENTS_FILE`` (re-resolved
+    when the env value changes, so tests and launchers can redirect)."""
+    global _exporter, _exporter_env
+    path = os.environ.get("TORCHFT_EVENTS_FILE") or None
+    if path != _exporter_env:
+        if _exporter is not None:
+            _exporter.close()
+        _exporter = (
+            _FileExporter(
+                path,
+                int(os.environ.get("TORCHFT_EVENTS_MAX_BYTES", 16 * 1024 * 1024)),
+            )
+            if path
+            else None
+        )
+        _exporter_env = path
+    return _exporter
+
+
 def log_event(kind: str, message: str, **extra: Any) -> None:
     """Record a structured protocol event (kind in {quorum, commit, error})."""
     if kind not in _LOGGERS:
@@ -41,6 +128,9 @@ def log_event(kind: str, message: str, **extra: Any) -> None:
     record = {"kind": kind, "message": message, **extra}
     with _lock:
         _recent_events.append(record)
+        exporter = _file_exporter()
+        if exporter is not None:
+            exporter.write({"ts": time.time(), **record})
     logger = _LOGGERS[kind]
     rendered = " ".join(f"{k}={v}" for k, v in extra.items())
     if kind == "error":
