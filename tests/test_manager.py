@@ -138,6 +138,22 @@ class TestManagerHappyPath:
         np.testing.assert_allclose(out["a"], np.full(2, 2.0))
         np.testing.assert_allclose(out["b"][0], np.full(3, 4.0))
 
+    def test_jax_array_leaves_pass_through_unmaterialized(self, manager_ctx):
+        # device arrays go to the PG unconverted (the device→host sync
+        # runs on the PG worker, not the submitting thread); mixed
+        # jax/numpy/scalar pytrees still average correctly
+        import jax.numpy as jnp
+
+        build, client, _ = manager_ctx
+        manager = build()
+        client._quorum.return_value = make_quorum()
+        manager.start_quorum()
+        grads = {"j": jnp.full((4,), 6.0), "n": np.full(2, 4.0), "s": 8.0}
+        out = manager.allreduce(grads).wait(timeout=10)
+        np.testing.assert_allclose(np.asarray(out["j"]), np.full(4, 3.0))
+        np.testing.assert_allclose(out["n"], np.full(2, 2.0))
+        np.testing.assert_allclose(np.asarray(out["s"]), 4.0)
+
 
 class TestManagerHealing:
     def test_async_heal_applies_on_commit(self, manager_ctx):

@@ -67,12 +67,20 @@ class PGTransport(CheckpointTransport[Any]):
             dtype=np.uint8,
         )
         for dst in dst_ranks:
-            self._pg.send(header, dst, tag=_META_TAG).wait(timeout=timeout)
+            # submit the whole stream, then reap: the PG worker executes
+            # in submission order, and keeping its queue non-empty lets it
+            # drain the socket continuously instead of idling one
+            # thread-wakeup round trip per leaf
+            works = [self._pg.send(header, dst, tag=_META_TAG)]
             for i, arr in enumerate(arrays):
                 if arr is not None:
-                    self._pg.send(
-                        arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
-                    ).wait(timeout=timeout)
+                    works.append(
+                        self._pg.send(
+                            arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
+                        )
+                    )
+            for w in works:
+                w.wait(timeout=timeout)
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
@@ -94,22 +102,58 @@ class PGTransport(CheckpointTransport[Any]):
             except Exception:  # noqa: BLE001 - fall back to fresh alloc
                 inplace_leaves = None
 
-        leaves: List[Any] = []
+        # Submit every tensor recv up front (the PG worker runs them in
+        # order, streaming the socket without per-leaf wakeup gaps); in-
+        # place targets go straight to the wire reader as recv(out=...)
+        # (uint8 view: the wire carries flat bytes).
+        works: "List[Optional[Any]]" = []
         for i, meta in enumerate(header["leaves"]):
             if meta["kind"] == "object":
-                leaves.append(meta["value"])
+                works.append(None)
                 continue
-            raw = self._pg.recv(src_rank, tag=_TENSOR_TAG + i).wait(timeout=timeout)
-            arr = raw.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
-            if (
-                inplace_leaves is not None
-                and isinstance(inplace_leaves[i], np.ndarray)
-                and inplace_leaves[i].shape == arr.shape
-                and inplace_leaves[i].dtype == arr.dtype
-            ):
-                inplace_leaves[i][...] = arr
-                leaves.append(inplace_leaves[i])
-            else:
-                leaves.append(arr.copy())
+            out = None
+            if inplace_leaves is not None:
+                target = inplace_leaves[i]
+                if (
+                    isinstance(target, np.ndarray)
+                    and target.shape == tuple(meta["shape"])
+                    and str(target.dtype) == meta["dtype"]
+                    and target.flags.c_contiguous
+                ):
+                    out = target
+            works.append(
+                (
+                    self._pg.recv(
+                        src_rank,
+                        tag=_TENSOR_TAG + i,
+                        out=None if out is None else out.reshape(-1).view(np.uint8),
+                    ),
+                    out,
+                )
+            )
+
+        leaves: List[Any] = []
+        try:
+            for meta, w in zip(header["leaves"], works):
+                if w is None:
+                    leaves.append(meta["value"])
+                    continue
+                work, out = w
+                raw = work.wait(timeout=timeout)
+                if out is not None:
+                    leaves.append(out)
+                else:
+                    # raw is a fresh private buffer; the reshaped view owns it
+                    leaves.append(
+                        raw.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+                    )
+        except Exception:
+            # Abandoning mid-stream leaves the tag stream desynced AND
+            # queued in-place recvs that would keep writing into LIVE
+            # training buffers as bytes arrive.  Abort tears the PG down so
+            # no queued op ever executes; the Manager latches the error and
+            # reconfigures at the next quorum.
+            self._pg.abort()
+            raise
         treedef = jax.tree_util.tree_structure(header["skeleton"])
         return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -488,7 +488,17 @@ class Manager:
         elif not self.is_participating():
             send_leaves = [np.zeros_like(np.asarray(x)) for x in leaves]
         else:
-            send_leaves = [np.asarray(x) for x in leaves]
+            # Leaves pass through unmaterialized: the PG converts on its
+            # worker thread, so the device→host sync overlaps whatever the
+            # caller does next instead of blocking this thread (counted in
+            # the ``ring`` phase; the DiLoCo fragment-overlap pattern
+            # depends on this submit being non-blocking).  Non-array leaves
+            # (Python scalars) still need numpy wrapping for the dtype
+            # checks below.
+            send_leaves = [
+                x if isinstance(x, (np.ndarray, jax.Array)) else np.asarray(x)
+                for x in leaves
+            ]
         self._record_phase("host_sync", time.perf_counter() - t_host)
 
         if reduce_op == REDUCE_AVG:
@@ -646,11 +656,12 @@ class Manager:
 
         Keys: ``quorum_wait`` (blocked waiting for the async quorum RPC —
         the part NOT hidden behind the forward pass; includes the wait in
-        ``should_commit``), ``host_sync`` (device→host materialisation of
-        the allreduce input), ``ring`` (collective submit→completion,
-        includes queueing and the host-side AVG division chained after the
-        raw collective), ``commit`` (should_commit RPC barrier).  Resets
-        the accumulator.
+        ``should_commit``), ``host_sync`` (caller-thread flatten +
+        zero-fill; the device→host materialisation itself runs on the PG
+        worker and lands in ``ring``), ``ring`` (collective
+        submit→completion: device sync, queueing, the wire, and the
+        host-side AVG division chained after the raw collective),
+        ``commit`` (should_commit RPC barrier).  Resets the accumulator.
         """
         with self._phase_lock:
             out, self._phase_acc = self._phase_acc, {}

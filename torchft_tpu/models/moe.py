@@ -91,7 +91,11 @@ def moe_ffn(
 
     With a mesh, the ``[E, C, d]`` expert buffers get ``P(ep, ...)``
     sharding constraints so XLA dispatches tokens to expert shards over the
-    ep axis (all-to-all on ICI).
+    ep axis (all-to-all on ICI).  ``mesh="manual"`` applies the constraint
+    with a bare PartitionSpec — the form required inside a partial-manual
+    shard_map (e.g. the pipeline), where ep stays automatic but a
+    NamedSharding over the full mesh is rejected for mentioning manual
+    axes.
     """
     b, t, d = x.shape
     n = b * t
@@ -140,7 +144,11 @@ def moe_ffn(
         preferred_element_type=jnp.float32,
     ).astype(act)
     if mesh is not None:
-        spec = NamedSharding(mesh, P(cfg.ep_axis, None, None))
+        spec = (
+            P(cfg.ep_axis, None, None)
+            if isinstance(mesh, str)
+            else NamedSharding(mesh, P(cfg.ep_axis, None, None))
+        )
         expert_in = jax.lax.with_sharding_constraint(expert_in, spec)
 
     wg = params["w_gate"].astype(act)

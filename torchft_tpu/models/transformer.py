@@ -294,12 +294,16 @@ def _make_block(
 
     def attention(q, k, v):
         if manual_cp:
-            if cfg.attn_impl != "ring":
-                raise ValueError(
-                    "manual-cp blocks support ring attention only"
+            if cfg.attn_impl == "ring":
+                return ring_attention_local(
+                    q, k, v, axis_name=cfg.cp_axis, causal=True
                 )
-            return ring_attention_local(
-                q, k, v, axis_name=cfg.cp_axis, causal=True
+            if cfg.attn_impl == "ulysses":
+                return ulysses_attention_local(
+                    q, k, v, axis_name=cfg.cp_axis, causal=True
+                )
+            raise ValueError(
+                "manual-cp blocks support ring or ulysses attention only"
             )
         if cfg.attn_impl in ("ring", "ulysses"):
             if mesh is None:
@@ -458,56 +462,77 @@ def forward_pipelined(
     mesh: Mesh,
     microbatches: int = 4,
     pp_axis: str = "pp",
-) -> jax.Array:
+    return_aux: bool = False,
+) -> "jax.Array | tuple":
     """Pipeline-parallel forward: decoder blocks GPipe-scheduled over the
     ``pp`` mesh axis (torchft_tpu/parallel/pipeline.py), embedding/head
     outside the pipe.
 
     Each stage holds ``n_layers / pp`` consecutive blocks (the stacked
-    layer dim is sharded over pp). Supported attention: ``dense``, and
-    ``ring`` when the mesh has a ``cp`` axis — the pipeline shard_map goes
-    manual over (pp, cp) and each stage runs the local ring body, so
-    long-context sequence parallelism composes with the pipeline.
-    MoE/ulysses remain out of scope (their sharding-constraint /
-    all-to-all plumbing doesn't nest here).
+    layer dim is sharded over pp). Composes with the other parallelism
+    axes:
+
+    - ``attn_impl='ring'`` / ``'ulysses'`` with a ``cp`` mesh axis: the
+      pipeline shard_map goes manual over (pp, cp) and each stage runs the
+      local sequence-parallel body (K/V ppermute ring / head all-to-all);
+    - ``n_experts > 0`` (MoE / ep): expert FFNs run inside the stage; the
+      load-balance aux loss rides the pipe as a side stream of the
+      activation pytree and is returned with ``return_aux=True``. Aux is
+      computed per microbatch (batch statistics over each microbatch
+      rather than the full batch — an equally valid estimator).
     """
-    ring = cfg.attn_impl == "ring"
-    if cfg.attn_impl not in ("dense", "ring") or cfg.n_experts:
+    manual_cp = cfg.attn_impl in ("ring", "ulysses")
+    if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(
-            "forward_pipelined supports dense or ring attention with a "
-            "dense FFN only"
+            f"unknown attn_impl {cfg.attn_impl!r}; "
+            "expected 'dense', 'ring', or 'ulysses'"
         )
-    if ring and cfg.cp_axis not in mesh.axis_names:
+    if manual_cp and cfg.cp_axis not in mesh.axis_names:
         raise ValueError(
-            f"ring attention requires a {cfg.cp_axis!r} mesh axis; "
-            f"this mesh has {mesh.axis_names}"
+            f"{cfg.attn_impl} attention requires a {cfg.cp_axis!r} mesh "
+            f"axis; this mesh has {mesh.axis_names}"
         )
     from torchft_tpu.parallel.pipeline import pipeline_apply
 
-    t = tokens.shape[1]
+    b, t = tokens.shape
     x = _embed(params, tokens, cfg, sharded=True)
-    positions = None if ring else jnp.arange(t)
-    block = _make_block(cfg, None, manual_cp=ring)
+    positions = None if manual_cp else jnp.arange(t)
+    # MoE blocks pin their [E, C, d] expert buffers to the ep axis inside
+    # the pipeline's partial-manual shard_map — via a bare-PartitionSpec
+    # constraint ("manual" sentinel), since ep stays automatic in there
+    moe_mesh = (
+        "manual" if cfg.n_experts and cfg.ep_axis in mesh.axis_names else None
+    )
+    block = _make_block(cfg, moe_mesh, manual_cp=manual_cp)
 
     def layer_fn(h, layer_params):
-        return block(h, layer_params, positions)[0]
+        y, aux = block(h["x"], layer_params, positions)
+        if manual_cp:
+            # aux is computed from this cp shard's local tokens: average
+            # over cp for the global-batch statistic (also makes the value
+            # cp-invariant, which the pipe's carry signature requires)
+            aux = jax.lax.pmean(aux, cfg.cp_axis)
+        return {"x": y, "aux": h["aux"] + aux}
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    # pipeline_apply is partial-manual over pp (+cp for ring): batch
-    # (dp/fsdp/ep) and weight (fsdp/tp) shardings flow automatically from
-    # input shardings
-    x = pipeline_apply(
+    # pipeline_apply is partial-manual over pp (+cp for ring/ulysses):
+    # batch (dp/fsdp/ep) and weight (fsdp/tp) shardings flow automatically
+    # from input shardings; the scalar aux stream broadcasts per example
+    out = pipeline_apply(
         params["blocks"],
-        x,
+        {"x": x, "aux": jnp.zeros((b,), jnp.float32)},
         layer_fn,
         mesh,
         axis_name=pp_axis,
         microbatches=microbatches,
-        seq_axis=cfg.cp_axis if ring else None,
+        seq_axis=cfg.cp_axis if manual_cp else None,
     )
-    return _head(params, x)
+    logits = _head(params, out["x"])
+    if return_aux:
+        return logits, out["aux"].mean()
+    return logits
 
 
 def loss_fn(

@@ -48,24 +48,29 @@ def _stage_apply(
 
 def pipeline_apply_local(
     params: Params,
-    microbatches: jax.Array,
-    fn: "Callable[[jax.Array, Params], jax.Array]",
+    microbatches: Any,
+    fn: "Callable[[Any, Params], Any]",
     axis_name: str = "pp",
-) -> jax.Array:
+) -> Any:
     """Per-shard GPipe body; must run inside shard_map over ``axis_name``.
 
     Args:
         params: this stage's layer stack, pytree with leading ``[L/S]`` dim.
-        microbatches: ``[M, mb, ...]`` — full microbatch set (replicated
+        microbatches: activation pytree (an array is the common case),
+            every leaf ``[M, mb, ...]`` — full microbatch set (replicated
             across stages; only stage 0 feeds it into the pipe).
-        fn: one decoder-layer step ``fn(x, layer_params) -> x``.
+            Multi-leaf activations let side streams ride the pipe (e.g.
+            the MoE load-balance aux loss accumulating across stages).
+        fn: one decoder-layer step ``fn(x, layer_params) -> x`` over the
+            activation pytree.
 
-    Returns ``[M, mb, ...]`` outputs, identical on every stage (the last
-    stage's results are broadcast back via psum).
+    Returns ``[M, mb, ...]``-leaved outputs, identical on every stage (the
+    last stage's results are broadcast back via psum).
     """
+    tmap = jax.tree_util.tree_map
     stage = jax.lax.axis_index(axis_name)
     size = jax.lax.axis_size(axis_name)
-    m = microbatches.shape[0]
+    m = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     n_ticks = m + size - 1
     perm_fwd = [(i, i + 1) for i in range(size - 1)]
 
@@ -73,30 +78,38 @@ def pipeline_apply_local(
         buf, outputs = carry
         mb_idx = t - stage
         active = (mb_idx >= 0) & (mb_idx < m)
+        idx = jnp.clip(mb_idx, 0, m - 1)
         # stage 0 pulls the next microbatch; later stages consume the
         # activation that hopped in last tick
-        feed = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(mb_idx, 0, m - 1), axis=0, keepdims=False
+        feed = tmap(
+            lambda mbs: jax.lax.dynamic_index_in_dim(
+                mbs, idx, axis=0, keepdims=False
+            ),
+            microbatches,
         )
-        x_in = jnp.where(stage == 0, feed, buf)
+        x_in = tmap(lambda f, b: jnp.where(stage == 0, f, b), feed, buf)
         y = _stage_apply(fn, x_in, params)
         # bubble ticks produce garbage; zero it so the output scatter and
         # the ppermute hand clean values downstream
-        y = jnp.where(active, y, jnp.zeros_like(y))
+        y = tmap(lambda v: jnp.where(active, v, jnp.zeros_like(v)), y)
         is_last = stage == size - 1
-        outputs = jax.lax.dynamic_update_index_in_dim(
-            outputs,
-            jnp.where(
-                active & is_last,
-                y,
-                jax.lax.dynamic_index_in_dim(
-                    outputs, jnp.clip(mb_idx, 0, m - 1), axis=0, keepdims=False
+        outputs = tmap(
+            lambda outs, v: jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(
+                    active & is_last,
+                    v,
+                    jax.lax.dynamic_index_in_dim(
+                        outs, idx, axis=0, keepdims=False
+                    ),
                 ),
+                idx,
+                axis=0,
             ),
-            jnp.clip(mb_idx, 0, m - 1),
-            axis=0,
+            outputs,
+            y,
         )
-        buf = jax.lax.ppermute(y, axis_name, perm_fwd)
+        buf = tmap(lambda v: jax.lax.ppermute(v, axis_name, perm_fwd), y)
         return (buf, outputs), None
 
     # pvary: the carry becomes device-varying after one tick (it depends on
@@ -104,15 +117,18 @@ def pipeline_apply_local(
     # axis type or scan rejects the carry signature (shard_map vma rule)
     _pcast = getattr(jax.lax, "pcast", None)
     if _pcast is not None:
-        buf0 = _pcast(jnp.zeros_like(microbatches[0]), axis_name, to="varying")
-        out0 = _pcast(jnp.zeros_like(microbatches), axis_name, to="varying")
+        vary = lambda v: _pcast(v, axis_name, to="varying")  # noqa: E731
     else:  # older jax
-        buf0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
-        out0 = jax.lax.pvary(jnp.zeros_like(microbatches), (axis_name,))
+        vary = lambda v: jax.lax.pvary(v, (axis_name,))  # noqa: E731
+    buf0 = tmap(lambda mbs: vary(jnp.zeros_like(mbs[0])), microbatches)
+    out0 = tmap(lambda mbs: vary(jnp.zeros_like(mbs)), microbatches)
     (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
     # only the last stage holds real outputs; broadcast to all stages
-    return jax.lax.psum(
-        jnp.where(stage == size - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    return tmap(
+        lambda outs: jax.lax.psum(
+            jnp.where(stage == size - 1, outs, jnp.zeros_like(outs)), axis_name
+        ),
+        outputs,
     )
 
 
@@ -140,10 +156,15 @@ def pipeline_apply(
         params: pytree with leading layer dim ``[L]``; ``L`` must divide by
             the pp axis size (each stage takes ``L/S`` consecutive layers).
         x: ``[B, ...]`` activations; ``B`` must divide by ``microbatches``.
-        fn: one layer step ``fn(x_mb, layer_params) -> x_mb``. With
-            ``seq_axis`` the fn runs in manual context over that axis too
-            (it may call e.g. ring_attention_local over it) and receives
-            the local sequence chunk.
+            May be a PYTREE of ``[B, ...]`` leaves (side streams ride the
+            pipe — e.g. a per-example MoE aux-loss accumulator); the
+            sequence sharding (``seq_axis``) applies to leaves with a
+            ``seq_dim`` to shard (ndim > seq_dim).
+        fn: one layer step ``fn(x_mb, layer_params) -> x_mb`` over the
+            activation (pytree). With ``seq_axis`` the fn runs in manual
+            context over that axis too (it may call e.g.
+            ring_attention_local or ulysses_attention_local over it) and
+            receives the local sequence chunk.
         mesh: mesh containing ``axis_name``.
         microbatches: GPipe microbatch count M (bubble = (S-1)/(M+S-1)).
         batch_axes: unused (kept for call-site stability); batch sharding
@@ -152,7 +173,7 @@ def pipeline_apply(
             (manual: the stage fn owns its collectives).
         seq_dim: which dim of ``x`` is the sequence (default 1, [B, T, E]).
 
-    Returns ``[B, ...]`` outputs with x's sharding.
+    Returns outputs with x's structure and sharding.
     """
     del batch_axes  # automatic in partial-manual mode
     if axis_name not in mesh.axis_names:
@@ -165,29 +186,38 @@ def pipeline_apply(
         raise ValueError(
             f"layer count {n_layers} not divisible by pp axis size {stages}"
         )
-    b = x.shape[0]
+    x_leaves, x_treedef = jax.tree_util.tree_flatten(x)
+    b = x_leaves[0].shape[0]
     if b % microbatches != 0:
         raise ValueError(f"batch {b} not divisible by microbatches {microbatches}")
     mb = b // microbatches
-    x_mb = x.reshape((microbatches, mb) + x.shape[1:])
+    x_mb = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((microbatches, mb) + leaf.shape[1:]), x
+    )
 
     param_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), params
     )
-    data_entries: "list" = [None] * (x.ndim + 1)
-    if seq_axis is not None:
-        data_entries[seq_dim + 1] = seq_axis  # +1 for the microbatch dim
-    data_spec = P(*data_entries)
+
+    def leaf_spec(leaf: jax.Array) -> P:
+        entries: "list" = [None] * (leaf.ndim + 1)
+        if seq_axis is not None and leaf.ndim > seq_dim:
+            entries[seq_dim + 1] = seq_axis  # +1 for the microbatch dim
+        return P(*entries)
+
+    data_specs = jax.tree_util.tree_map(leaf_spec, x)
 
     manual = {axis_name} if seq_axis is None else {axis_name, seq_axis}
     out = jax.shard_map(
         functools.partial(pipeline_apply_local, fn=fn, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(param_specs, data_spec),
-        out_specs=data_spec,
+        in_specs=(param_specs, data_specs),
+        out_specs=data_specs,
         axis_names=manual,
     )(params, x_mb)
-    return out.reshape(x.shape)
+    return jax.tree_util.tree_map(
+        lambda o, leaf: o.reshape(leaf.shape), out, x
+    )
 
 
 __all__ = ["pipeline_apply", "pipeline_apply_local"]

@@ -184,8 +184,9 @@ class ProcessGroup(ABC):
     def send(self, array: Any, dst: int, tag: int = 0) -> Work: ...
 
     @abstractmethod
-    def recv(self, src: int, tag: int = 0) -> Work:
-        """Resolves to the received array (shape/dtype carried on the wire)."""
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
+        """Resolves to the received array (shape/dtype carried on the wire).
+        ``out``: backends that can, receive in place into this buffer."""
 
     def barrier(self) -> Work:
         return self.allreduce([np.zeros(1, dtype=np.float32)])
@@ -239,7 +240,7 @@ class ProcessGroupDummy(ProcessGroup):
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         return failed_work(RuntimeError("send not supported on world-size-1 group"))
 
-    def recv(self, src: int, tag: int = 0) -> Work:
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
         return failed_work(RuntimeError("recv not supported on world-size-1 group"))
 
 
@@ -631,11 +632,17 @@ class ProcessGroupTCP(ProcessGroup):
     # -- collectives -------------------------------------------------------
 
     def allreduce(self, arrays: "List[Any]", op: str = REDUCE_SUM) -> Work:
-        np_arrays = [_as_numpy(a) for a in arrays]
         deadline_budget = self._timeout
 
         def run() -> List[np.ndarray]:
+            # device→host materialization happens HERE, on the PG worker:
+            # for jax-array inputs `_as_numpy` blocks on device compute +
+            # transfer, and doing that on the caller thread would stall it
+            # for the whole sync instead of letting the submit return
+            # immediately (the DiLoCo overlap pattern: outer-grad allreduce
+            # rides behind the next fragment's inner steps).
             deadline = time.monotonic() + deadline_budget
+            np_arrays = [_as_numpy(a) for a in arrays]
             return self._allreduce_coalesced(np_arrays, op, deadline)
 
         return self._submit(run)
@@ -867,12 +874,14 @@ class ProcessGroupTCP(ProcessGroup):
 
         return self._submit(run)
 
-    def recv(self, src: int, tag: int = 0) -> Work:
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
+        """``out``: receive straight into this buffer (shape/dtype must
+        match the wire) — the zero-alloc path for healing into live state."""
         deadline_budget = self._timeout
 
         def run() -> np.ndarray:
             deadline = time.monotonic() + deadline_budget
-            return self._recv_msg(src, 1000 + tag, deadline)
+            return self._recv_msg(src, 1000 + tag, deadline, out=out)
 
         return self._submit(run)
 
@@ -942,8 +951,8 @@ class ProcessGroupWrapper(ProcessGroup):
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         return self._wrap(self._pg.send(array, dst, tag), lambda: None)
 
-    def recv(self, src: int, tag: int = 0) -> Work:
-        return self._wrap(self._pg.recv(src, tag), lambda: None)
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
+        return self._wrap(self._pg.recv(src, tag, out=out), lambda: None)
 
     def _wrap(self, work: Work, fallback: "Callable[[], Any]") -> Work:
         """Hook: ``fallback()`` builds a success-path-shaped substitute result."""
@@ -1096,7 +1105,7 @@ class ManagedProcessGroup(ProcessGroup):
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
 
-    def recv(self, src: int, tag: int = 0) -> Work:
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
         return failed_work(RuntimeError("ManagedProcessGroup only supports allreduce"))
 
 
@@ -1674,8 +1683,17 @@ class ProcessGroupBaby(ProcessGroup):
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         return self._submit("send", _as_numpy(array), dst, tag)
 
-    def recv(self, src: int, tag: int = 0) -> Work:
-        return self._submit("recv", src, tag)
+    def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
+        work = self._submit("recv", src, tag)
+        if out is None:
+            return work
+        # the worker can't share the caller's buffer; emulate in-place by
+        # copying the (possibly shm-backed) result into it
+        def into(arr: np.ndarray) -> np.ndarray:
+            out[...] = arr.reshape(out.shape)
+            return out
+
+        return work.then(into)
 
 
 class ProcessGroupBabyTCP(ProcessGroupBaby):
