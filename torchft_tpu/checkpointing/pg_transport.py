@@ -102,38 +102,40 @@ class PGTransport(CheckpointTransport[Any]):
             except Exception:  # noqa: BLE001 - fall back to fresh alloc
                 inplace_leaves = None
 
-        # Submit every tensor recv up front (the PG worker runs them in
-        # order, streaming the socket without per-leaf wakeup gaps); in-
-        # place targets go straight to the wire reader as recv(out=...)
-        # (uint8 view: the wire carries flat bytes).
-        works: "List[Optional[Any]]" = []
-        for i, meta in enumerate(header["leaves"]):
-            if meta["kind"] == "object":
-                works.append(None)
-                continue
-            out = None
-            if inplace_leaves is not None:
-                target = inplace_leaves[i]
-                if (
-                    isinstance(target, np.ndarray)
-                    and target.shape == tuple(meta["shape"])
-                    and str(target.dtype) == meta["dtype"]
-                    and target.flags.c_contiguous
-                ):
-                    out = target
-            works.append(
-                (
-                    self._pg.recv(
-                        src_rank,
-                        tag=_TENSOR_TAG + i,
-                        out=None if out is None else out.reshape(-1).view(np.uint8),
-                    ),
-                    out,
-                )
-            )
-
         leaves: List[Any] = []
         try:
+            # Submit every tensor recv up front (the PG worker runs them in
+            # order, streaming the socket without per-leaf wakeup gaps);
+            # in-place targets go straight to the wire reader as
+            # recv(out=...) (uint8 view: the wire carries flat bytes).
+            works: "List[Optional[Any]]" = []
+            for i, meta in enumerate(header["leaves"]):
+                if meta["kind"] == "object":
+                    works.append(None)
+                    continue
+                out = None
+                if inplace_leaves is not None:
+                    target = inplace_leaves[i]
+                    if (
+                        isinstance(target, np.ndarray)
+                        and target.shape == tuple(meta["shape"])
+                        and str(target.dtype) == meta["dtype"]
+                        and target.flags.c_contiguous
+                    ):
+                        out = target
+                works.append(
+                    (
+                        self._pg.recv(
+                            src_rank,
+                            tag=_TENSOR_TAG + i,
+                            out=None
+                            if out is None
+                            else out.reshape(-1).view(np.uint8),
+                        ),
+                        out,
+                    )
+                )
+
             for meta, w in zip(header["leaves"], works):
                 if w is None:
                     leaves.append(meta["value"])
@@ -148,11 +150,12 @@ class PGTransport(CheckpointTransport[Any]):
                         raw.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
                     )
         except Exception:
-            # Abandoning mid-stream leaves the tag stream desynced AND
-            # queued in-place recvs that would keep writing into LIVE
-            # training buffers as bytes arrive.  Abort tears the PG down so
-            # no queued op ever executes; the Manager latches the error and
-            # reconfigures at the next quorum.
+            # Abandoning mid-stream (including a failure while still
+            # SUBMITTING — e.g. a malformed leaf meta) leaves the tag
+            # stream desynced AND queued in-place recvs that would keep
+            # writing into LIVE training buffers as bytes arrive.  Abort
+            # tears the PG down so no queued op ever executes; the Manager
+            # latches the error and reconfigures at the next quorum.
             self._pg.abort()
             raise
         treedef = jax.tree_util.tree_structure(header["skeleton"])

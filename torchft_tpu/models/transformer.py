@@ -505,7 +505,13 @@ def forward_pipelined(
     )
     block = _make_block(cfg, moe_mesh, manual_cp=manual_cp)
 
+    moe = bool(cfg.n_experts)
+
     def layer_fn(h, layer_params):
+        # non-MoE: plain array activations — no dead aux stream riding the
+        # pipe (it would cost a ppermute + scatter per tick for zeros)
+        if not moe:
+            return block(h, layer_params, positions)[0]
         y, aux = block(h["x"], layer_params, positions)
         if manual_cp:
             # aux is computed from this cp shard's local tokens: average
@@ -519,19 +525,19 @@ def forward_pipelined(
 
     # pipeline_apply is partial-manual over pp (+cp for ring/ulysses):
     # batch (dp/fsdp/ep) and weight (fsdp/tp) shardings flow automatically
-    # from input shardings; the scalar aux stream broadcasts per example
+    # from input shardings; MoE adds a per-example aux side stream
     out = pipeline_apply(
         params["blocks"],
-        {"x": x, "aux": jnp.zeros((b,), jnp.float32)},
+        {"x": x, "aux": jnp.zeros((b,), jnp.float32)} if moe else x,
         layer_fn,
         mesh,
         axis_name=pp_axis,
         microbatches=microbatches,
         seq_axis=cfg.cp_axis if manual_cp else None,
     )
-    logits = _head(params, out["x"])
+    logits = _head(params, out["x"] if moe else out)
     if return_aux:
-        return logits, out["aux"].mean()
+        return logits, out["aux"].mean() if moe else jnp.zeros((), jnp.float32)
     return logits
 
 

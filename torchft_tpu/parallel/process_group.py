@@ -1688,8 +1688,15 @@ class ProcessGroupBaby(ProcessGroup):
         if out is None:
             return work
         # the worker can't share the caller's buffer; emulate in-place by
-        # copying the (possibly shm-backed) result into it
+        # copying the (possibly shm-backed) result into it — with the same
+        # validation the direct backend's wire reader applies (a silent
+        # value-cast would mask a buffer-setup bug)
         def into(arr: np.ndarray) -> np.ndarray:
+            if arr.dtype != out.dtype or arr.nbytes != out.nbytes:
+                raise RuntimeError(
+                    f"in-place recv buffer mismatch: {out.shape}/{out.dtype} "
+                    f"vs wire {arr.shape}/{arr.dtype}"
+                )
             out[...] = arr.reshape(out.shape)
             return out
 
