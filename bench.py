@@ -464,30 +464,32 @@ def bench_model() -> "Dict[str, Any]":
     if on_tpu:
         # ~465M params, shaped for the v5e MXU (d_model 1536, head_dim 256
         # — large aligned matmul tiles; hd 64/96 measured 10+ MFU points
-        # lower), bf16 compute.
+        # lower), bf16 compute, Pallas flash attention.
         base = dict(
             vocab_size=32000, d_model=1536, n_heads=6, n_kv_heads=3,
-            d_ff=4096, n_layers=16, max_seq_len=1024, attn_impl="dense",
+            d_ff=4096, n_layers=16, max_seq_len=1024,
         )
         seq, timed_steps = 1024, 16
-        # (remat, batch): remat B8 measured best (45.6% MFU); the adamw
-        # f32 state (~5.6 GB) rules out no-remat at useful batch sizes.
-        attempts = [(True, 8), (True, 4)]
+        # (attn, remat, batch): flash+remat+B8 measured best (49.8% MFU);
+        # the adamw f32 state (~5.6 GB) rules out no-remat at useful batch
+        # sizes; dense fallback in case the kernel regresses on a future
+        # driver chip.
+        attempts = [("flash", True, 8), ("flash", True, 4), ("dense", True, 8)]
     else:
         base = dict(
             vocab_size=512, d_model=128, n_heads=4, n_kv_heads=2,
-            d_ff=384, n_layers=2, max_seq_len=128, attn_impl="dense",
+            d_ff=384, n_layers=2, max_seq_len=128,
         )
         seq, timed_steps = 128, 5
-        attempts = [(False, 2)]
+        attempts = [("flash", False, 2)]
 
-    def run(remat: bool, batch: int) -> "Dict[str, Any]":
+    def run(attn: str, remat: bool, batch: int) -> "Dict[str, Any]":
         import jax.numpy as jnp
         from jax import lax
 
         from torchft_tpu.models.transformer import loss_fn
 
-        cfg = TransformerConfig(remat=remat, **base)
+        cfg = TransformerConfig(remat=remat, attn_impl=attn, **base)
         optimizer = optax.adamw(3e-4)
         # One dispatch runs n fused train steps (dynamic trip count -> one
         # compile).  Under the driver the chip sits behind a tunnel with
@@ -542,7 +544,7 @@ def bench_model() -> "Dict[str, Any]":
             "config": (
                 f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads}/{cfg.n_kv_heads} "
                 f"ff{cfg.d_ff} V{cfg.vocab_size} B{batch} T{seq} "
-                f"remat={'on' if remat else 'off'}"
+                f"{attn} remat={'on' if remat else 'off'}"
             ),
             "params_matmul_m": round(fl["params_matmul"] / 1e6, 1),
             "step_ms": round(step_s * 1e3, 2),
@@ -557,15 +559,15 @@ def bench_model() -> "Dict[str, Any]":
     import gc
 
     last_err: "Optional[str]" = None
-    for remat, batch in attempts:
+    for attn, remat, batch in attempts:
         # An OOM crash can wedge the device into FAILED_PRECONDITION for a
         # little while (measured under the driver tunnel); give each config
         # a settle-and-retry before moving to the next.
         for retry in range(3):
             try:
-                return run(remat, batch)
+                return run(attn, remat, batch)
             except Exception as e:  # noqa: BLE001 - OOM etc: try next config
-                log(f"model bench remat={remat} B{batch} failed: {e!r}")
+                log(f"model bench {attn} remat={remat} B{batch} failed: {e!r}")
                 last_err = repr(e)
                 retryable = "FAILED_PRECONDITION" in repr(e)
             # The raised exception's traceback pins the failed attempt's

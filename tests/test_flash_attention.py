@@ -1,0 +1,142 @@
+"""Flash attention (Pallas, interpret mode on CPU) vs dense reference.
+
+The kernel must match dense_attention in both directions of AD — it is
+the bench flagship's attention (attn_impl='flash') so a numerics drift
+here is a silent model-quality bug.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.ops.flash_attention import flash_attention
+from torchft_tpu.ops.ring_attention import dense_attention
+
+
+def _qkv(b=2, t=256, h=4, hkv=2, d=64, dtype=jnp.float32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, t, h, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, t, hkv, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, t, hkv, d), dtype)
+    return q, k, v
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = _qkv()
+        ref = dense_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+        )
+
+    def test_multiple_block_sizes(self):
+        # 128 / 256 / 512 block selection paths
+        for t in (128, 384, 512):
+            q, k, v = _qkv(t=t, seed=t)
+            ref = dense_attention(q, k, v)
+            out = flash_attention(q, k, v)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+            )
+
+    def test_rejects_unaligned_seq(self):
+        q, k, v = _qkv(t=100)
+        with pytest.raises(ValueError, match="128"):
+            flash_attention(q, k, v)
+
+    def test_gqa_head_broadcast(self):
+        q, k, v = _qkv(h=8, hkv=2)
+        ref = dense_attention(q, k, v)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+        )
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        q, k, v = _qkv()
+
+        def make_loss(fn):
+            def loss(q, k, v):
+                out = fn(q, k, v, causal=causal)
+                # non-uniform cotangent exercises dq/dk/dv paths properly
+                w = jnp.arange(out.size, dtype=out.dtype).reshape(out.shape)
+                return (out * w).mean()
+
+            return jax.grad(loss, argnums=(0, 1, 2))
+
+        g_ref = make_loss(dense_attention)(q, k, v)
+        g_out = make_loss(flash_attention)(q, k, v)
+        for name, a, b in zip("qkv", g_out, g_ref):
+            scale = float(np.abs(np.asarray(b)).max()) + 1e-12
+            np.testing.assert_allclose(
+                np.asarray(a) / scale, np.asarray(b) / scale,
+                atol=1e-5, err_msg=f"d{name}",
+            )
+
+
+class TestFlashInTransformer:
+    def test_forward_matches_dense_impl(self):
+        from torchft_tpu.models import transformer as tfm
+
+        base = dict(
+            vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+            n_layers=2, max_seq_len=128, dtype=jnp.float32,
+        )
+        params = tfm.init_params(
+            jax.random.PRNGKey(0), tfm.TransformerConfig(**base)
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 64)
+        ref = tfm.forward(
+            params, tokens, tfm.TransformerConfig(attn_impl="dense", **base)
+        )
+        out = tfm.forward(
+            params, tokens, tfm.TransformerConfig(attn_impl="flash", **base)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+        )
+
+    def test_train_step_grads_finite(self):
+        import optax
+
+        from torchft_tpu.models import transformer as tfm
+
+        cfg = tfm.TransformerConfig(
+            vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+            n_layers=2, max_seq_len=128, dtype=jnp.float32,
+            attn_impl="flash",
+        )
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-3)
+        step = tfm.make_train_step(cfg, optimizer, donate=False)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 64)
+        params2, _, loss = step(params, optimizer.init(params), tokens)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree_util.tree_leaves(params2):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_rejects_mesh(self):
+        # the guard lives in the block builder: flash is the single-device
+        # per-shard kernel, meshes must use ring/ulysses/dense
+        from jax.sharding import Mesh
+
+        from torchft_tpu.models import transformer as tfm
+
+        cfg = tfm.TransformerConfig(
+            vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+            n_layers=2, max_seq_len=128, attn_impl="flash",
+            dtype=jnp.float32,
+        )
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+        block = tfm._make_block(cfg, mesh)
+        x = jnp.zeros((2, 128, 64), jnp.float32)
+        layer0 = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+        with pytest.raises(ValueError, match="single-device"):
+            block(x, layer0, jnp.arange(128))

@@ -216,7 +216,7 @@ class TestPipelinedTransformer:
         tokens = jnp.zeros((4, 8), jnp.int32)
         cfg = dataclasses.replace(self._cfg(), attn_impl="bogus")
         params = tfm.init_params(jax.random.PRNGKey(0), self._cfg())
-        with pytest.raises(ValueError, match="unknown attn_impl"):
+        with pytest.raises(ValueError, match="does not support attn_impl"):
             tfm.forward_pipelined(params, tokens, cfg, mesh)
 
 

@@ -341,10 +341,20 @@ def _make_block(
                 out_specs=spec,
             )
             return fn(q, k, v)
+        if cfg.attn_impl == "flash":
+            if mesh is not None:
+                raise ValueError(
+                    "attn_impl='flash' is the single-device kernel; on "
+                    "meshes use 'ring'/'ulysses' (sequence parallel) or "
+                    "'dense' (XLA-sharded)"
+                )
+            from torchft_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
         if cfg.attn_impl != "dense":
             raise ValueError(
                 f"unknown attn_impl {cfg.attn_impl!r}; "
-                "expected 'dense', 'ring', or 'ulysses'"
+                "expected 'dense', 'flash', 'ring', or 'ulysses'"
             )
         return dense_attention(q, k, v, causal=True)
 
@@ -484,8 +494,10 @@ def forward_pipelined(
     manual_cp = cfg.attn_impl in ("ring", "ulysses")
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(
-            f"unknown attn_impl {cfg.attn_impl!r}; "
-            "expected 'dense', 'ring', or 'ulysses'"
+            f"forward_pipelined does not support attn_impl "
+            f"{cfg.attn_impl!r}; expected 'dense', 'ring', or 'ulysses' "
+            "('flash' is the single-device kernel — use ring/ulysses for "
+            "sequence parallelism inside the pipe)"
         )
     if manual_cp and cfg.cp_axis not in mesh.axis_names:
         raise ValueError(

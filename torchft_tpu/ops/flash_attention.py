@@ -1,0 +1,368 @@
+"""Flash attention for TPU: fused tiled causal attention in Pallas.
+
+The framework's hot-op kernel (the reference's hot ops are its Triton
+quantization kernels, torchft/quantization.py:44-430; attention itself it
+leaves to torch — on TPU the [T, T] score materialization is the dominant
+HBM cost of the transformer, so this is where a Pallas kernel pays).
+
+Standard FlashAttention-2 scheme, fwd + bwd:
+
+- forward: one pass over K/V blocks per Q block with the online-softmax
+  running (m, l) statistics in VMEM scratch; writes O and the per-row
+  logsumexp L. Never materializes [T, T].
+- backward: recomputes p = exp(q·kᵀ·scale − L) per tile from the saved L
+  (no stored probabilities), accumulating dK/dV over Q blocks in one
+  kernel and dQ over K/V blocks in another.
+- causal block skipping: fully-masked tiles are skipped via ``pl.when``
+  (half the FLOPs at long T), diagonal tiles masked elementwise.
+- dtypes: matmuls run in the input dtype (bf16 on TPU) with f32
+  accumulation; softmax statistics and accumulators are f32 scratch.
+
+Layouts follow the guide (/opt/skills/guides/pallas_guide.md): blocks are
+(sublane × lane)-aligned, row statistics ride a 128-lane minor dim.  Off
+TPU every kernel runs in interpreter mode so the CPU test suite covers
+the same code path.
+
+Wired into the model as ``TransformerConfig(attn_impl="flash")``
+(torchft_tpu/models/transformer.py); requires T % 128 == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_size(t: int) -> int:
+    for blk in (512, 256, 128):
+        if t % blk == 0:
+            return blk
+    raise ValueError(f"flash attention requires seq len % 128 == 0, got {t}")
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *, scale, causal, blk_q, blk_k
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # causal: this tile is live unless every key position exceeds every
+    # query position in the block
+    needed = jnp.logical_or(
+        not causal, j * blk_k <= i * blk_q + blk_q - 1
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q,
+            k_ref[0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [blk_q, blk_k]
+        if causal:
+            rq = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            rk = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rq >= rk, s, _NEG_INF)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:] = jnp.broadcast_to(
+            l_s[:, :1] * corr + p.sum(axis=1, keepdims=True), l_s.shape
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(q.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_s[:, :1] + jnp.log(l)), (lse_ref.shape[1], lse_ref.shape[2])
+        )
+
+
+def _fwd(
+    q3: jax.Array, k3: jax.Array, v3: jax.Array, scale: float, causal: bool
+) -> "Tuple[jax.Array, jax.Array]":
+    bh, t, d = q3.shape
+    blk = _block_size(t)
+    n = t // blk
+    grid = (bh, n, n)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, blk_q=blk, blk_k=blk
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk, _LANE), lambda b, i, j: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t, _LANE), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, _LANE), jnp.float32),
+            pltpu.VMEM((blk, _LANE), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q, k, lse_col, scale, causal, i, j, blk_q, blk_k):
+    """exp(q·kᵀ·scale − L) with the causal mask — shared by both bwd
+    kernels.  lse_col: [blk_q, 1] f32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    p = jnp.exp(s - lse_col)
+    if causal:
+        rq = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+        rk = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        p = jnp.where(rq >= rk, p, 0.0)
+    return p
+
+
+def _bwd_kv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, blk_q, blk_k,
+):
+    j = pl.program_id(1)  # K/V block (outer)
+    i = pl.program_id(2)  # Q block (inner, accumulated)
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = jnp.logical_or(
+        not causal, i * blk_q + blk_q - 1 >= j * blk_k
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        do = do_ref[0]
+        p = _recompute_p(
+            q, k_ref[0], lse_ref[0][:, :1], scale, causal, i, j, blk_q, blk_k
+        )
+        pt = p.astype(q.dtype)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == ni - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc, *, scale, causal, blk_q, blk_k,
+):
+    i = pl.program_id(1)  # Q block (outer)
+    j = pl.program_id(2)  # K/V block (inner, accumulated)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = jnp.logical_or(
+        not causal, j * blk_k <= i * blk_q + blk_q - 1
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        p = _recompute_p(
+            q, k_ref[0], lse_ref[0][:, :1], scale, causal, i, j, blk_q, blk_k
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(
+    q3, k3, v3, o3, lse, do3, scale: float, causal: bool
+) -> "Tuple[jax.Array, jax.Array, jax.Array]":
+    bh, t, d = q3.shape
+    blk = _block_size(t)
+    n = t // blk
+    # delta_i = rowsum(dO * O): tiny elementwise pass, plain XLA
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    )  # [bh, t]
+    lse_b = jnp.broadcast_to(lse[..., None], (bh, t, _LANE))
+    delta_b = jnp.broadcast_to(delta[..., None], (bh, t, _LANE))
+
+    # kv kernel grid = (b, j, i): index maps receive (b, kv_block, q_block)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kv_kernel, scale=scale, causal=causal, blk_q=blk, blk_k=blk
+        ),
+        grid=(bh, n, n),
+        in_specs=[
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),      # q
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),      # k
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),      # v
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),      # do
+            pl.BlockSpec((1, blk, _LANE), lambda b, jj, ii: (b, ii, 0)),  # lse
+            pl.BlockSpec((1, blk, _LANE), lambda b, jj, ii: (b, ii, 0)),  # delta
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse_b, delta_b)
+
+    # q kernel grid = (b, i, j): index maps receive (b, q_block, kv_block)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_q_kernel, scale=scale, causal=causal, blk_q=blk, blk_k=blk
+        ),
+        grid=(bh, n, n),
+        in_specs=[
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),      # q
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),      # k
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),      # v
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),      # do
+            pl.BlockSpec((1, blk, _LANE), lambda b, ii, jj: (b, ii, 0)),  # lse
+            pl.BlockSpec((1, blk, _LANE), lambda b, ii, jj: (b, ii, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash3(q3, k3, v3, scale, causal):
+    return _fwd(q3, k3, v3, scale, causal)[0]
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal):
+    o, lse = _fwd(q3, k3, v3, scale, causal)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Tiled fused causal attention, ``[B, T, H, D] -> [B, T, H, D]``.
+
+    Drop-in for :func:`~torchft_tpu.ops.ring_attention.dense_attention`
+    with O(T) memory instead of the O(T^2) score matrix.  GQA K/V with
+    fewer heads are broadcast up (the kernel is per-head).  Requires
+    ``T % 128 == 0``; other shapes should use ``dense_attention``.
+    """
+    b, t, h, d = q.shape
+    if k.shape[2] != h:
+        if h % k.shape[2] != 0:
+            raise ValueError(
+                f"query heads {h} not a multiple of kv heads {k.shape[2]}"
+            )
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(d)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out3 = _flash3(to3(q), to3(k), to3(v), scale, causal)
+    return out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+__all__ = ["flash_attention"]
