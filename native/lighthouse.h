@@ -106,6 +106,11 @@ class LighthouseServer : public RpcServer {
   std::condition_variable quorum_cv_;
   std::map<std::string, ParticipantDetails> participants_;
   std::map<std::string, int64_t> heartbeats_;
+  // Fast-restart supersession bookkeeping: id -> eviction sequence number
+  // (a ghost rpc_quorum waiter compares against its entry snapshot and
+  // aborts instead of resurrecting the evicted heartbeat).
+  std::map<std::string, int64_t> evicted_seq_;
+  int64_t evict_counter_ = 0;
   std::optional<Quorum> prev_quorum_;
   int64_t quorum_id_ = 0;
   // Broadcast: monotonically increasing sequence of formed quorums.

@@ -236,3 +236,75 @@ class TestCoordinationDocs:
                 if name.startswith("_"):
                     continue
                 assert fn.__doc__ and fn.__doc__.strip(), f"{cls.__name__}.{name}"
+
+
+class TestFastRestartSupersession:
+    def test_new_incarnation_evicts_stale_same_prefix_member(self):
+        # replica ids carry a ":uuid" incarnation suffix; a rejoin with a
+        # new uuid proves the old incarnation is dead, so quorum formation
+        # must NOT wait out the join timeout for its stale heartbeat
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            # first quorum: survivor + old incarnation
+            hb = LighthouseClient(server.address())
+            hb.heartbeat("survivor:aaa")
+            hb.heartbeat("victim:old")
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:old"}],
+            )
+            assert [p.replica_id for p in results["survivor:aaa"].participants] == [
+                "survivor:aaa",
+                "victim:old",
+            ]
+            # victim dies (no leave RPC; heartbeat would stay "healthy" for
+            # 60 s) and restarts with a new uuid. Without supersession this
+            # quorum would block on the 5 s join timeout for "victim:old".
+            start = time.monotonic()
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:new"}],
+            )
+            elapsed = time.monotonic() - start
+            assert [p.replica_id for p in results["victim:new"].participants] == [
+                "survivor:aaa",
+                "victim:new",
+            ]
+            assert elapsed < 2.0, (
+                f"rejoin quorum took {elapsed:.1f}s — stale incarnation "
+                "was not evicted"
+            )
+            hb.close()
+
+    def test_empty_prefix_ids_never_evict_each_other(self):
+        # Manager's default replica_id="" gives ids of the shape ":uuid" —
+        # DISTINCT logical replicas sharing the empty prefix; supersession
+        # must not apply (a mutual eviction would deadlock quorum)
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": ":uuidA"}, {"replica_id": ":uuidB"}],
+            )
+            assert [p.replica_id for p in results[":uuidA"].participants] == [
+                ":uuidA",
+                ":uuidB",
+            ]
+
+    def test_live_same_prefix_participants_not_evicted(self):
+        # two LIVE replicas whose user-supplied ids share a prefix
+        # ("host:1"/"host:2"): both have pending quorum requests, so
+        # neither may be evicted as a stale incarnation
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "host:1"}, {"replica_id": "host:2"}],
+            )
+            assert [p.replica_id for p in results["host:1"].participants] == [
+                "host:1",
+                "host:2",
+            ]

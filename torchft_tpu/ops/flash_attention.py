@@ -110,9 +110,8 @@ def _fwd_kernel(
     def _():
         l = jnp.maximum(l_s[:, :1], 1e-30)
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to(
-            (m_s[:, :1] + jnp.log(l)), (lse_ref.shape[1], lse_ref.shape[2])
-        )
+        # [blk, 1] column -> [1, blk] lane vector (Mosaic relayout)
+        lse_ref[0] = (m_s[:, :1] + jnp.log(l)).reshape(1, -1)
 
 
 def _fwd(
@@ -134,11 +133,15 @@ def _fwd(
         ],
         out_specs=(
             pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk, _LANE), lambda b, i, j: (b, i, 0)),
+            # row stats as [bh, 1, t]: a (1, 1, blk) block keeps the
+            # sublane dim equal to the array's (TPU block-shape rule) and
+            # the per-row scalars on lanes — 128x less HBM than
+            # broadcasting to a [bh, t, 128] stat plane
+            pl.BlockSpec((1, 1, blk), lambda b, i, j: (b, 0, i)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, t, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((blk, d), jnp.float32),
@@ -147,7 +150,7 @@ def _fwd(
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
-    return o, lse[:, :, 0]
+    return o, lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -155,9 +158,11 @@ def _fwd(
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, lse_col, scale, causal, i, j, blk_q, blk_k):
+def _recompute_p(q, k, lse_row, scale, causal, i, j, blk_q, blk_k):
     """exp(q·kᵀ·scale − L) with the causal mask — shared by both bwd
-    kernels.  lse_col: [blk_q, 1] f32."""
+    kernels.  lse_row: [1, blk_q] f32 lane vector (reshaped to a column
+    here; Mosaic relayout)."""
+    lse_col = lse_row.reshape(-1, 1)  # lane vector -> column
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -191,7 +196,7 @@ def _bwd_kv_kernel(
         q = q_ref[0]
         do = do_ref[0]
         p = _recompute_p(
-            q, k_ref[0], lse_ref[0][:, :1], scale, causal, i, j, blk_q, blk_k
+            q, k_ref[0], lse_ref[0], scale, causal, i, j, blk_q, blk_k
         )
         pt = p.astype(q.dtype)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -201,7 +206,7 @@ def _bwd_kv_kernel(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -233,13 +238,13 @@ def _bwd_q_kernel(
     def _():
         q = q_ref[0]
         p = _recompute_p(
-            q, k_ref[0], lse_ref[0][:, :1], scale, causal, i, j, blk_q, blk_k
+            q, k_ref[0], lse_ref[0], scale, causal, i, j, blk_q, blk_k
         )
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(q.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -259,9 +264,8 @@ def _bwd(
     # delta_i = rowsum(dO * O): tiny elementwise pass, plain XLA
     delta = jnp.sum(
         do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
-    )  # [bh, t]
-    lse_b = jnp.broadcast_to(lse[..., None], (bh, t, _LANE))
-    delta_b = jnp.broadcast_to(delta[..., None], (bh, t, _LANE))
+    )[:, None, :]  # [bh, 1, t]
+    lse3 = lse[:, None, :]
 
     # kv kernel grid = (b, j, i): index maps receive (b, kv_block, q_block)
     dk, dv = pl.pallas_call(
@@ -270,12 +274,12 @@ def _bwd(
         ),
         grid=(bh, n, n),
         in_specs=[
-            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),      # q
-            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),      # k
-            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),      # v
-            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),      # do
-            pl.BlockSpec((1, blk, _LANE), lambda b, jj, ii: (b, ii, 0)),  # lse
-            pl.BlockSpec((1, blk, _LANE), lambda b, jj, ii: (b, ii, 0)),  # delta
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),  # q
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),  # k
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),  # v
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),  # do
+            pl.BlockSpec((1, 1, blk), lambda b, jj, ii: (b, 0, ii)),  # lse
+            pl.BlockSpec((1, 1, blk), lambda b, jj, ii: (b, 0, ii)),  # delta
         ],
         out_specs=(
             pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, jj, 0)),
@@ -290,7 +294,7 @@ def _bwd(
             pltpu.VMEM((blk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse_b, delta_b)
+    )(q3, k3, v3, do3, lse3, delta)
 
     # q kernel grid = (b, i, j): index maps receive (b, q_block, kv_block)
     dq = pl.pallas_call(
@@ -299,18 +303,18 @@ def _bwd(
         ),
         grid=(bh, n, n),
         in_specs=[
-            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),      # q
-            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),      # k
-            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),      # v
-            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),      # do
-            pl.BlockSpec((1, blk, _LANE), lambda b, ii, jj: (b, ii, 0)),  # lse
-            pl.BlockSpec((1, blk, _LANE), lambda b, ii, jj: (b, ii, 0)),  # delta
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),  # q
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),  # k
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, jj, 0)),  # v
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),  # do
+            pl.BlockSpec((1, 1, blk), lambda b, ii, jj: (b, 0, ii)),  # lse
+            pl.BlockSpec((1, 1, blk), lambda b, ii, jj: (b, 0, ii)),  # delta
         ],
         out_specs=pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse_b, delta_b)
+    )(q3, k3, v3, do3, lse3, delta)
     return dq, dk, dv
 
 
