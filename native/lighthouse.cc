@@ -260,16 +260,17 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   // quorum in the join-timeout wait until heartbeat expiry. Measured:
   // cuts rejoin-quorum formation from ~join_timeout to the next tick.
   //
-  // Guards against false eviction of a LIVE same-prefix replica:
-  // - empty prefixes never match (default replica_id="" gives every
-  //   replica the ":uuid" shape — those are distinct logical replicas);
-  // - an id with a pending quorum request (in participants_) is alive by
-  //   definition and is never evicted; only heartbeat-but-not-joining
-  //   entries (the dead-incarnation signature) are.
-  // Evicted ids are stamped in evicted_seq_ so a ghost rpc_quorum handler
-  // thread of the dead incarnation (its client is gone but the handler
-  // blocks until its RPC deadline) aborts instead of re-inserting the
-  // stale heartbeat from its wait loop.
+  // Convention: the segment after the last ':' is the INCARNATION suffix
+  // (the Manager always appends ":uuid4"), so two ids sharing a non-empty
+  // prefix are incarnations of one logical replica — at most one can be a
+  // live process, and the newest joiner is it.  The superseded entry is
+  // removed from heartbeats_ AND participants_ (a kill can land while the
+  // old incarnation is blocked inside rpc_quorum, leaving its request
+  // registered), and stamped in evicted_seq_ so the dead incarnation's
+  // ghost handler thread (its client is gone but the handler blocks until
+  // its RPC deadline) aborts instead of re-inserting the stale state from
+  // its wait loop.  Empty prefixes never match: default replica_id=""
+  // gives every replica the ":uuid" shape — distinct logical replicas.
   {
     auto prefix_of = [](const std::string& id) {
       auto pos = id.rfind(':');
@@ -279,13 +280,21 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
     if (!new_prefix.empty()) {
       for (auto it = heartbeats_.begin(); it != heartbeats_.end();) {
         if (it->first != requester.replica_id &&
-            participants_.count(it->first) == 0 &&
             prefix_of(it->first) == new_prefix) {
           evicted_seq_[it->first] = ++evict_counter_;
+          participants_.erase(it->first);
           it = heartbeats_.erase(it);
         } else {
           ++it;
         }
+      }
+      // Bound evicted_seq_: ghosts only live for one RPC deadline, so
+      // stamps older than the last 256 evictions are dead weight.
+      for (auto it = evicted_seq_.begin(); it != evicted_seq_.end();) {
+        if (evict_counter_ - it->second > 256)
+          it = evicted_seq_.erase(it);
+        else
+          ++it;
       }
     }
   }
@@ -303,6 +312,15 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       std::max<int64_t>(1, std::min<int64_t>(opt_.heartbeat_timeout_ms / 2,
                                              1000)));
   while (true) {
+    {
+      // Superseded by a newer incarnation after we entered: abort BEFORE
+      // re-registering anything (see eviction block above) — this handler
+      // belongs to a replica whose replacement has already joined.
+      auto ev = evicted_seq_.find(requester.replica_id);
+      if (ev != evicted_seq_.end() && ev->second > entry_evict_counter)
+        throw std::runtime_error(
+            "superseded by a newer incarnation of this replica");
+    }
     if (quorum_seq_ != seen_seq) {
       seen_seq = quorum_seq_;
       const Quorum& q = latest_quorum_;
@@ -322,14 +340,6 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
     }
     if (stopping_.load())
       throw std::runtime_error("lighthouse shutting down");
-    {
-      // Superseded by a newer incarnation after we entered: abort rather
-      // than resurrect the evicted heartbeat (see eviction block above).
-      auto ev = evicted_seq_.find(requester.replica_id);
-      if (ev != evicted_seq_.end() && ev->second > entry_evict_counter)
-        throw std::runtime_error(
-            "superseded by a newer incarnation of this replica");
-    }
     heartbeats_[requester.replica_id] = now_ms();
     if (std::chrono::steady_clock::now() >= deadline)
       throw TimeoutError("timeout waiting for quorum");

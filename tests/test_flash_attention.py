@@ -121,11 +121,8 @@ class TestFlashInTransformer:
         for leaf in jax.tree_util.tree_leaves(params2):
             assert np.isfinite(np.asarray(leaf)).all()
 
-    def test_rejects_mesh(self):
-        # the guard lives in the block builder: flash is the single-device
-        # per-shard kernel, meshes must use ring/ulysses/dense
-        from jax.sharding import Mesh
-
+    def test_rejects_manual_context(self):
+        # flash does not nest in the pipeline's manual shard_map context
         from torchft_tpu.models import transformer as tfm
 
         cfg = tfm.TransformerConfig(
@@ -134,9 +131,54 @@ class TestFlashInTransformer:
             dtype=jnp.float32,
         )
         params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+        block = tfm._make_block(cfg, "manual")
+        x = jnp.zeros((2, 128, 64), jnp.float32)
+        layer0 = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+        with pytest.raises(ValueError, match="manual shard_map"):
+            block(x, layer0, jnp.arange(128))
+
+
+class TestFlashOnMesh:
+    def test_batch_and_head_sharded_matches_dense(self):
+        # flash on a dp x tp mesh: batch and heads shard, each device runs
+        # the kernel on its full-sequence shard
+        from jax.sharding import Mesh, NamedSharding
+
+        from torchft_tpu.models import transformer as tfm
+
+        base = dict(
+            vocab_size=64, d_model=64, n_heads=4, n_kv_heads=4, d_ff=128,
+            n_layers=2, max_seq_len=128, dtype=jnp.float32,
+        )
+        cfg = tfm.TransformerConfig(attn_impl="flash", **base)
+        cfg_dense = tfm.TransformerConfig(attn_impl="dense", **base)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 128), 0, 64)
+        ref = tfm.forward(params, tokens, cfg_dense)
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+        sharded = tfm.shard_params(params, mesh, cfg)
+        tok_sh = jax.device_put(
+            tokens, NamedSharding(mesh, tfm.batch_spec(cfg, mesh))
+        )
+        out = jax.jit(lambda p, t: tfm.forward(p, t, cfg, mesh))(sharded, tok_sh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
+        )
+
+    def test_rejects_cp_mesh(self):
+        from jax.sharding import Mesh
+
+        from torchft_tpu.models import transformer as tfm
+
+        cfg = tfm.TransformerConfig(
+            vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+            n_layers=2, max_seq_len=128, attn_impl="flash", dtype=jnp.float32,
+        )
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("cp",))
         block = tfm._make_block(cfg, mesh)
         x = jnp.zeros((2, 128, 64), jnp.float32)
         layer0 = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
-        with pytest.raises(ValueError, match="single-device"):
+        with pytest.raises(ValueError, match="sequence unsharded"):
             block(x, layer0, jnp.arange(128))

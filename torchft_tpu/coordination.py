@@ -400,6 +400,13 @@ class LighthouseClient:
 
         Doubles as an implicit heartbeat (reference src/lighthouse.rs:
         498-544); ``data`` is an opaque JSON dict carried to all members.
+
+        Id convention: the segment after the last ``:`` is the INCARNATION
+        suffix (the Manager appends ``:uuid4``). A joiner supersedes any
+        member sharing its non-empty prefix — the stale incarnation is
+        evicted immediately so a fast-restarted replica re-forms quorum
+        without waiting out heartbeat expiry. Ids without ``:`` (or with
+        an empty prefix) never supersede anything.
         """
         member = QuorumMember(
             replica_id=replica_id,

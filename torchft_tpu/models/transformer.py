@@ -278,6 +278,20 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _expand_kv_for_tp(cfg: TransformerConfig, mesh: Mesh, nh: int, k, v):
+    """K/V normally cross shard_map unexpanded (nkv heads of ppermute /
+    all-to-all / kernel bytes); when tp doesn't divide nkv that layout
+    isn't shardable, so pre-expand to nh heads."""
+    tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        cfg.tp_axis, 1
+    )
+    if k.shape[2] % tp_size != 0:
+        rep = nh // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def _make_block(
     cfg: TransformerConfig, mesh: "Optional[Mesh]", manual_cp: bool = False
 ):
@@ -319,16 +333,7 @@ def _make_block(
                 if cfg.attn_impl == "ring"
                 else ulysses_attention_local
             )
-            # K/V normally cross shard_map unexpanded (nkv heads of ppermute
-            # / all-to-all bytes); when tp doesn't divide nkv that layout
-            # isn't shardable, so fall back to pre-expanding to nh heads
-            tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-                cfg.tp_axis, 1
-            )
-            if k.shape[2] % tp_size != 0:
-                rep = nh // k.shape[2]
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            k, v = _expand_kv_for_tp(cfg, mesh, nh, k, v)
             spec = _filter_spec(
                 P(_batch_axes(cfg, mesh), cfg.cp_axis, cfg.tp_axis, None), mesh
             )
@@ -342,15 +347,38 @@ def _make_block(
             )
             return fn(q, k, v)
         if cfg.attn_impl == "flash":
-            if mesh is not None:
-                raise ValueError(
-                    "attn_impl='flash' is the single-device kernel; on "
-                    "meshes use 'ring'/'ulysses' (sequence parallel) or "
-                    "'dense' (XLA-sharded)"
-                )
             from torchft_tpu.ops.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=True)
+            if mesh is None:
+                return flash_attention(q, k, v, causal=True)
+            if isinstance(mesh, str):
+                raise ValueError(
+                    "attn_impl='flash' does not nest in manual shard_map "
+                    "contexts; use 'ring'/'ulysses' there"
+                )
+            # batch/head-parallel over the mesh: each shard holds the FULL
+            # sequence (flash is not sequence-parallel — use ring/ulysses
+            # for cp) and runs the kernel on its [B/dp.., T, H/tp, D] shard
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes.get(cfg.cp_axis, 1) > 1:
+                raise ValueError(
+                    "attn_impl='flash' needs the sequence unsharded; on a "
+                    f"{cfg.cp_axis!r} mesh use 'ring' or 'ulysses'"
+                )
+            k, v = _expand_kv_for_tp(cfg, mesh, nh, k, v)
+            spec = _filter_spec(
+                P(_batch_axes(cfg, mesh), None, cfg.tp_axis, None), mesh
+            )
+            fn = jax.shard_map(
+                lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                # pallas_call's out_shape carries no vma annotation; the
+                # kernel is per-shard elementwise in the mesh sense
+                check_vma=False,
+            )
+            return fn(q, k, v)
         if cfg.attn_impl != "dense":
             raise ValueError(
                 f"unknown attn_impl {cfg.attn_impl!r}; "
@@ -496,8 +524,9 @@ def forward_pipelined(
         raise ValueError(
             f"forward_pipelined does not support attn_impl "
             f"{cfg.attn_impl!r}; expected 'dense', 'ring', or 'ulysses' "
-            "('flash' is the single-device kernel — use ring/ulysses for "
-            "sequence parallelism inside the pipe)"
+            "('flash' does not compose with the pipeline's manual "
+            "shard_map — use ring/ulysses for sequence parallelism "
+            "inside the pipe)"
         )
     if manual_cp and cfg.cp_axis not in mesh.axis_names:
         raise ValueError(
