@@ -80,3 +80,33 @@ class TestTrainDDPResume:
         assert "resumed from" in second.stdout and "at step 6" in second.stdout
         steps = [s for s, _ in list_checkpoints(save_dir)]
         assert steps[-1] == 10
+
+
+class TestCorruption:
+    def test_truncated_checkpoint_raises_cleanly(self, tmp_path):
+        # a partial write that somehow survived (e.g. torn disk) must fail
+        # loudly at load, never return garbage state
+        import pytest
+
+        path = save_checkpoint(str(tmp_path), 3, {"w": np.arange(1000.0)})
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[: len(data) // 2])
+        with pytest.raises(EOFError):
+            load_checkpoint(path)
+
+    def test_atomic_write_never_replaces_on_failure(self, tmp_path):
+        # save_checkpoint writes tmp + os.replace: a failed serialize must
+        # leave the previous checkpoint intact
+        path = save_checkpoint(str(tmp_path), 5, {"w": np.ones(4)})
+        before = open(path, "rb").read()
+
+        class Unpicklable:
+            def __reduce__(self):
+                raise RuntimeError("nope")
+
+        try:
+            save_checkpoint(str(tmp_path), 5, {"bad": Unpicklable()})
+        except Exception:
+            pass
+        assert open(path, "rb").read() == before
+        np.testing.assert_array_equal(load_checkpoint(path)["w"], np.ones(4))

@@ -182,3 +182,14 @@ class TestFlashOnMesh:
         layer0 = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
         with pytest.raises(ValueError, match="sequence unsharded"):
             block(x, layer0, jnp.arange(128))
+
+
+class TestFlashBf16:
+    def test_bf16_matches_dense_within_tolerance(self):
+        # the production dtype: matmuls in bf16 with f32 accumulation in
+        # BOTH impls — agreement bound is bf16 resolution, not exactness
+        q, k, v = _qkv(dtype=jnp.bfloat16, seed=3)
+        ref = np.asarray(dense_attention(q, k, v), np.float32)
+        out = np.asarray(flash_attention(q, k, v), np.float32)
+        scale = np.abs(ref).max() + 1e-9
+        assert np.abs(out - ref).max() / scale < 3e-2
