@@ -309,8 +309,11 @@ def _make_block(
     def attention(q, k, v):
         if manual_cp:
             if cfg.attn_impl == "ring":
+                # the pipeline's shard_map is partial-auto, which rejects
+                # pallas lowering — keep the jnp tile body there
                 return ring_attention_local(
-                    q, k, v, axis_name=cfg.cp_axis, causal=True
+                    q, k, v, axis_name=cfg.cp_axis, causal=True,
+                    use_flash=False,
                 )
             if cfg.attn_impl == "ulysses":
                 return ulysses_attention_local(
@@ -344,6 +347,8 @@ def _make_block(
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
+                # the ring body may lower to pallas_call (flash tiles)
+                check_vma=False,
             )
             return fn(q, k, v)
         if cfg.attn_impl == "flash":

@@ -37,6 +37,7 @@ def ring_attention_local(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    use_flash: "Optional[bool]" = None,
 ) -> jax.Array:
     """Per-shard ring attention body. Must run inside shard_map over
     ``axis_name``; q/k/v are local sequence chunks ``[B, T_local, H, D]``
@@ -48,13 +49,26 @@ def ring_attention_local(
 
     Returns the local output chunk ``[B, T_local, H, D]`` in q's dtype.
     """
-    idx = jax.lax.axis_index(axis_name)
-    size = jax.lax.axis_size(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     hkv = k.shape[2]
     if h % hkv != 0:
         raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    # Long-context fast path: when the local chunks are lane-aligned, run
+    # the fused Pallas kernel per (Q x visiting-KV) tile instead of
+    # materializing [T_local, T_local] scores (flash x ring composition;
+    # identical contract, bwd re-rotates against the global logsumexp).
+    # ``use_flash=False`` opts out — required inside partial-auto shard_map
+    # contexts (the pipeline), where pallas_call's missing vma annotation
+    # is rejected.
+    if use_flash is None:
+        use_flash = tq % 128 == 0 and tk % 128 == 0
+    if use_flash:
+        from torchft_tpu.ops.flash_attention import ring_flash_local
+
+        return ring_flash_local(q, k, v, axis_name, causal)
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
     rep = h // hkv
     scale = 1.0 / math.sqrt(d)
 
@@ -179,6 +193,7 @@ def sharded_attention(
     causal: bool = True,
     batch_axes: "Optional[tuple]" = None,
     head_axis: "Optional[str]" = None,
+    may_use_pallas: bool = False,
 ) -> jax.Array:
     """Shared shard_map wrapper for sequence-parallel attention bodies.
 
@@ -193,6 +208,10 @@ def sharded_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # vma validation stays ON except when the body may lower to
+        # pallas_call (flash ring tiles), whose out_shape carries no vma
+        # annotation
+        check_vma=not may_use_pallas,
     )
     return fn(q, k, v)
 
@@ -209,7 +228,10 @@ def ring_attention(
 ) -> jax.Array:
     """shard_map'd ring attention over ``mesh`` axis ``axis_name``
     (see :func:`sharded_attention` for the layout contract)."""
+    size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    t_local = q.shape[1] // size
     return sharded_attention(
         ring_attention_local, q, k, v, mesh, axis_name, causal,
         batch_axes, head_axis,
+        may_use_pallas=t_local % 128 == 0,
     )
