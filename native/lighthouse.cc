@@ -249,6 +249,21 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
 
   std::unique_lock<std::mutex> lk(mu_);
   int64_t now = now_ms();
+  max_rpc_timeout_ms_ = std::max(max_rpc_timeout_ms_, timeout_ms);
+  // Supersession is one-directional: an incarnation that has been evicted
+  // (a newer incarnation of the same logical replica joined after it) can
+  // never re-register or evict its successor, even if the old process is
+  // still alive (hung, partitioned-then-rescheduled) and retries.  The
+  // lighthouse's arrival order IS the incarnation order — uuid4 suffixes
+  // carry none of their own.
+  {
+    auto ev = evicted_at_ms_.find(requester.replica_id);
+    if (ev != evicted_at_ms_.end()) {
+      ev->second = now;  // still calling -> still alive -> keep the stamp
+      throw std::runtime_error(
+          "superseded by a newer incarnation of this replica");
+    }
+  }
   // Implicit heartbeat + registration.
   heartbeats_[requester.replica_id] = now;
   participants_[requester.replica_id] = {requester, now};
@@ -266,10 +281,11 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   // live process, and the newest joiner is it.  The superseded entry is
   // removed from heartbeats_ AND participants_ (a kill can land while the
   // old incarnation is blocked inside rpc_quorum, leaving its request
-  // registered), and stamped in evicted_seq_ so the dead incarnation's
+  // registered), and stamped in evicted_at_ms_ so the dead incarnation's
   // ghost handler thread (its client is gone but the handler blocks until
   // its RPC deadline) aborts instead of re-inserting the stale state from
-  // its wait loop.  Empty prefixes never match: default replica_id=""
+  // its wait loop, and its background heartbeats are ignored (see
+  // rpc_heartbeat).  Empty prefixes never match: default replica_id=""
   // gives every replica the ":uuid" shape — distinct logical replicas.
   {
     auto prefix_of = [](const std::string& id) {
@@ -281,24 +297,28 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       for (auto it = heartbeats_.begin(); it != heartbeats_.end();) {
         if (it->first != requester.replica_id &&
             prefix_of(it->first) == new_prefix) {
-          evicted_seq_[it->first] = ++evict_counter_;
+          evicted_at_ms_[it->first] = now;
           participants_.erase(it->first);
           it = heartbeats_.erase(it);
         } else {
           ++it;
         }
       }
-      // Bound evicted_seq_: ghosts only live for one RPC deadline, so
-      // stamps older than the last 256 evictions are dead weight.
-      for (auto it = evicted_seq_.begin(); it != evicted_seq_.end();) {
-        if (evict_counter_ - it->second > 256)
-          it = evicted_seq_.erase(it);
-        else
-          ++it;
-      }
+    }
+    // Prune stamps by AGE, not count: a ghost handler can stay blocked for
+    // its full RPC deadline (and a zombie's heartbeat thread runs
+    // indefinitely), so keep each stamp for 2x the largest quorum deadline
+    // ever requested plus the heartbeat window — a restart storm of any
+    // size cannot age out a stamp that a live ghost still needs.
+    const int64_t keep_ms =
+        2 * std::max(max_rpc_timeout_ms_, opt_.heartbeat_timeout_ms);
+    for (auto it = evicted_at_ms_.begin(); it != evicted_at_ms_.end();) {
+      if (now - it->second > keep_ms)
+        it = evicted_at_ms_.erase(it);
+      else
+        ++it;
     }
   }
-  const int64_t entry_evict_counter = evict_counter_;
   int64_t seen_seq = quorum_seq_;
   // Proactive tick so a completing quorum doesn't wait for the next tick.
   tick_locked(now);
@@ -312,15 +332,14 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       std::max<int64_t>(1, std::min<int64_t>(opt_.heartbeat_timeout_ms / 2,
                                              1000)));
   while (true) {
-    {
-      // Superseded by a newer incarnation after we entered: abort BEFORE
-      // re-registering anything (see eviction block above) — this handler
-      // belongs to a replica whose replacement has already joined.
-      auto ev = evicted_seq_.find(requester.replica_id);
-      if (ev != evicted_seq_.end() && ev->second > entry_evict_counter)
-        throw std::runtime_error(
-            "superseded by a newer incarnation of this replica");
-    }
+    // Superseded by a newer incarnation after we entered: abort BEFORE
+    // re-registering anything (see eviction block above) — this handler
+    // belongs to a replica whose replacement has already joined.  (The
+    // entry check above guarantees we were not stamped at entry, so
+    // presence alone means "evicted after we entered".)
+    if (evicted_at_ms_.count(requester.replica_id))
+      throw std::runtime_error(
+          "superseded by a newer incarnation of this replica");
     if (quorum_seq_ != seen_seq) {
       seen_seq = quorum_seq_;
       const Quorum& q = latest_quorum_;
@@ -349,8 +368,23 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
 
 Json LighthouseServer::rpc_heartbeat(const Json& params) {
   std::lock_guard<std::mutex> g(mu_);
-  heartbeats_[params.get("replica_id").as_string()] = now_ms();
-  return Json::object();
+  const std::string rid = params.get("replica_id").as_string();
+  Json out = Json::object();
+  // A superseded incarnation's background heartbeat thread must not
+  // resurrect its heartbeats_ entry — that would make the zombie "healthy
+  // but not participating" and wedge quorum behind join_timeout for as
+  // long as the zombie lives.  Tell the caller instead of recording, and
+  // REFRESH the stamp: a zombie that is still heartbeating is still alive,
+  // so its stamp must outlive the age-based prune for as long as it keeps
+  // calling (the prune only clears stamps of incarnations gone silent).
+  auto ev = evicted_at_ms_.find(rid);
+  if (ev != evicted_at_ms_.end()) {
+    ev->second = now_ms();
+    out["superseded"] = true;
+    return out;
+  }
+  heartbeats_[rid] = now_ms();
+  return out;
 }
 
 void LighthouseServer::handle_http(int fd, const std::string& request_head) {

@@ -106,11 +106,14 @@ class LighthouseServer : public RpcServer {
   std::condition_variable quorum_cv_;
   std::map<std::string, ParticipantDetails> participants_;
   std::map<std::string, int64_t> heartbeats_;
-  // Fast-restart supersession bookkeeping: id -> eviction sequence number
-  // (a ghost rpc_quorum waiter compares against its entry snapshot and
-  // aborts instead of resurrecting the evicted heartbeat).
-  std::map<std::string, int64_t> evicted_seq_;
-  int64_t evict_counter_ = 0;
+  // Fast-restart supersession bookkeeping: id -> eviction wall time (ms).
+  // Presence is the supersession stamp: an evicted incarnation can never
+  // re-register, heartbeat, or evict its successor (one-directional — the
+  // lighthouse's arrival order IS the incarnation order).  Entries are
+  // pruned by age relative to the largest RPC deadline ever seen, so a
+  // ghost handler still blocked on a long timeout keeps its stamp.
+  std::map<std::string, int64_t> evicted_at_ms_;
+  int64_t max_rpc_timeout_ms_ = 0;
   std::optional<Quorum> prev_quorum_;
   int64_t quorum_id_ = 0;
   // Broadcast: monotonically increasing sequence of formed quorums.

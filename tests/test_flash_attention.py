@@ -42,6 +42,30 @@ class TestFlashForward:
                 np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
             )
 
+    def test_fully_masked_rows_yield_zero_not_mean_of_v(self):
+        # A chunk whose queries all PRECEDE every key (causal ring chunk
+        # with q_off < k_off) has zero live keys per row: the kernel must
+        # emit O == 0 and lse ~ -inf for such rows, not exp(-inf - -inf)=1
+        # weights (a garbage mean of V).
+        from torchft_tpu.ops.flash_attention import _fwd, _to3
+
+        q, k, v = _qkv(t=128)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        h = q.shape[2]
+        ke = jnp.repeat(k, h // k.shape[2], axis=2)
+        ve = jnp.repeat(v, h // v.shape[2], axis=2)
+        # keys start INSIDE the first tile (k_off=64): rows 0..63 are fully
+        # masked within a tile the block-level `needed` gate keeps live, so
+        # this exercises the p-masking line (an out-of-tile offset like 4096
+        # would be skipped by the gate and pass even without the fix)
+        offs = jnp.array([0, 64], jnp.int32)
+        o, lse = _fwd(_to3(q), _to3(ke), _to3(ve), scale, True, offs)
+        o, lse = np.asarray(o), np.asarray(lse)
+        np.testing.assert_array_equal(o[:, :64], 0.0)
+        assert np.all(lse[:, :64] < -1e20)
+        # live rows are untouched by the masking
+        assert np.all(np.isfinite(o[:, 64:])) and np.any(o[:, 64:] != 0.0)
+
     def test_rejects_unaligned_seq(self):
         q, k, v = _qkv(t=100)
         with pytest.raises(ValueError, match="128"):

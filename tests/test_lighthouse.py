@@ -312,3 +312,86 @@ class TestFastRestartSupersession:
             errors = [r for r in results.values() if isinstance(r, Exception)]
             assert len(errors) == 2, results
             assert any("superseded" in str(e) for e in errors), results
+
+    def test_zombie_heartbeat_cannot_rewedge_quorum(self):
+        # A superseded-but-still-alive predecessor (hung, then rescheduled)
+        # keeps its background heartbeat thread running.  If the lighthouse
+        # accepted those heartbeats after eviction, the zombie would be
+        # "healthy but not participating" and every post-rejoin quorum
+        # would wait out the full join timeout again.
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:old"}],
+            )
+            assert isinstance(results["victim:old"], Quorum)
+
+            stop = threading.Event()
+
+            def zombie():
+                c = LighthouseClient(server.address())
+                try:
+                    while not stop.is_set():
+                        c.heartbeat("victim:old")
+                        time.sleep(0.02)
+                finally:
+                    c.close()
+
+            t = threading.Thread(target=zombie, daemon=True)
+            t.start()
+            try:
+                start = time.monotonic()
+                results = _concurrent_quorums(
+                    server.address(),
+                    [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:new"}],
+                )
+                elapsed = time.monotonic() - start
+                assert [
+                    p.replica_id for p in results["victim:new"].participants
+                ] == ["survivor:aaa", "victim:new"]
+                assert elapsed < 2.0, (
+                    f"rejoin quorum took {elapsed:.1f}s — zombie heartbeat "
+                    "re-wedged quorum formation"
+                )
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+    def test_evicted_incarnation_cannot_evict_successor(self):
+        # Supersession is one-directional: once evicted, the old incarnation
+        # can never re-register — a zombie's quorum retry is rejected with
+        # 'superseded' instead of evicting the legitimate successor (which
+        # would make the two incarnations mutually evict forever).
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:old"}],
+            )
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:new"}],
+            )
+            assert isinstance(results["victim:new"], Quorum)
+
+            # the zombie predecessor retries its quorum RPC
+            res = _concurrent_quorums(
+                server.address(), [{"replica_id": "victim:old"}], timeout=2.0
+            )
+            assert isinstance(res["victim:old"], Exception), res
+            assert "superseded" in str(res["victim:old"])
+
+            # the successor is unaffected: the next round still forms fast
+            start = time.monotonic()
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"}, {"replica_id": "victim:new"}],
+            )
+            elapsed = time.monotonic() - start
+            assert [
+                p.replica_id for p in results["victim:new"].participants
+            ] == ["survivor:aaa", "victim:new"]
+            assert elapsed < 2.0, f"successor quorum took {elapsed:.1f}s"

@@ -102,7 +102,14 @@ def _fwd_kernel(
             s = jnp.where(rq >= rk, s, _NEG_INF)
         m_prev = m_s[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # A query row with zero live keys so far has m_new == _NEG_INF, so
+        # s - m_new == 0 for every MASKED entry and p would be 1 — O would
+        # become a garbage mean of V.  Zero p for such rows instead: l
+        # stays 0, O resolves to 0 and lse to ~-inf, so callers passing
+        # offsets (ring chunks where q precedes every k) get an exact
+        # zero-weight chunk rather than relying on the combiner's
+        # exp-underflow to hide it.
+        p = jnp.where(m_new > _NEG_INF / 2, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_s[:] = jnp.broadcast_to(
             l_s[:, :1] * corr + p.sum(axis=1, keepdims=True), l_s.shape

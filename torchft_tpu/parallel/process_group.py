@@ -97,6 +97,22 @@ def _as_numpy(x: Any) -> np.ndarray:
     return np.asarray(x)
 
 
+def _check_recv_buffer(out: np.ndarray, shape: Any, dtype: str) -> None:
+    """Validate a caller-supplied in-place recv buffer against the wire
+    header: shape, dtype, and contiguity must all match (a silent
+    value-cast or reshape would mask a buffer-setup bug).  Shared by the
+    direct wire reader and the Baby PG's in-place emulation."""
+    if (
+        str(out.dtype) != dtype
+        or tuple(out.shape) != tuple(shape)
+        or not out.flags.c_contiguous
+    ):
+        raise RuntimeError(
+            f"in-place recv buffer mismatch: {out.shape}/{out.dtype} vs "
+            f"wire {tuple(shape)}/{dtype}"
+        )
+
+
 def _routable_local_ip(store_addr: str) -> str:
     """Local IP of the interface that routes to the store host.
 
@@ -570,15 +586,13 @@ class ProcessGroupTCP(ProcessGroup):
                     f"collective payload size mismatch: header says {nbytes},"
                     f" shape/dtype imply {out.nbytes}"
                 )
-        elif (
-            out.nbytes != nbytes
-            or str(out.dtype) != header["dtype"]
-            or not out.flags.c_contiguous
-        ):
-            raise RuntimeError(
-                f"in-place recv buffer mismatch: {out.shape}/{out.dtype} vs "
-                f"wire {header['shape']}/{header['dtype']}"
-            )
+        else:
+            _check_recv_buffer(out, header["shape"], header["dtype"])
+            if out.nbytes != nbytes:
+                raise RuntimeError(
+                    f"collective payload size mismatch: header says {nbytes},"
+                    f" shape/dtype imply {out.nbytes}"
+                )
         if nbytes:
             # uint8 view for ml_dtypes compat (see _send_msg)
             self._read_into_sock(
@@ -1689,15 +1703,10 @@ class ProcessGroupBaby(ProcessGroup):
             return work
         # the worker can't share the caller's buffer; emulate in-place by
         # copying the (possibly shm-backed) result into it — with the same
-        # validation the direct backend's wire reader applies (a silent
-        # value-cast would mask a buffer-setup bug)
+        # validation the direct backend's wire reader applies
         def into(arr: np.ndarray) -> np.ndarray:
-            if arr.dtype != out.dtype or arr.nbytes != out.nbytes:
-                raise RuntimeError(
-                    f"in-place recv buffer mismatch: {out.shape}/{out.dtype} "
-                    f"vs wire {arr.shape}/{arr.dtype}"
-                )
-            out[...] = arr.reshape(out.shape)
+            _check_recv_buffer(out, arr.shape, str(arr.dtype))
+            out[...] = arr
             return out
 
         return work.then(into)
