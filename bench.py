@@ -1,6 +1,7 @@
-"""Headline benchmark suite: recovery latency, FT overhead, model MFU.
+"""Headline benchmark suite: recovery latency, FT overhead, model MFU,
+FT-around-model overhead, DiLoCo outer-sync cost.
 
-Three measurements, one JSON line:
+Measurements, one JSON line:
 
 1. **recovery_to_healthy_step_latency** (primary metric, BASELINE.json
    north star): a replica group dies mid-run and must rejoin with ZERO
@@ -32,10 +33,14 @@ Three measurements, one JSON line:
    ``docs/benchmarks.md``.  Reference-scale intent:
    torchft/examples/slurm/runner.py:16-49.
 
-``vs_baseline`` = recovery latency / 1.0 — a 1-second recovery target we
-set for ourselves (the reference publishes no numbers, BASELINE.md; its
-embedded join_timeout default alone is 100 ms + 100 ms quorum tick).
-Values < 1.0 beat the target; lower is better.
+``vs_baseline`` = median recovery latency / 1.0 — a 1-second recovery
+target we set for ourselves (the reference publishes no numbers,
+BASELINE.md; its embedded join_timeout default alone is 100 ms + 100 ms
+quorum tick).  Values < 1.0 beat the target; lower is better.  The
+recovery headline is the MEDIAN of ``RECOVERY_CYCLES`` independent
+kill/rejoin cycles, each with a per-phase breakdown (teardown, manager
+re-init, quorum RPC, PG reconfigure, heal transfer, ring step, commit)
+so a regressed number is attributable to protocol vs host noise.
 
 Recovery/overhead compute is host-side numpy on purpose: those benches
 measure the DCN fault-tolerance layer, and routing 16 MB grads through
@@ -63,9 +68,10 @@ from torchft_tpu.parallel.process_group import (
 )
 
 PARAM_SIZE = 4 * 1024 * 1024  # 4M fp32 = 16 MB state dict
-TOTAL_STEPS = 30
+TOTAL_STEPS = 20
 KILL_AT_STEP = 10
 KILL_REPLICA = 1
+RECOVERY_CYCLES = 3  # independent kill/rejoin cycles; median is the headline
 
 OVERHEAD_WARMUP = 5
 OVERHEAD_STEPS = 30
@@ -127,9 +133,11 @@ class Replica:
         )
         healed = attempt > 0
         if healed and self.bench.t_killed is not None:
+            self.bench.teardown_s = t_init0 - self.bench.t_killed
+            self.bench.manager_init_s = time.perf_counter() - t_init0
             log(f"replica {self.replica_id}: teardown+restart took "
-                f"{t_init0 - self.bench.t_killed:.3f}s, manager re-init "
-                f"{time.perf_counter() - t_init0:.3f}s")
+                f"{self.bench.teardown_s:.3f}s, manager re-init "
+                f"{self.bench.manager_init_s:.3f}s")
         try:
             while manager.current_step() < TOTAL_STEPS:
                 step = manager.current_step()
@@ -154,6 +162,9 @@ class Replica:
                     self.step_times.append(time.perf_counter() - t0)
                     if healed:
                         self.bench.t_healthy = time.perf_counter()
+                        # phases accumulated since this (fresh) Manager was
+                        # built == exactly the recovery step's protocol work
+                        self.bench.healed_phases = manager.pop_phase_times()
                         log(f"replica {self.replica_id}: healthy commit at "
                             f"step {manager.current_step()} after heal "
                             f"(quorum+heal+step {time.perf_counter() - t0:.3f}s)")
@@ -168,11 +179,17 @@ class Replica:
 
 
 class RecoveryBench:
+    """One kill/rejoin cycle: 2 replica groups, kill one mid-run, time
+    kill→healthy-commit with a per-phase breakdown of where it went."""
+
     def __init__(self) -> None:
         self.t_killed: "Optional[float]" = None
         self.t_healthy: "Optional[float]" = None
+        self.teardown_s: "Optional[float]" = None
+        self.manager_init_s: "Optional[float]" = None
+        self.healed_phases: "Dict[str, float]" = {}
 
-    def run(self) -> float:
+    def run(self) -> "Dict[str, Any]":
         lighthouse = LighthouseServer(
             min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=1000
         )
@@ -194,7 +211,60 @@ class RecoveryBench:
         log(f"steady-state: median step {statistics.median(all_steps)*1e3:.1f} ms "
             f"({PARAM_SIZE*4/1e6:.0f} MB grads over loopback DCN), "
             f"total wall {wall:.1f}s for {TOTAL_STEPS} steps x 2 replicas")
-        return self.t_healthy - self.t_killed
+
+        # Phase breakdown of kill -> healthy commit.  teardown + manager
+        # re-init happen before the healed Manager exists; the rest comes
+        # from its pop_phase_times().  quorum_rpc / pg_configure /
+        # heal_recv run on the async-quorum thread and are what the
+        # caller-side quorum_wait was waiting FOR (they overlap it, not
+        # add to it); ring + commit are the healed step's collective and
+        # commit barrier.
+        phases_ms: "Dict[str, float]" = {
+            "teardown": (self.teardown_s or 0.0) * 1e3,
+            "manager_init": (self.manager_init_s or 0.0) * 1e3,
+        }
+        for k in ("quorum_rpc", "pg_configure", "heal_recv", "ring",
+                  "commit", "quorum_wait", "host_sync"):
+            if k in self.healed_phases:
+                phases_ms[k] = self.healed_phases[k] * 1e3
+        return {
+            "latency_s": self.t_healthy - self.t_killed,
+            "phases_ms": {k: round(v, 1) for k, v in phases_ms.items()},
+            "steady_step_ms": round(statistics.median(all_steps) * 1e3, 1),
+            "wall_s": round(wall, 1),
+        }
+
+
+def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
+    """>= 3 independent kill/rejoin cycles; the MEDIAN is the headline (one
+    cycle on a 1-core host is a coin flip — r03's single sample measured
+    1.059 s on the driver vs 0.14-0.22 s locally with no way to tell host
+    noise from a protocol pathology; the per-cycle phase breakdown now
+    says which)."""
+    cycle_results = []
+    for i in range(cycles):
+        r = RecoveryBench().run()
+        log(f"recovery cycle {i}: {r['latency_s']:.3f}s phases {r['phases_ms']}")
+        cycle_results.append(r)
+
+    latencies = [r["latency_s"] for r in cycle_results]
+    median_latency = statistics.median(latencies)
+    # median per phase across cycles (phases missing in a cycle count as 0)
+    keys = sorted({k for r in cycle_results for k in r["phases_ms"]})
+    phase_median = {
+        k: round(statistics.median([r["phases_ms"].get(k, 0.0)
+                                    for r in cycle_results]), 1)
+        for k in keys
+    }
+    return {
+        "value": round(median_latency, 3),
+        "recovery_cycles_s": [round(x, 3) for x in latencies],
+        "recovery_min_s": round(min(latencies), 3),
+        "recovery_phases_ms": phase_median,
+        "steady_step_ms": round(
+            statistics.median([r["steady_step_ms"] for r in cycle_results]), 1
+        ),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -400,7 +470,108 @@ def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
 
 
 # ---------------------------------------------------------------------------
-# 3. flagship model MFU on the attached accelerator
+# 3. DiLoCo outer sync at flagship scale (the BASELINE.json north star)
+# ---------------------------------------------------------------------------
+
+FLAGSHIP_PARAMS = int(464.4e6)  # matches the bench_model flagship config
+DILOCO_FRAGMENTS = 8            # Streaming DiLoCo fragment count
+DILOCO_SYNC_EVERY = 20          # inner steps per fragment cycle
+
+
+def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
+    """One full outer sync of flagship-scale pseudogradients over the
+    loopback ring, f32 vs int8-quantized — the product's reason to exist
+    on DCN, priced at the scale BASELINE.json describes.
+
+    Streaming-DiLoCo shape: ~464 M params in 8 fragments, each fragment
+    allreduced separately (that IS the streaming schedule — and it caps
+    peak memory at one ~232 MB fragment per rank instead of 1.86 GiB).
+    Pseudograds are host numpy (the outer sync runs on the DCN host path;
+    the device-side Pallas quantize has its own bitwise-equivalence tests
+    and here the host codec is the honest leg for host arrays).
+
+    Amortized cost per inner step = sync wall / sync_every; overhead_pct
+    prices it against the measured flagship model step.  This is the
+    NO-OVERLAP upper bound — the product overlaps fragment syncs with
+    inner steps (local_sgd.py fragment_sync_delay), so real overhead is
+    lower.
+    """
+    world = 2
+    frag_elems = FLAGSHIP_PARAMS // DILOCO_FRAGMENTS
+    legs: "Dict[str, Dict[str, Any]]" = {}
+    for leg, quantize in (("f32", False), ("int8", True)):
+        from torchft_tpu.ops.collectives import allreduce_quantized
+
+        store = StoreServer()
+        barrier = threading.Barrier(world)
+        walls: "Dict[int, float]" = {}
+        wires: "Dict[int, int]" = {}
+
+        def worker(rank: int) -> None:
+            pg = ProcessGroupTCP(timeout=300.0)
+            pg.configure(
+                f"{store.address()}/diloco_{leg}", f"dl_{rank}", rank, world
+            )
+            try:
+                rng = np.random.default_rng(rank)
+                frag = rng.standard_normal(frag_elems).astype(np.float32)
+                barrier.wait(timeout=60)
+                t0 = time.perf_counter()
+                wire = 0
+                for _ in range(DILOCO_FRAGMENTS):
+                    if quantize:
+                        w = allreduce_quantized([frag], REDUCE_SUM, pg)
+                        w.wait(timeout=600)
+                        wire += w.wire_bytes
+                    else:
+                        pg.allreduce([frag], REDUCE_SUM).wait(timeout=600)
+                        # 2-rank ring: reduce-scatter half + allgather half
+                        # = nbytes sent per rank per allreduce
+                        wire += frag.nbytes
+                walls[rank] = time.perf_counter() - t0
+                wires[rank] = wire
+            finally:
+                pg.shutdown()
+
+        threads = [
+            threading.Thread(target=worker, args=(r,), daemon=True)
+            for r in range(world)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=900)
+        finally:
+            store.shutdown()
+        assert len(walls) == world, f"diloco {leg} leg failed"
+        sync_s = max(walls.values())
+        amortized_ms = sync_s * 1e3 / DILOCO_SYNC_EVERY
+        legs[leg] = {
+            "sync_s": round(sync_s, 2),
+            "wire_gb": round(wires[0] / 1e9, 3),
+            "amortized_ms_per_inner_step": round(amortized_ms, 1),
+            "overhead_pct_vs_model_step": round(
+                100.0 * amortized_ms / model_step_ms, 1
+            ),
+        }
+        log(f"diloco {leg}: one outer sync of {FLAGSHIP_PARAMS/1e6:.0f}M "
+            f"params in {sync_s:.2f}s ({wires[0]/1e9:.2f} GB wire) -> "
+            f"{amortized_ms:.0f} ms/inner-step amortized at "
+            f"sync_every={DILOCO_SYNC_EVERY} = "
+            f"{legs[leg]['overhead_pct_vs_model_step']:.1f}% of a "
+            f"{model_step_ms:.0f} ms model step (no-overlap upper bound)")
+    legs["wire_reduction_x"] = round(
+        legs["f32"]["wire_gb"] / max(legs["int8"]["wire_gb"], 1e-9), 2
+    )
+    legs["params_m"] = round(FLAGSHIP_PARAMS / 1e6, 1)
+    legs["fragments"] = DILOCO_FRAGMENTS
+    legs["sync_every"] = DILOCO_SYNC_EVERY
+    return legs
+
+
+# ---------------------------------------------------------------------------
+# 4. flagship model MFU on the attached accelerator
 # ---------------------------------------------------------------------------
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets).
@@ -444,6 +615,108 @@ def _model_flops_per_step(cfg, batch: int, seq: int) -> "Dict[str, float]":
         "flops": float(mm + attn),
         "tokens": float(tokens),
     }
+
+
+def _ft_around_model_step(
+    multi_step, params, opt_state, tokens, step_s: float,
+    steps: int = 6, warmup: int = 2,
+) -> "Dict[str, Any]":
+    """FT overhead around the REAL on-chip model step (VERDICT r03 #2).
+
+    Runs the flagship ``multi_step`` inside the full Manager per-step
+    protocol (world-size-1 ring: quorum RPC + managed allreduce of a real
+    on-device proxy leaf + commit vote) and prices the protocol against
+    the bare fused-dispatch step time measured by the difference method.
+
+    Measurement is the phase-sum estimator (``pop_phase_times``), not a
+    twin wall-clock ratio — the loop's wall time is tunnel-RTT-bound
+    (~200 ms/dispatch under the driver) and means nothing.  The headline
+    ``model_overhead_pct`` counts quorum_wait + commit + host_sync: the
+    phases a real pod pays per step.  ``proxy_ring_ms`` (the managed
+    allreduce of a real jax-array leaf, incl. its device→host
+    materialisation on the PG worker) is reported separately because on
+    the driver it is dominated by the tunnel round trip — on-pod that hop
+    is PCIe-microseconds.  The proxy leaf is a real output of the step
+    (so the jax-array host path of manager.allreduce is exercised
+    end-to-end), sized token-scale rather than full-grad-scale because
+    full grads cannot cross the driver tunnel (and the DCN-scale sync
+    cost is priced at full scale by bench_diloco).
+    """
+    import jax
+
+    # a real on-device leaf of the step output as the allreduce proxy:
+    # remember its flat index so each iteration reduces the leaf freshly
+    # produced by THAT step (not a stale buffer)
+    all_leaves = jax.tree_util.tree_leaves(params)
+    proxy = min(
+        (x for x in all_leaves if x.ndim >= 1),
+        key=lambda x: abs(x.size - 2048),
+    )
+    proxy_idx = next(i for i, x in enumerate(all_leaves) if x is proxy)
+
+    lighthouse = LighthouseServer(
+        min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    manager = None
+    acc: "Dict[str, float]" = {}
+    ring_ms: "List[float]" = []
+    try:
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=30.0),
+            min_replica_size=1,
+            load_state_dict=lambda sd: None,
+            state_dict=lambda: {"ok": np.zeros(1, np.float32)},
+            lighthouse_addr=lighthouse.address(),
+            replica_id="model_ft",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=True,
+            timeout=30.0,
+            quorum_timeout=30.0,
+        )
+        for step in range(steps):
+            manager.start_quorum()
+            p2, o2, loss = multi_step(params, opt_state, tokens, 1)
+            # keep only the proxy leaf of the step output: holding the full
+            # updated (params, opt_state) alongside the originals would put
+            # 3x the ~5.6 GB optimizer state in HBM transiently -> OOM
+            proxy = jax.tree_util.tree_leaves(p2)[proxy_idx]
+            del p2, o2
+            # sync the dispatch the same way the bare measurement does, so
+            # the protocol phases below are measured with the device idle
+            assert np.isfinite(float(loss))
+            work = manager.allreduce({"g": proxy})
+            work.wait(timeout=30)
+            committed = manager.should_commit()
+            assert committed, "world-1 FT step failed to commit"
+            phase = manager.pop_phase_times()
+            if step >= warmup:
+                ring_ms.append(phase.get("ring", 0.0) * 1e3)
+                for k, v in phase.items():
+                    acc[k] = acc.get(k, 0.0) + v
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        lighthouse.shutdown()
+
+    n = steps - warmup
+    protocol_ms = (
+        acc.get("quorum_wait", 0.0) + acc.get("commit", 0.0)
+        + acc.get("host_sync", 0.0)
+    ) * 1e3 / n
+    out = {
+        "protocol_ms_per_step": round(protocol_ms, 3),
+        "model_overhead_pct": round(100.0 * protocol_ms / (step_s * 1e3), 2),
+        "proxy_ring_ms": round(statistics.median(ring_ms), 1),
+        "phases_ms_per_step": {
+            k: round(v * 1e3 / n, 3) for k, v in sorted(acc.items())
+        },
+    }
+    log(f"model FT overhead: protocol +{protocol_ms:.2f} ms on a "
+        f"{step_s*1e3:.0f} ms step -> {out['model_overhead_pct']:.2f}% "
+        f"(proxy ring {out['proxy_ring_ms']:.0f} ms, tunnel-RTT-bound "
+        f"under the driver)")
+    return out
 
 
 def bench_model() -> "Dict[str, Any]":
@@ -538,6 +811,13 @@ def bench_model() -> "Dict[str, Any]":
         fl = _model_flops_per_step(cfg, batch, seq)
         peak = _peak_flops(dev.device_kind) if on_tpu else None
         achieved = fl["flops"] / step_s
+        try:
+            ft = _ft_around_model_step(
+                multi_step, params, opt_state, tokens, step_s
+            )
+        except Exception as e:  # noqa: BLE001 - never cost the MFU number
+            log(f"model FT-overhead leg failed: {e!r}")
+            ft = {"error": repr(e)}
         out = {
             "platform": platform,
             "device_kind": dev.device_kind,
@@ -552,6 +832,7 @@ def bench_model() -> "Dict[str, Any]":
             "tokens_per_s": round(fl["tokens"] / step_s),
             "tflops_per_s": round(achieved / 1e12, 1),
             "mfu_pct": round(100.0 * achieved / peak, 1) if peak else None,
+            "ft": ft,
         }
         log(f"model bench: {out}")
         return out
@@ -583,7 +864,7 @@ def bench_model() -> "Dict[str, Any]":
 
 
 def main() -> None:
-    latency = RecoveryBench().run()
+    recovery = bench_recovery()
     # The secondary benches must never cost the driver the primary metric:
     # degrade to an "error" field instead of dying without the JSON line.
     try:
@@ -596,13 +877,20 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"model bench failed: {e!r}")
         model = {"error": repr(e)}
+    try:
+        diloco = bench_diloco(model.get("step_ms") or 262.0)
+    except Exception as e:  # noqa: BLE001
+        log(f"diloco bench failed: {e!r}")
+        diloco = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
-        "value": round(latency, 3),
         "unit": "s",
-        "vs_baseline": round(latency / 1.0, 3),
+        "vs_baseline": round(recovery["value"] / 1.0, 3),
+        **recovery,
         **overhead,
+        "model_overhead_pct": (model.get("ft") or {}).get("model_overhead_pct"),
         "model": model,
+        "diloco": diloco,
     }
     print(json.dumps(result), flush=True)
 

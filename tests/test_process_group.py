@@ -448,3 +448,62 @@ class TestNumerics:
             np.testing.assert_array_equal(result, np.full(4, 3000))
         for pg in pgs:
             pg.shutdown()
+
+
+class TestFlightRecorder:
+    """On abort/deadline of a wedged collective, the in-flight op table
+    (op, peer, tag, bytes progressed, deadline, generation) must land in
+    the structured event pipeline — reference dumps the NCCL flight
+    recorder on abort for the same postmortems
+    (torchft/process_group.py:89-108,830-838)."""
+
+    def test_wedged_collective_dumps_flight_record(self, store, tmp_path, monkeypatch):
+        import json
+
+        events_file = tmp_path / "events.jsonl"
+        monkeypatch.setenv("TORCHFT_EVENTS_FILE", str(events_file))
+
+        world = 2
+        pgs = make_group(store, world, prefix="fr", timeout=2.0)
+        try:
+            # rank 0 submits an allreduce; rank 1 never does -> rank 0's ring
+            # exchange wedges on the recv until its deadline fires
+            with pytest.raises(Exception):
+                pgs[0].allreduce([np.ones(1024, np.float32)]).wait(timeout=10)
+
+            events = [
+                json.loads(line)
+                for line in events_file.read_text().strip().splitlines()
+            ]
+            aborts = [e for e in events if e["kind"] == "abort"]
+            assert aborts, f"no abort record in {events}"
+            rec = aborts[-1]
+            assert rec["op"] == "allreduce"
+            assert rec["rank"] == 0 and rec["world"] == 2
+            assert "generation" in rec and "in_flight_s" in rec
+            # it wedged waiting on rank 1 with an expired deadline
+            assert rec["recv_peer"] == 1
+            assert rec["deadline_remaining_s"] <= 0.1
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_abort_mid_op_dumps_flight_record(self, store, monkeypatch):
+        from torchft_tpu.utils.logging import recent_events
+
+        world = 2
+        pgs = make_group(store, world, prefix="fr2", timeout=30.0)
+        try:
+            # wedge rank 0 (long deadline), then abort it from another thread
+            work = pgs[0].allreduce([np.ones(8, np.float32)])
+            import time as _t
+
+            _t.sleep(0.2)  # let the worker enter the blocked recv
+            pgs[0].abort()
+            with pytest.raises(Exception):
+                work.wait(timeout=10)
+            aborts = [e for e in recent_events() if e["kind"] == "abort"]
+            assert aborts and aborts[-1]["op"] == "allreduce"
+        finally:
+            for pg in pgs:
+                pg.shutdown()

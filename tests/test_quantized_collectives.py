@@ -209,3 +209,20 @@ class TestQuantizedCollectives:
             for m in managers:
                 m.shutdown()
             lighthouse.shutdown()
+
+
+def test_quantize_subnormal_rows_stay_finite():
+    """Rows whose absmax is below 127/f32max would overflow the reciprocal
+    scale to inf (NaN payloads); they must encode as exact zeros instead."""
+    from torchft_tpu.ops import quantization as q
+
+    a = np.full((3, 64), 1e-38, dtype=np.float32)
+    a[1] = 0.0
+    a[2] = 1.0  # a normal row for contrast
+    scales, payload = q.quantize(a)
+    assert np.all(np.isfinite(scales))
+    out = q.dequantize(scales, payload, a.shape, np.float32)
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[0], 0.0)  # sub-quantizable -> zero
+    np.testing.assert_array_equal(out[1], 0.0)
+    np.testing.assert_allclose(out[2], 1.0, atol=1e-2)

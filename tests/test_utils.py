@@ -175,3 +175,98 @@ class TestStatefulSampler:
         s.set_epoch(1)
         assert s.state_dict() == {"epoch": 1, "position": 0}
         assert len(list(iter(s))) == s.num_samples
+
+
+class TestEventExporters:
+    """The exporter seam (reference otel.py:42-86 Tee shape): custom sinks
+    install via register_exporter, no monkeypatching."""
+
+    def test_custom_exporter_receives_events(self):
+        from torchft_tpu.utils.logging import (
+            CallbackExporter,
+            log_event,
+            register_exporter,
+            unregister_exporter,
+        )
+
+        seen = []
+        exp = register_exporter(CallbackExporter(seen.append))
+        try:
+            log_event("commit", "hello", replica_id="r0", step=3)
+        finally:
+            unregister_exporter(exp)
+        log_event("commit", "after-unregister", replica_id="r0", step=4)
+        assert len(seen) == 1
+        rec = seen[0]
+        assert rec["kind"] == "commit" and rec["message"] == "hello"
+        assert rec["replica_id"] == "r0" and rec["step"] == 3 and "ts" in rec
+
+    def test_failing_exporter_never_breaks_logging(self):
+        from torchft_tpu.utils.logging import (
+            CallbackExporter,
+            log_event,
+            recent_events,
+            register_exporter,
+            unregister_exporter,
+        )
+
+        def boom(_):
+            raise RuntimeError("sink down")
+
+        exp = register_exporter(CallbackExporter(boom))
+        try:
+            log_event("error", "still records", replica_id="r1", step=0)
+        finally:
+            unregister_exporter(exp)
+        assert any(
+            e["message"] == "still records" for e in recent_events()
+        )
+
+    def test_ring_exporter_bounded(self):
+        from torchft_tpu.utils.logging import RingExporter
+
+        ring = RingExporter(maxlen=4)
+        for i in range(10):
+            ring.export({"i": i})
+        assert [e["i"] for e in ring.events()] == [6, 7, 8, 9]
+
+    def test_abort_kind_accepted(self):
+        from torchft_tpu.utils.logging import log_event, recent_events
+
+        log_event("abort", "collective aborted", op="allreduce", peer=1)
+        assert any(e["kind"] == "abort" for e in recent_events())
+
+    def test_reentrant_exporter_does_not_deadlock(self):
+        # the seam's contract: a sink may re-enter the logging module
+        # (recent_events, even log_event) without deadlocking
+        from torchft_tpu.utils.logging import (
+            CallbackExporter,
+            log_event,
+            recent_events,
+            register_exporter,
+            unregister_exporter,
+        )
+
+        depth = []
+
+        def reentrant(rec):
+            if rec["message"] == "outer" and not depth:
+                depth.append(1)
+                assert isinstance(recent_events(), list)
+                log_event("commit", "inner", step=1)
+
+        exp = register_exporter(CallbackExporter(reentrant))
+        try:
+            done = []
+            t = threading.Thread(
+                target=lambda: (log_event("commit", "outer", step=0),
+                                done.append(True)),
+                daemon=True,
+            )
+            t.start()
+            t.join(timeout=5)
+            assert done, "log_event deadlocked on a re-entrant exporter"
+            msgs = [e["message"] for e in recent_events()]
+            assert "outer" in msgs and "inner" in msgs
+        finally:
+            unregister_exporter(exp)

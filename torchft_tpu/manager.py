@@ -311,6 +311,7 @@ class Manager:
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
         try:
+            t_rpc = time.perf_counter()
             with jax.profiler.TraceAnnotation("torchft::manager::_client::_quorum"):
                 quorum = self._client._quorum(
                     group_rank=self._group_rank,
@@ -321,6 +322,7 @@ class Manager:
                     init_sync=self._init_sync,
                     commit_failures=self._commit_failures,
                 )
+            self._record_phase("quorum_rpc", time.perf_counter() - t_rpc)
         except Exception as e:  # noqa: BLE001 - captured into the protocol
             # Graceful capture (the reference leaves this as a TODO,
             # manager.py:566-567): the replica sits out this step and votes
@@ -367,6 +369,7 @@ class Manager:
                 f"reconfiguring for quorum_id={quorum.quorum_id} store={store_prefixed_addr}"
             )
             try:
+                t_cfg = time.perf_counter()
                 with jax.profiler.TraceAnnotation("torchft::manager::_pg::configure"):
                     self._pg.configure(
                         store_prefixed_addr,
@@ -374,6 +377,7 @@ class Manager:
                         quorum.replica_rank,
                         quorum.replica_world_size,
                     )
+                self._record_phase("pg_configure", time.perf_counter() - t_cfg)
                 self._quorum_id = quorum.quorum_id
             except Exception as e:  # noqa: BLE001 - captured into the protocol
                 self._logger.exception(f"got exception in pg configure: {e}")
@@ -388,6 +392,7 @@ class Manager:
                 self._logger.info(
                     f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                 )
+                t_send = time.perf_counter()
                 with jax.profiler.TraceAnnotation(
                     "torchft::manager::_checkpoint_transport::send_checkpoint"
                 ):
@@ -397,9 +402,11 @@ class Manager:
                         state_dict=self._manager_state_dict(),
                         timeout=self._timeout,
                     )
+                self._record_phase("heal_send", time.perf_counter() - t_send)
 
             if quorum.heal:
                 self._healing = True
+                t_recv = time.perf_counter()
                 self._logger.info(
                     f"healing required, fetching checkpoint metadata from "
                     f"{quorum.recover_src_manager_address} max_step={quorum.max_step}"
@@ -428,6 +435,7 @@ class Manager:
                 # loading the torchft dict restores the step; set it anyway
                 # to make reasoning (and tests) simpler
                 self._step = quorum.max_step
+                self._record_phase("heal_recv", time.perf_counter() - t_recv)
         except Exception as e:  # noqa: BLE001 - captured into the protocol
             self._logger.exception(f"got exception in recovery: {e}")
             self.report_error(e)
@@ -654,14 +662,23 @@ class Manager:
     def pop_phase_times(self) -> "Dict[str, float]":
         """Wall-clock seconds spent per protocol phase since the last call.
 
-        Keys: ``quorum_wait`` (blocked waiting for the async quorum RPC —
-        the part NOT hidden behind the forward pass; includes the wait in
-        ``should_commit``), ``host_sync`` (caller-thread flatten +
-        zero-fill; the device→host materialisation itself runs on the PG
-        worker and lands in ``ring``), ``ring`` (collective
+        Caller-thread keys: ``quorum_wait`` (blocked waiting for the async
+        quorum work — the part NOT hidden behind the forward pass; includes
+        the wait in ``should_commit``), ``host_sync`` (caller-thread
+        flatten + zero-fill; the device→host materialisation itself runs on
+        the PG worker and lands in ``ring``), ``ring`` (collective
         submit→completion: device sync, queueing, the wire, and the
         host-side AVG division chained after the raw collective),
-        ``commit`` (should_commit RPC barrier).  Resets the accumulator.
+        ``commit`` (should_commit RPC barrier).
+
+        Async-quorum-thread keys (run inside the executor, so they OVERLAP
+        ``quorum_wait`` rather than adding to it — they break down what the
+        caller was waiting FOR): ``quorum_rpc`` (the lighthouse-mediated
+        quorum round trip), ``pg_configure`` (collective reconfigure on
+        quorum change), ``heal_send`` / ``heal_recv`` (live checkpoint
+        transfer to/from a recovering peer, incl. the metadata fetch).
+
+        Resets the accumulator.
         """
         with self._phase_lock:
             out, self._phase_acc = self._phase_acc, {}

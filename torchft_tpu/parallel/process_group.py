@@ -321,6 +321,9 @@ class ProcessGroupTCP(ProcessGroup):
         self._errored: Optional[Exception] = None
         self._aborted = False
         self._generation = 0
+        # In-flight op record for the abort flight recorder (written by the
+        # worker thread; read best-effort by _dump_flight from abort()).
+        self._flight: "Optional[Dict[str, Any]]" = None
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
         self._sender: "Optional[concurrent_futures.ThreadPoolExecutor]" = None
@@ -456,6 +459,7 @@ class ProcessGroupTCP(ProcessGroup):
                 item[2].set_exception(_PGAborted("process group torn down"))
 
     def abort(self) -> None:
+        self._dump_flight("process group aborted")
         with self._lock:
             self._aborted = True
             if self._errored is None:
@@ -473,7 +477,7 @@ class ProcessGroupTCP(ProcessGroup):
 
     # -- op submission -----------------------------------------------------
 
-    def _submit(self, fn: "Callable[[], Any]") -> Work:
+    def _submit(self, fn: "Callable[[], Any]", op: str = "op") -> Work:
         fut: Future = Future()
         with self._lock:
             if self._errored is not None:
@@ -485,7 +489,7 @@ class ProcessGroupTCP(ProcessGroup):
             # Enqueue under the lock: the queue object is swapped by
             # _teardown/_start_worker under the same lock, so this item can
             # never land on a retired queue with no worker to fail it.
-            self._queue.put((self._generation, fn, fut))
+            self._queue.put((self._generation, fn, fut, op))
         return Work(fut)
 
     def _worker_loop(self, gen: int, q: "queue.Queue") -> None:
@@ -494,7 +498,7 @@ class ProcessGroupTCP(ProcessGroup):
             item = q.get()
             if item is None:
                 return
-            item_gen, fn, fut = item
+            item_gen, fn, fut, op = item
             with self._lock:
                 superseded = self._generation != gen
                 errored = self._errored
@@ -505,13 +509,61 @@ class ProcessGroupTCP(ProcessGroup):
                     errored or _PGAborted("process group reconfigured")
                 )
                 continue
+            self._flight = {
+                "op": op,
+                "generation": item_gen,
+                "rank": self._rank,
+                "world": self._world,
+                "started_at": time.time(),
+            }
             try:
                 fut.set_result(fn())
+                self._flight = None
             except Exception as e:  # noqa: BLE001 - latch every op failure
+                # Flight-recorder dump BEFORE latching: when a wedged
+                # collective dies (deadline, peer reset), the op-level state
+                # — what was in flight, with whom, how far it got — is the
+                # evidence the postmortem needs (reference dumps the NCCL
+                # flight recorder on abort for the same reason,
+                # torchft/process_group.py:89-108,830-838).
+                self._dump_flight(f"collective failed: {e!r}")
                 with self._lock:
                     if self._errored is None:
                         self._errored = e
                 fut.set_exception(e)
+
+    # -- flight recorder ---------------------------------------------------
+
+    def _flight_io(self, **kw: Any) -> None:
+        """Worker-thread-only: merge current transfer state (direction,
+        peer, tag, bytes) into the in-flight op record."""
+        f = self._flight
+        if f is not None:
+            f.update(kw)
+
+    def _flight_progress(self, nbytes: int) -> None:
+        f = self._flight
+        if f is not None:
+            f["bytes_done"] = f.get("bytes_done", 0) + nbytes
+
+    def _dump_flight(self, reason: str) -> None:
+        """Write the in-flight op table to the structured event pipeline
+        (JSONL sink when TORCHFT_EVENTS_FILE is set)."""
+        f = self._flight
+        self._flight = None
+        if f is None:
+            return
+        from torchft_tpu.utils.logging import log_event
+
+        f = dict(f)
+        deadline = f.pop("deadline_mono", None)
+        if deadline is not None:
+            f["deadline_remaining_s"] = round(deadline - time.monotonic(), 3)
+        f["in_flight_s"] = round(time.time() - f.pop("started_at"), 3)
+        try:
+            log_event("abort", reason, **f)
+        except Exception:  # noqa: BLE001 - recorder must never mask the error
+            logger.exception("flight-recorder dump failed")
 
     # -- wire helpers ------------------------------------------------------
 
@@ -532,9 +584,8 @@ class ProcessGroupTCP(ProcessGroup):
             raise _PGAborted(f"no connection to rank {rank}")
         return peer
 
-    @staticmethod
     def _read_into_sock(
-        sock: socket.socket, view: memoryview, deadline: float
+        self, sock: socket.socket, view: memoryview, deadline: float
     ) -> None:
         """recv_into a buffer — zero intermediate copies for payloads."""
         off, n = 0, len(view)
@@ -544,12 +595,17 @@ class ProcessGroupTCP(ProcessGroup):
             if got == 0:
                 raise ConnectionError("peer closed connection")
             off += got
+            self._flight_progress(got)
 
     def _send_msg(self, dst: int, tag: int, array: np.ndarray, deadline: float) -> None:
         peer = self._peer(dst)
         array = np.ascontiguousarray(array)
         header = pickle.dumps(
             {"tag": tag, "shape": array.shape, "dtype": str(array.dtype)}
+        )
+        self._flight_io(
+            send_peer=dst, send_tag=tag, send_bytes=array.nbytes,
+            deadline_mono=deadline,
         )
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
         peer.sock.sendall(struct.pack(">II", len(header), array.nbytes) + header)
@@ -571,6 +627,10 @@ class ProcessGroupTCP(ProcessGroup):
         fast path for ring steps — reference pg_transport in-place recv
         analog, torchft/checkpointing/pg_transport.py:230-300)."""
         peer = self._peer(src)
+        # record the blocked-on peer BEFORE the header read: a wedged recv
+        # (peer never sends) hangs right here, and that is exactly the state
+        # the flight recorder must capture
+        self._flight_io(recv_peer=src, recv_tag=tag, deadline_mono=deadline)
         hlen, nbytes = struct.unpack(
             ">II", self._read_exact_sock(peer.sock, 8, deadline)
         )
@@ -593,6 +653,7 @@ class ProcessGroupTCP(ProcessGroup):
                     f"collective payload size mismatch: header says {nbytes},"
                     f" shape/dtype imply {out.nbytes}"
                 )
+        self._flight_io(recv_bytes=nbytes)
         if nbytes:
             # uint8 view for ml_dtypes compat (see _send_msg)
             self._read_into_sock(
@@ -659,7 +720,7 @@ class ProcessGroupTCP(ProcessGroup):
             np_arrays = [_as_numpy(a) for a in arrays]
             return self._allreduce_coalesced(np_arrays, op, deadline)
 
-        return self._submit(run)
+        return self._submit(run, op="allreduce")
 
     # Pack small same-acc-dtype leaves into buckets up to this many bytes.
     # Below the cap, coalescing wins (one ring amortizes per-message
@@ -793,7 +854,7 @@ class ProcessGroupTCP(ProcessGroup):
                 )
             return [p.copy() for p in pieces]  # type: ignore[union-attr]
 
-        return self._submit(run)
+        return self._submit(run, op="allgather")
 
     def broadcast(self, array: Any, root: int = 0) -> Work:
         np_array = _as_numpy(array)
@@ -811,7 +872,7 @@ class ProcessGroupTCP(ProcessGroup):
                 return np_array.copy()
             return self._recv_msg(root, 400, deadline)
 
-        return self._submit(run)
+        return self._submit(run, op="broadcast")
 
     def reduce_scatter(self, array: Any, op: str = REDUCE_SUM) -> Work:
         np_array = _as_numpy(array)
@@ -855,7 +916,7 @@ class ProcessGroupTCP(ProcessGroup):
             # the result
             return np.array(result, dtype=np_array.dtype)
 
-        return self._submit(run)
+        return self._submit(run, op="reduce_scatter")
 
     def alltoall(self, arrays: "List[Any]") -> Work:
         np_arrays = [_as_numpy(a) for a in arrays]
@@ -876,7 +937,7 @@ class ProcessGroupTCP(ProcessGroup):
                 )
             return out  # type: ignore[return-value]
 
-        return self._submit(run)
+        return self._submit(run, op="alltoall")
 
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         np_array = _as_numpy(array)
@@ -886,7 +947,7 @@ class ProcessGroupTCP(ProcessGroup):
             deadline = time.monotonic() + deadline_budget
             self._send_msg(dst, 1000 + tag, np_array, deadline)
 
-        return self._submit(run)
+        return self._submit(run, op="send")
 
     def recv(self, src: int, tag: int = 0, out: "Optional[np.ndarray]" = None) -> Work:
         """``out``: receive straight into this buffer (shape/dtype must
@@ -897,7 +958,7 @@ class ProcessGroupTCP(ProcessGroup):
             deadline = time.monotonic() + deadline_budget
             return self._recv_msg(src, 1000 + tag, deadline, out=out)
 
-        return self._submit(run)
+        return self._submit(run, op="recv")
 
 
 # ---------------------------------------------------------------------------

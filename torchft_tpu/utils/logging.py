@@ -1,20 +1,26 @@
-"""Structured event logging for quorums / commits / errors.
+"""Structured event logging for quorums / commits / errors / aborts.
 
 Analog of the reference's structured-event pipeline (reference:
-torchft/otel.py:42-86 and manager.py:659-669,848-858): three well-known
-loggers receive one record per protocol event, each carrying
-``extra={job_id, replica_id, rank, quorum_id, step, ...}``.  OTLP export is
-out of scope for this environment (zero egress); the pipeline here has
-three sinks:
+torchft/otel.py:42-86 and manager.py:659-669,848-858).  The reference's
+OTEL layer is an exporter *interface* (a Tee of console + OTLP sinks);
+this module mirrors that shape: ``log_event`` fans every record out to a
+registry of :class:`EventExporter` objects.  OTLP itself is out of scope
+in a zero-egress environment, but the seam is what a deployment needs —
+``register_exporter`` installs any custom sink without monkeypatching.
 
-- stdlib logging with the extras rendered inline;
-- an in-memory ring of recent events that the lighthouse dashboard and
-  tests can inspect;
-- a **persistent JSONL file** (the crash-durable sink — an FT system's
-  logs matter most when the process dies): set ``TORCHFT_EVENTS_FILE`` to
-  a path and every event is appended as one JSON line, flushed per event,
-  with size-based rotation to ``<path>.1`` at ``TORCHFT_EVENTS_MAX_BYTES``
-  (default 16 MiB).
+Built-in exporters:
+
+- :class:`RingExporter` — in-memory ring of recent events the lighthouse
+  dashboard and tests inspect (always installed; ``recent_events()``).
+- :class:`JSONLFileExporter` — the crash-durable sink (an FT system's
+  logs matter most when the process dies): set ``TORCHFT_EVENTS_FILE``
+  to a path and every event is appended as one JSON line, flushed per
+  event, with size-based rotation to ``<path>.1`` at
+  ``TORCHFT_EVENTS_MAX_BYTES`` (default 16 MiB).  Auto-installed from
+  the env var.
+
+Every record additionally lands on stdlib logging with the extras
+rendered inline (the reference's console leg of the Tee).
 """
 
 from __future__ import annotations
@@ -25,26 +31,63 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Deque, Dict, Optional, TextIO
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Deque, Dict, List, Optional, TextIO
 
 _EVENT_RING_SIZE = 256
 
-_quorum_logger = logging.getLogger("torchft_quorums")
-_commit_logger = logging.getLogger("torchft_commits")
-_error_logger = logging.getLogger("torchft_errors")
-
-_lock = threading.Lock()
-_recent_events: Deque[Dict[str, Any]] = collections.deque(maxlen=_EVENT_RING_SIZE)
-
-
 _LOGGERS = {
-    "quorum": _quorum_logger,
-    "commit": _commit_logger,
-    "error": _error_logger,
+    "quorum": logging.getLogger("torchft_quorums"),
+    "commit": logging.getLogger("torchft_commits"),
+    "error": logging.getLogger("torchft_errors"),
+    "abort": logging.getLogger("torchft_aborts"),
 }
 
+_lock = threading.Lock()
 
-class _FileExporter:
+
+class EventExporter(ABC):
+    """One sink in the event pipeline (reference otel.py:42-86 exporter
+    shape).  ``export`` receives every structured record; exceptions are
+    swallowed by the pipeline (a sink must never take down training) but
+    logged.  ``close`` releases resources; an exporter may be registered
+    and unregistered at runtime."""
+
+    @abstractmethod
+    def export(self, record: "Dict[str, Any]") -> None: ...
+
+    def close(self) -> None:  # noqa: B027 - optional hook
+        pass
+
+
+class RingExporter(EventExporter):
+    """Bounded in-memory ring of the most recent events."""
+
+    def __init__(self, maxlen: int = _EVENT_RING_SIZE) -> None:
+        self._events: "Deque[Dict[str, Any]]" = collections.deque(maxlen=maxlen)
+
+    def export(self, record: "Dict[str, Any]") -> None:
+        self._events.append(record)
+
+    def events(self) -> "List[Dict[str, Any]]":
+        return list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+
+class CallbackExporter(EventExporter):
+    """Adapter: wrap a plain callable as an exporter (the cheapest way for
+    user code to tap the event stream)."""
+
+    def __init__(self, fn: "Callable[[Dict[str, Any]], None]") -> None:
+        self._fn = fn
+
+    def export(self, record: "Dict[str, Any]") -> None:
+        self._fn(record)
+
+
+class JSONLFileExporter(EventExporter):
     """Append-per-event JSONL writer with size-based rotation.
 
     Flushes after every event: a SIGKILLed replica must leave its last
@@ -52,12 +95,19 @@ class _FileExporter:
     batch for the same reason, torchft/otel.py:42-86).
     """
 
-    def __init__(self, path: str, max_bytes: int) -> None:
+    def __init__(self, path: str, max_bytes: int = 16 * 1024 * 1024) -> None:
         self._path = path
         self._max_bytes = max_bytes
         self._fh: "Optional[TextIO]" = None
+        # exports may arrive from multiple threads (the pipeline calls
+        # exporters outside its own lock to allow re-entrancy)
+        self._write_lock = threading.Lock()
 
-    def write(self, record: "Dict[str, Any]") -> None:
+    def export(self, record: "Dict[str, Any]") -> None:
+        with self._write_lock:
+            self._export_locked(record)
+
+    def _export_locked(self, record: "Dict[str, Any]") -> None:
         try:
             if self._fh is None:
                 self._fh = open(self._path, "a", encoding="utf-8")
@@ -97,43 +147,77 @@ class _FileExporter:
             self._fh = None
 
 
-_exporter: "Optional[_FileExporter]" = None
-_exporter_env: "Optional[str]" = None  # env value the exporter was built for
+# --- exporter registry ------------------------------------------------------
+
+_ring = RingExporter()
+_registered: "List[EventExporter]" = []
+_env_exporter: "Optional[JSONLFileExporter]" = None
+_env_exporter_path: "Optional[str]" = None  # env value it was built for
 
 
-def _file_exporter() -> "Optional[_FileExporter]":
+def register_exporter(exporter: EventExporter) -> EventExporter:
+    """Install an exporter into the pipeline; returns it (for later
+    :func:`unregister_exporter`).  No monkeypatching required."""
+    with _lock:
+        _registered.append(exporter)
+    return exporter
+
+
+def unregister_exporter(exporter: EventExporter) -> None:
+    """Remove (and close) a previously registered exporter."""
+    with _lock:
+        if exporter in _registered:
+            _registered.remove(exporter)
+    exporter.close()
+
+
+def _env_jsonl_exporter() -> "Optional[JSONLFileExporter]":
     """Resolve the JSONL exporter from ``TORCHFT_EVENTS_FILE`` (re-resolved
     when the env value changes, so tests and launchers can redirect)."""
-    global _exporter, _exporter_env
+    global _env_exporter, _env_exporter_path
     path = os.environ.get("TORCHFT_EVENTS_FILE") or None
-    if path != _exporter_env:
-        if _exporter is not None:
-            _exporter.close()
-        _exporter = (
-            _FileExporter(
+    if path != _env_exporter_path:
+        if _env_exporter is not None:
+            _env_exporter.close()
+        _env_exporter = (
+            JSONLFileExporter(
                 path,
                 int(os.environ.get("TORCHFT_EVENTS_MAX_BYTES", 16 * 1024 * 1024)),
             )
             if path
             else None
         )
-        _exporter_env = path
-    return _exporter
+        _env_exporter_path = path
+    return _env_exporter
 
 
 def log_event(kind: str, message: str, **extra: Any) -> None:
-    """Record a structured protocol event (kind in {quorum, commit, error})."""
+    """Record a structured protocol event
+    (kind in {quorum, commit, error, abort})."""
     if kind not in _LOGGERS:
         raise ValueError(f"unknown event kind {kind!r}, expected one of {sorted(_LOGGERS)}")
-    record = {"kind": kind, "message": message, **extra}
+    record = {"ts": time.time(), "kind": kind, "message": message, **extra}
+    # Snapshot the sink list under the lock, but call export() OUTSIDE it:
+    # a custom exporter is allowed to re-enter this module (recent_events,
+    # even log_event) without deadlocking.  Each exporter handles its own
+    # thread safety (JSONLFileExporter serializes internally; the ring's
+    # deque append is atomic).
     with _lock:
-        _recent_events.append(record)
-        exporter = _file_exporter()
-        if exporter is not None:
-            exporter.write({"ts": time.time(), **record})
+        sinks: "List[EventExporter]" = [_ring]
+        env = _env_jsonl_exporter()
+        if env is not None:
+            sinks.append(env)
+        sinks.extend(_registered)
+    for sink in sinks:
+        try:
+            sink.export(record)
+        except Exception as e:  # noqa: BLE001 - a sink never kills training
+            logging.getLogger(__name__).warning(
+                "event exporter %r failed: %s", type(sink).__name__, e
+            )
     logger = _LOGGERS[kind]
     rendered = " ".join(f"{k}={v}" for k, v in extra.items())
-    if kind == "error":
+    if kind in ("error", "abort"):
         logger.error("%s %s", message, rendered)
     else:
         logger.info("%s %s", message, rendered)
@@ -141,7 +225,7 @@ def log_event(kind: str, message: str, **extra: Any) -> None:
 
 def recent_events() -> "list[Dict[str, Any]]":
     with _lock:
-        return list(_recent_events)
+        return _ring.events()
 
 
 class ReplicaLogger:
