@@ -618,7 +618,7 @@ def _model_flops_per_step(cfg, batch: int, seq: int) -> "Dict[str, float]":
 
 
 def _ft_around_model_step(
-    multi_step, params, opt_state, tokens, step_s: float,
+    multi_step, state, tokens, step_s: float,
     steps: int = 6, warmup: int = 2,
 ) -> "Dict[str, Any]":
     """FT overhead around the REAL on-chip model step (VERDICT r03 #2).
@@ -647,12 +647,12 @@ def _ft_around_model_step(
     # a real on-device leaf of the step output as the allreduce proxy:
     # remember its flat index so each iteration reduces the leaf freshly
     # produced by THAT step (not a stale buffer)
-    all_leaves = jax.tree_util.tree_leaves(params)
-    proxy = min(
+    all_leaves = jax.tree_util.tree_leaves(state[0])
+    proxy_leaf = min(
         (x for x in all_leaves if x.ndim >= 1),
         key=lambda x: abs(x.size - 2048),
     )
-    proxy_idx = next(i for i, x in enumerate(all_leaves) if x is proxy)
+    proxy_idx = next(i for i, x in enumerate(all_leaves) if x is proxy_leaf)
 
     lighthouse = LighthouseServer(
         min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=1000
@@ -676,12 +676,11 @@ def _ft_around_model_step(
         )
         for step in range(steps):
             manager.start_quorum()
-            p2, o2, loss = multi_step(params, opt_state, tokens, 1)
-            # keep only the proxy leaf of the step output: holding the full
-            # updated (params, opt_state) alongside the originals would put
-            # 3x the ~5.6 GB optimizer state in HBM transiently -> OOM
+            # donation contract: the step consumes state and returns the
+            # new buffers; rebind (the bare timing loop does the same)
+            p2, s2, loss = multi_step(state[0], state[1], tokens, 1)
+            state[0], state[1] = p2, s2
             proxy = jax.tree_util.tree_leaves(p2)[proxy_idx]
-            del p2, o2
             # sync the dispatch the same way the bare measurement does, so
             # the protocol phases below are measured with the device idle
             assert np.isfinite(float(loss))
@@ -743,26 +742,32 @@ def bench_model() -> "Dict[str, Any]":
             d_ff=4096, n_layers=16, max_seq_len=1024,
         )
         seq, timed_steps = 1024, 16
-        # (attn, remat, batch): flash+remat+B8 measured best (49.8% MFU);
-        # the adamw f32 state (~5.6 GB) rules out no-remat at useful batch
-        # sizes; dense fallback in case the kernel regresses on a future
-        # driver chip.
-        attempts = [("flash", True, 8), ("flash", True, 4), ("dense", True, 8)]
+        # (attn, remat_policy, batch): flash + dots-policy remat + donated
+        # step buffers measured best (57.1% MFU vs 49 for full remat
+        # without donation); full-remat and dense fallbacks in case a
+        # future driver chip regresses the kernel or the memory headroom.
+        attempts = [
+            ("flash", "dots", 8), ("flash", "full", 8), ("dense", "full", 8)
+        ]
     else:
         base = dict(
             vocab_size=512, d_model=128, n_heads=4, n_kv_heads=2,
             d_ff=384, n_layers=2, max_seq_len=128,
         )
         seq, timed_steps = 128, 5
-        attempts = [("flash", False, 2)]
+        attempts = [("flash", "full", 2)]
 
-    def run(attn: str, remat: bool, batch: int) -> "Dict[str, Any]":
+    def run(attn: str, remat_policy: str, batch: int) -> "Dict[str, Any]":
+        import functools
+
         import jax.numpy as jnp
         from jax import lax
 
         from torchft_tpu.models.transformer import loss_fn
 
-        cfg = TransformerConfig(remat=remat, attn_impl=attn, **base)
+        cfg = TransformerConfig(
+            remat=on_tpu, remat_policy=remat_policy, attn_impl=attn, **base
+        )
         optimizer = optax.adamw(3e-4)
         # One dispatch runs n fused train steps (dynamic trip count -> one
         # compile).  Under the driver the chip sits behind a tunnel with
@@ -771,7 +776,11 @@ def bench_model() -> "Dict[str, Any]":
         # time comes from the DIFFERENCE between an n-step and a 1-step
         # dispatch, each synced by fetching the scalar loss — the RTT and
         # dispatch cost cancel.
-        @jax.jit
+        # donate_argnums: the 5.6 GB params+adamw carry would otherwise be
+        # double-buffered across the dispatch (in + out live at once) —
+        # donation alone measured +5 MFU points at B8 by relieving that
+        # HBM pressure; callers rebind to the returned state each call.
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def multi_step(params, opt_state, tokens, n):
             def body(i, carry):
                 params, opt_state, _ = carry
@@ -793,12 +802,15 @@ def bench_model() -> "Dict[str, Any]":
         tokens = jax.jit(
             lambda k: jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
         )(jax.random.PRNGKey(1))
+        state = [params, opt_state]
 
         def timed(n: int) -> float:
             t0 = time.perf_counter()
-            _, _, loss = multi_step(params, opt_state, tokens, n)
+            p2, s2, loss = multi_step(state[0], state[1], tokens, n)
             assert np.isfinite(float(loss)), "non-finite loss"
-            return time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            state[0], state[1] = p2, s2
+            return dt
 
         t_c0 = time.perf_counter()
         timed(1)  # compile + warm
@@ -812,9 +824,7 @@ def bench_model() -> "Dict[str, Any]":
         peak = _peak_flops(dev.device_kind) if on_tpu else None
         achieved = fl["flops"] / step_s
         try:
-            ft = _ft_around_model_step(
-                multi_step, params, opt_state, tokens, step_s
-            )
+            ft = _ft_around_model_step(multi_step, state, tokens, step_s)
         except Exception as e:  # noqa: BLE001 - never cost the MFU number
             log(f"model FT-overhead leg failed: {e!r}")
             ft = {"error": repr(e)}
@@ -824,7 +834,7 @@ def bench_model() -> "Dict[str, Any]":
             "config": (
                 f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads}/{cfg.n_kv_heads} "
                 f"ff{cfg.d_ff} V{cfg.vocab_size} B{batch} T{seq} "
-                f"{attn} remat={'on' if remat else 'off'}"
+                f"{attn} remat={remat_policy if cfg.remat else 'off'} donated"
             ),
             "params_matmul_m": round(fl["params_matmul"] / 1e6, 1),
             "step_ms": round(step_s * 1e3, 2),

@@ -37,31 +37,29 @@ def _find_lib() -> str:
     """Locate (or build) the shared library.  Search order:
 
     1. ``TORCHFT_NATIVE_LIB`` — explicit override (deployment images);
-    2. the repo-layout ``native/`` source tree — editable/dev installs,
+    2. the packaged ``.so`` next to this module — wheel installs (staged
+       by setup.py's build_py hook);
+    3. the repo-layout ``native/`` directory — editable/dev installs,
        built on first import when missing (g++/make are baked into the
-       target environment).  The source tree outranks a staged ``.so``
-       so a dev checkout where ``pip wheel .`` once copied a build into
-       the package dir never silently shadows later native/ rebuilds;
-    3. the packaged ``.so`` next to this module — wheel installs (staged
-       by setup.py's build_py hook; no source tree present there).
+       target environment).
     """
     env = os.environ.get("TORCHFT_NATIVE_LIB")
     if env:
         if not os.path.exists(env):
             raise FileNotFoundError(f"TORCHFT_NATIVE_LIB={env} does not exist")
         return env
-    if os.path.isdir(_NATIVE_DIR):
-        repo = os.path.join(_NATIVE_DIR, _LIB_NAME)
-        if not os.path.exists(repo):
-            _build()
-        return repo
     packaged = os.path.join(_PKG_DIR, _LIB_NAME)
     if os.path.exists(packaged):
         return packaged
-    raise RuntimeError(
-        "native core not found: no packaged .so, no native/ source tree, "
-        "and TORCHFT_NATIVE_LIB unset"
-    )
+    repo = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    if not os.path.exists(repo):
+        if not os.path.isdir(_NATIVE_DIR):
+            raise RuntimeError(
+                "native core not found: no packaged .so, no native/ source "
+                "tree, and TORCHFT_NATIVE_LIB unset"
+            )
+        _build()
+    return repo
 
 
 def get_lib() -> ctypes.CDLL:

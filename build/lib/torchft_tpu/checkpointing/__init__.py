@@ -1,0 +1,19 @@
+from torchft_tpu.checkpointing.durable import (
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+    save_checkpoint,
+)
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = [
+    "CheckpointTransport",
+    "HTTPTransport",
+    "PGTransport",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "load_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,86 @@
+"""Durable (on-disk) checkpoints for cold-start resume.
+
+Live healing (HTTP/PG transports) covers the *partial* failure case — some
+replicas die, peers hold the state.  Durable checkpoints cover the total
+one: every replica died (preemption, maintenance), so on restart there is
+no healthy peer to heal from and the job must resume from disk.  The
+reference demonstrates this in its trainer: periodic ``torch.save`` of
+``{model, optim}`` alongside ``manager.state_dict()``
+(reference: train_ddp.py:201-208); here the same composite
+``{"user": ..., "torchft": manager.state_dict()}`` pytree goes through the
+transports' streaming serializer (checkpointing/serialization.py) so large
+arrays are written without pickling copies.
+
+Writes are atomic (tmp file + ``os.replace``) so a kill mid-save can never
+corrupt the latest checkpoint, and old checkpoints are pruned to
+``keep_last``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, List, Optional, Tuple
+
+from torchft_tpu.checkpointing.serialization import (
+    deserialize_from,
+    reassemble,
+    serialize_to,
+)
+
+_CKPT_RE = re.compile(r"^ckpt_step(\d+)\.tft$")
+
+
+def _ckpt_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_step{step}.tft")
+
+
+def save_checkpoint(
+    directory: str, step: int, state_dict: Any, keep_last: int = 2
+) -> str:
+    """Atomically write ``state_dict`` for ``step``; prune to ``keep_last``.
+
+    Returns the checkpoint path.  The composite Manager layout
+    (``{"user": ..., "torchft": {"step": ..., ...}}``) is conventional but
+    not required — any pytree serializes.
+    """
+    os.makedirs(directory, exist_ok=True)
+    path = _ckpt_path(directory, step)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        serialize_to(state_dict, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+    if keep_last > 0:
+        for old_step, old_path in list_checkpoints(directory)[:-keep_last]:
+            if old_step != step:
+                try:
+                    os.remove(old_path)
+                except OSError:
+                    pass
+    return path
+
+
+def load_checkpoint(path: str) -> Any:
+    with open(path, "rb") as f:
+        return reassemble(*deserialize_from(f))
+
+
+def list_checkpoints(directory: str) -> "List[Tuple[int, str]]":
+    """All checkpoints in ``directory`` as (step, path), step-ascending."""
+    if not os.path.isdir(directory):
+        return []
+    found = []
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(found)
+
+
+def latest_checkpoint(directory: str) -> "Optional[str]":
+    """Path of the highest-step checkpoint, or None."""
+    ckpts = list_checkpoints(directory)
+    return ckpts[-1][1] if ckpts else None

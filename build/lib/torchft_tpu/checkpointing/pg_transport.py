@@ -1,0 +1,162 @@
+"""ProcessGroup checkpoint transport: push weights over collectives.
+
+Analog of the reference PG transport
+(reference: torchft/checkpointing/pg_transport.py:27-300): the sender ships a
+pickled metadata frame (skeleton + per-leaf shape/dtype) followed by each
+array as a raw buffer over tagged point-to-point sends; the receiver
+reconstructs, optionally **in place** into an existing same-structure state
+dict (no reallocation — the fast path for healing into live training state).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.parallel.process_group import ProcessGroup
+
+logger = logging.getLogger(__name__)
+
+_META_TAG = 3000
+_TENSOR_TAG = 3001
+
+
+class PGTransport(CheckpointTransport[Any]):
+    """Checkpoint transport over a ProcessGroup's send/recv.
+
+    Args:
+        pg: the (replica-dimension) process group; src/dst ranks are replica
+            ranks within the current quorum.
+        timeout: per-transfer deadline.
+        state_dict_fn: optional callable returning a same-structure state
+            dict whose buffers are received into (in-place fast path).
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        timeout: float = 60.0,
+        state_dict_fn: "Optional[Callable[[], Any]]" = None,
+    ) -> None:
+        self._pg = pg
+        self._timeout = timeout
+        self._state_dict_fn = state_dict_fn
+
+    def metadata(self) -> str:
+        return "<n/a>"  # rendezvous rides the quorum PG; nothing to publish
+
+    def send_checkpoint(
+        self, dst_ranks: "List[int]", step: int, state_dict: Any, timeout: float
+    ) -> None:
+        from torchft_tpu.checkpointing.serialization import _flatten, _leaf_meta
+
+        skeleton, leaves = _flatten(state_dict)
+        metas = []
+        arrays: List[Optional[np.ndarray]] = []
+        for leaf in leaves:
+            meta, arr = _leaf_meta(leaf)
+            metas.append(meta)
+            arrays.append(arr)
+        header = np.frombuffer(
+            pickle.dumps({"step": step, "skeleton": skeleton, "leaves": metas}),
+            dtype=np.uint8,
+        )
+        for dst in dst_ranks:
+            # submit the whole stream, then reap: the PG worker executes
+            # in submission order, and keeping its queue non-empty lets it
+            # drain the socket continuously instead of idling one
+            # thread-wakeup round trip per leaf
+            works = [self._pg.send(header, dst, tag=_META_TAG)]
+            for i, arr in enumerate(arrays):
+                if arr is not None:
+                    works.append(
+                        self._pg.send(
+                            arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
+                        )
+                    )
+            for w in works:
+                w.wait(timeout=timeout)
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
+        header_bytes = self._pg.recv(src_rank, tag=_META_TAG).wait(timeout=timeout)
+        header = pickle.loads(header_bytes.tobytes())
+        if header["step"] != step:
+            raise RuntimeError(
+                f"checkpoint step mismatch: expected {step}, got {header['step']}"
+            )
+        # In-place fast path: receive into the live state dict's buffers.
+        inplace_leaves: "Optional[List[Any]]" = None
+        if self._state_dict_fn is not None:
+            try:
+                existing = self._state_dict_fn()
+                inplace_leaves = jax.tree_util.tree_flatten(existing)[0]
+                if len(inplace_leaves) != len(header["leaves"]):
+                    inplace_leaves = None
+            except Exception:  # noqa: BLE001 - fall back to fresh alloc
+                inplace_leaves = None
+
+        leaves: List[Any] = []
+        try:
+            # Submit every tensor recv up front (the PG worker runs them in
+            # order, streaming the socket without per-leaf wakeup gaps);
+            # in-place targets go straight to the wire reader as
+            # recv(out=...) (uint8 view: the wire carries flat bytes).
+            works: "List[Optional[Any]]" = []
+            for i, meta in enumerate(header["leaves"]):
+                if meta["kind"] == "object":
+                    works.append(None)
+                    continue
+                out = None
+                if inplace_leaves is not None:
+                    target = inplace_leaves[i]
+                    if (
+                        isinstance(target, np.ndarray)
+                        and target.shape == tuple(meta["shape"])
+                        and str(target.dtype) == meta["dtype"]
+                        and target.flags.c_contiguous
+                    ):
+                        out = target
+                works.append(
+                    (
+                        self._pg.recv(
+                            src_rank,
+                            tag=_TENSOR_TAG + i,
+                            out=None
+                            if out is None
+                            else out.reshape(-1).view(np.uint8),
+                        ),
+                        out,
+                    )
+                )
+
+            for meta, w in zip(header["leaves"], works):
+                if w is None:
+                    leaves.append(meta["value"])
+                    continue
+                work, out = w
+                raw = work.wait(timeout=timeout)
+                if out is not None:
+                    leaves.append(out)
+                else:
+                    # raw is a fresh private buffer; the reshaped view owns it
+                    leaves.append(
+                        raw.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+                    )
+        except Exception:
+            # Abandoning mid-stream (including a failure while still
+            # SUBMITTING — e.g. a malformed leaf meta) leaves the tag
+            # stream desynced AND queued in-place recvs that would keep
+            # writing into LIVE training buffers as bytes arrive.  Abort
+            # tears the PG down so no queued op ever executes; the Manager
+            # latches the error and reconfigures at the next quorum.
+            self._pg.abort()
+            raise
+        treedef = jax.tree_util.tree_structure(header["skeleton"])
+        return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,190 @@
+"""Streaming pytree (de)serialization for checkpoint transports.
+
+Analog of the reference's streaming state-dict serialization
+(reference: torchft/checkpointing/_serialization.py:1-33 and the
+pytree-flatten logic in http_transport.py:220-242).  A state dict (arbitrary
+pytree of jax/numpy arrays and plain Python leaves) is split into:
+
+- a picklable **skeleton** (the tree with integer leaf slots),
+- per-leaf **metadata** (shape/dtype for arrays, inline pickle otherwise),
+- the raw array buffers, streamed in order without copies.
+
+Wire layout: ``[8-byte meta length][pickled meta][buffer 0][buffer 1]...``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+_HEADER = struct.Struct(">Q")
+
+
+def _flatten(state_dict: Any) -> Tuple[Any, List[Any]]:
+    leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+    skeleton = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
+    return skeleton, leaves
+
+
+def _leaf_meta(leaf: Any) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+    if isinstance(leaf, (np.ndarray, jax.Array)) or np.isscalar(leaf) is False and hasattr(leaf, "__array__"):
+        arr = np.asarray(leaf)
+        # Record shape BEFORE ascontiguousarray: it promotes 0-d to (1,),
+        # which would corrupt pytree leaf shapes on the receiving side.
+        shape = arr.shape
+        return (
+            {"kind": "array", "shape": shape, "dtype": str(arr.dtype)},
+            np.ascontiguousarray(arr),
+        )
+    return {"kind": "object", "value": leaf}, None
+
+
+def prepare(
+    state_dict: Any, chunk_indices: "Optional[List[int]]" = None
+) -> "Tuple[int, Any]":
+    """Build a streamable serialization of ``state_dict``.
+
+    Returns ``(total_bytes, writer)`` where ``writer(out)`` streams the
+    payload without materializing it (buffers are written directly) — the
+    zero-copy path for serving multi-GB checkpoints.
+
+    ``chunk_indices`` restricts to a subset of leaf slots (for round-robin
+    chunked transport, reference http_transport.py:288-299); the skeleton is
+    still complete so any chunk can be merged by slot index.
+    """
+    skeleton, leaves = _flatten(state_dict)
+    indices = chunk_indices if chunk_indices is not None else list(range(len(leaves)))
+    metas: List[Dict[str, Any]] = []
+    buffers: List[Optional[np.ndarray]] = []
+    for i in indices:
+        meta, buf = _leaf_meta(leaves[i])
+        meta["slot"] = i
+        metas.append(meta)
+        buffers.append(buf)
+    header = pickle.dumps(
+        {"skeleton": skeleton, "num_leaves": len(leaves), "leaves": metas}
+    )
+    total = _HEADER.size + len(header) + sum(b.nbytes for b in buffers if b is not None)
+
+    def writer(out: BinaryIO) -> None:
+        out.write(_HEADER.pack(len(header)))
+        out.write(header)
+        for buf in buffers:
+            if buf is not None:
+                # uint8 view, not memoryview.cast: ml_dtypes (bfloat16, fp8 —
+                # the TPU training dtypes) have no buffer-protocol format
+                # char and would raise in cast("B").
+                out.write(buf.reshape(-1).view(np.uint8))
+
+    return total, writer
+
+
+def serialize_to(state_dict: Any, out: BinaryIO, chunk_indices: "Optional[List[int]]" = None) -> None:
+    _, writer = prepare(state_dict, chunk_indices)
+    writer(out)
+
+
+def serialize(state_dict: Any, chunk_indices: "Optional[List[int]]" = None) -> bytes:
+    bio = io.BytesIO()
+    serialize_to(state_dict, bio, chunk_indices)
+    return bio.getvalue()
+
+
+def num_leaves(state_dict: Any) -> int:
+    return len(jax.tree_util.tree_flatten(state_dict)[0])
+
+
+def _read_exact(src: BinaryIO, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = src.read(n - len(buf))
+        if not chunk:
+            raise EOFError(f"stream ended with {n - len(buf)} bytes missing")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_exact_into(src: BinaryIO, view: memoryview) -> None:
+    """Fill ``view`` from the stream — no intermediate byte assembly, so
+    multi-GB array payloads land straight in their final buffer."""
+    off, n = 0, len(view)
+    readinto = getattr(src, "readinto", None)
+    while off < n:
+        if readinto is not None:
+            got = readinto(view[off:])
+            if not got:
+                raise EOFError(f"stream ended with {n - off} bytes missing")
+            off += got
+        else:
+            chunk = src.read(n - off)
+            if not chunk:
+                raise EOFError(f"stream ended with {n - off} bytes missing")
+            view[off : off + len(chunk)] = chunk
+            off += len(chunk)
+
+
+def deserialize_from(
+    src: BinaryIO, into: "Optional[Dict[int, np.ndarray]]" = None
+) -> Tuple[Any, Dict[int, Any], int]:
+    """Read one serialized stream.
+
+    Returns ``(skeleton, {slot: leaf}, num_leaves)`` so chunked fetches can
+    be merged before reassembly via :func:`reassemble`.
+
+    ``into`` maps leaf slots to existing arrays to receive **in place**
+    (matching shape/dtype/contiguity required) — the warm-buffer fast path:
+    cold ``np.empty`` targets page-fault during the socket reads, roughly
+    halving effective recv bandwidth for multi-GB checkpoints.
+    """
+    (hlen,) = _HEADER.unpack(_read_exact(src, _HEADER.size))
+    header = pickle.loads(_read_exact(src, hlen))
+    leaves: Dict[int, Any] = {}
+    for meta in header["leaves"]:
+        if meta["kind"] == "array":
+            dtype = np.dtype(meta["dtype"])
+            out = None
+            if into is not None:
+                target = into.get(meta["slot"])
+                if (
+                    isinstance(target, np.ndarray)
+                    and target.dtype == dtype
+                    and target.shape == tuple(meta["shape"])
+                    and target.flags.c_contiguous
+                ):
+                    out = target
+            if out is None:
+                out = np.empty(meta["shape"], dtype=dtype)
+            if out.nbytes:
+                # uint8 view (not memoryview.cast): ml_dtypes leaves have no
+                # buffer-protocol format char
+                _read_exact_into(
+                    src, memoryview(out.reshape(-1).view(np.uint8))
+                )
+            leaves[meta["slot"]] = out
+        else:
+            leaves[meta["slot"]] = meta["value"]
+    return header["skeleton"], leaves, header["num_leaves"]
+
+
+def reassemble(skeleton: Any, leaves: Dict[int, Any], num_leaves: int) -> Any:
+    if len(leaves) != num_leaves:
+        missing = sorted(set(range(num_leaves)) - set(leaves))
+        raise ValueError(f"missing leaf slots {missing[:8]}... in checkpoint")
+    treedef = jax.tree_util.tree_structure(skeleton)
+    ordered = [leaves[i] for i in range(num_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, ordered)
+
+
+def deserialize(data: bytes) -> Any:
+    skeleton, leaves, n = deserialize_from(io.BytesIO(data))
+    return reassemble(skeleton, leaves, n)
+
+
+def split_chunks(num_leaves: int, num_chunks: int) -> "List[List[int]]":
+    """Round-robin leaf-slot assignment (reference http_transport.py:288-299)."""
+    return [list(range(i, num_leaves, num_chunks)) for i in range(num_chunks)]

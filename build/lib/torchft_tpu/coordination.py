@@ -1,0 +1,556 @@
+"""Coordination API: native servers + protocol clients.
+
+Public low-level surface for building custom fault-tolerance algorithms,
+analog of reference torchft/coordination.py:18-33 (which re-exports the Rust
+Lighthouse/Manager client+server classes).  Servers run native C++ threads
+(see ``native/``); clients speak the framed-JSON protocol directly from
+Python — socket waits release the GIL, mirroring the reference's
+GIL-releasing PyO3 calls (reference: src/lib.rs:153-281).
+
+Wire format: 4-byte big-endian length + UTF-8 JSON.
+Request: ``{"method": ..., "params": {...}, "timeout_ms": N}``.
+Response: ``{"ok": true, "result": {...}}`` or
+``{"ok": false, "error": msg, "code": "timeout"?}``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu import _native
+
+__all__ = [
+    "LighthouseServer",
+    "LighthouseClient",
+    "ManagerServer",
+    "ManagerClient",
+    "StoreServer",
+    "StoreClient",
+    "Quorum",
+    "QuorumMember",
+    "QuorumResult",
+]
+
+
+def _to_ms(timeout: "float | timedelta") -> int:
+    if isinstance(timeout, timedelta):
+        return int(timeout.total_seconds() * 1000)
+    return int(timeout * 1000)
+
+
+# ---------------------------------------------------------------------------
+# data types (mirror reference proto/torchft.proto:37-53 and _torchft.pyi)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QuorumMember:
+    replica_id: str
+    address: str = ""
+    store_address: str = ""
+    step: int = 0
+    world_size: int = 1
+    shrink_only: bool = False
+    commit_failures: int = 0
+    data: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "QuorumMember":
+        """Build from the wire-protocol dict (tolerates missing fields)."""
+        return QuorumMember(
+            replica_id=d.get("replica_id", ""),
+            address=d.get("address", ""),
+            store_address=d.get("store_address", ""),
+            step=d.get("step", 0),
+            world_size=d.get("world_size", 1),
+            shrink_only=d.get("shrink_only", False),
+            commit_failures=d.get("commit_failures", 0),
+            data=d.get("data", ""),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Wire-protocol dict for RPC payloads."""
+        return {
+            "replica_id": self.replica_id,
+            "address": self.address,
+            "store_address": self.store_address,
+            "step": self.step,
+            "world_size": self.world_size,
+            "shrink_only": self.shrink_only,
+            "commit_failures": self.commit_failures,
+            "data": self.data,
+        }
+
+
+@dataclass
+class Quorum:
+    quorum_id: int
+    participants: List[QuorumMember] = field(default_factory=list)
+    created_ms: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Quorum":
+        """Build from the wire-protocol dict."""
+        return Quorum(
+            quorum_id=d.get("quorum_id", 0),
+            participants=[QuorumMember.from_dict(p) for p in d.get("participants", [])],
+            created_ms=d.get("created_ms", 0),
+        )
+
+
+@dataclass
+class QuorumResult:
+    """Per-replica instructions computed from a cluster quorum.
+
+    Field parity with reference torchft/_torchft.pyi QuorumResult.
+    """
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 1
+    recover_src_manager_address: str = ""
+    recover_src_replica_rank: Optional[int] = None
+    recover_dst_replica_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_replica_rank: Optional[int] = None
+    max_world_size: int = 1
+    heal: bool = False
+    commit_failures: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "QuorumResult":
+        """Build from the wire-protocol dict."""
+        return QuorumResult(
+            quorum_id=d.get("quorum_id", 0),
+            replica_rank=d.get("replica_rank", 0),
+            replica_world_size=d.get("replica_world_size", 1),
+            recover_src_manager_address=d.get("recover_src_manager_address", ""),
+            recover_src_replica_rank=d.get("recover_src_replica_rank"),
+            recover_dst_replica_ranks=list(d.get("recover_dst_replica_ranks", [])),
+            store_address=d.get("store_address", ""),
+            max_step=d.get("max_step", 0),
+            max_replica_rank=d.get("max_replica_rank"),
+            max_world_size=d.get("max_world_size", 1),
+            heal=d.get("heal", False),
+            commit_failures=d.get("commit_failures", 0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# protocol client
+# ---------------------------------------------------------------------------
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class _RpcClient:
+    """Persistent framed-JSON connection; reconnects with backoff on failure."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _host_port(self) -> "tuple[str, int]":
+        if self._addr.startswith("["):
+            host, _, port = self._addr[1:].partition("]:")
+            return host, int(port)
+        host, _, port = self._addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    def _connect(self, deadline: float) -> socket.socket:
+        host, port = self._host_port()
+        backoff = 0.1
+        last_err: Exception = TimeoutError("connect: no attempt made")
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"timeout connecting to {self._addr}: {last_err}"
+                )
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=min(remaining, 5.0)
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as e:
+                last_err = e
+                time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+                backoff = min(backoff * 1.5, 10.0)
+
+    def call(
+        self, method: str, params: Dict[str, Any], timeout: "float | timedelta"
+    ) -> Dict[str, Any]:
+        timeout_s = (
+            timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
+        )
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            for attempt in range(2):
+                if self._sock is None:
+                    self._sock = self._connect(
+                        min(deadline, time.monotonic() + self._connect_timeout)
+                    )
+                payload = json.dumps(
+                    {
+                        "method": method,
+                        "params": params,
+                        "timeout_ms": max(int((deadline - time.monotonic()) * 1000), 1),
+                    }
+                ).encode()
+                try:
+                    self._sock.settimeout(max(deadline - time.monotonic(), 0.001))
+                    self._sock.sendall(struct.pack(">I", len(payload)) + payload)
+                    reply = self._recv_frame(deadline)
+                    break
+                except (OSError, ConnectionError) as e:
+                    self.close()
+                    if isinstance(e, socket.timeout):
+                        raise TimeoutError(
+                            f"rpc {method} to {self._addr} timed out: {e}"
+                        ) from e
+                    if attempt == 1:
+                        # Connection-level failure, not a deadline: report it
+                        # as such so callers can tell a crashed server from a
+                        # protocol wait expiring.
+                        raise ConnectionError(
+                            f"rpc {method} to {self._addr} failed: {e}"
+                        ) from e
+                    # Broken connection (e.g. server restarted): retry once.
+                    continue
+            resp = json.loads(reply)
+            if not resp.get("ok"):
+                if resp.get("code") == "timeout":
+                    raise TimeoutError(resp.get("error", "timeout"))
+                raise RpcError(resp.get("error", "rpc failed"))
+            return resp.get("result", {})
+
+    def _recv_frame(self, deadline: float) -> bytes:
+        assert self._sock is not None
+        header = self._recv_exact(4, deadline)
+        (length,) = struct.unpack(">I", header)
+        return self._recv_exact(length, deadline)
+
+    def _recv_exact(self, n: int, deadline: float) -> bytes:
+        assert self._sock is not None
+        buf = b""
+        while len(buf) < n:
+            self._sock.settimeout(max(deadline - time.monotonic(), 0.001))
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed by peer")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# servers (native C++, lifecycle via ctypes)
+# ---------------------------------------------------------------------------
+
+
+class _NativeServer:
+    def __init__(self, handle: int) -> None:
+        if handle < 0:
+            raise RuntimeError(f"server create failed: {_native.last_error()}")
+        self._handle: Optional[int] = handle
+        self._address = _native.take_string(
+            _native.get_lib().tft_server_address(handle)
+        )
+
+    def address(self) -> str:
+        """``host:port`` the server is listening on (resolves port 0)."""
+        return self._address
+
+    def shutdown(self) -> None:
+        """Stop the server and release its socket; idempotent."""
+        if self._handle is not None:
+            _native.get_lib().tft_server_shutdown(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "_NativeServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.shutdown()
+
+
+class LighthouseServer(_NativeServer):
+    """Cluster quorum authority (C++). Reference: src/lighthouse.rs.
+
+    Binds ``[::]:port`` (port 0 = ephemeral); serves framed-JSON RPC and an
+    HTML dashboard on the same port.
+    """
+
+    def __init__(
+        self,
+        bind: str = ":0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 100,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+    ) -> None:
+        host, _, port = bind.rpartition(":")
+        lib = _native.get_lib()
+        handle = lib.tft_lighthouse_create(
+            host.encode(),
+            int(port or 0),
+            min_replicas,
+            join_timeout_ms,
+            quorum_tick_ms,
+            heartbeat_timeout_ms,
+        )
+        super().__init__(handle)
+
+
+class StoreServer(_NativeServer):
+    """Rendezvous key-value store (C++). Replaces torch TCPStore usage."""
+
+    def __init__(self, bind: str = ":0") -> None:
+        host, _, port = bind.rpartition(":")
+        lib = _native.get_lib()
+        handle = lib.tft_store_create(host.encode(), int(port or 0))
+        super().__init__(handle)
+
+
+class ManagerServer(_NativeServer):
+    """Per-replica-group coordination server (C++). Reference: src/manager.rs."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        store_address: str,
+        world_size: int,
+        bind: str = ":0",
+        heartbeat_interval: "float | timedelta" = 0.1,
+        connect_timeout: "float | timedelta" = 10.0,
+        quorum_retries: int = 0,
+    ) -> None:
+        host, _, port = bind.rpartition(":")
+        lib = _native.get_lib()
+        handle = lib.tft_manager_create(
+            replica_id.encode(),
+            lighthouse_addr.encode(),
+            host.encode(),
+            int(port or 0),
+            store_address.encode(),
+            world_size,
+            _to_ms(heartbeat_interval),
+            _to_ms(connect_timeout),
+            quorum_retries,
+        )
+        super().__init__(handle)
+
+
+# ---------------------------------------------------------------------------
+# clients
+# ---------------------------------------------------------------------------
+
+
+class LighthouseClient:
+    """Client for LighthouseServer. Reference: src/lib.rs:483-591."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0) -> None:
+        ct = (
+            connect_timeout.total_seconds()
+            if isinstance(connect_timeout, timedelta)
+            else connect_timeout
+        )
+        self._client = _RpcClient(addr, ct)
+
+    def quorum(
+        self,
+        replica_id: str,
+        timeout: "float | timedelta",
+        address: str = "",
+        store_address: str = "",
+        step: int = 0,
+        world_size: int = 1,
+        shrink_only: bool = False,
+        commit_failures: int = 0,
+        data: "Dict[str, Any] | None" = None,
+    ) -> Quorum:
+        """Join the next quorum as ``replica_id`` and block until it forms.
+
+        Doubles as an implicit heartbeat (reference src/lighthouse.rs:
+        498-544); ``data`` is an opaque JSON dict carried to all members.
+
+        Id convention: the segment after the last ``:`` is the INCARNATION
+        suffix (the Manager appends ``:uuid4``). A joiner supersedes any
+        member sharing its non-empty prefix — the stale incarnation is
+        evicted immediately so a fast-restarted replica re-forms quorum
+        without waiting out heartbeat expiry. Ids without ``:`` (or with
+        an empty prefix) never supersede anything.
+        """
+        member = QuorumMember(
+            replica_id=replica_id,
+            address=address,
+            store_address=store_address,
+            step=step,
+            world_size=world_size,
+            shrink_only=shrink_only,
+            commit_failures=commit_failures,
+            data=json.dumps(data) if data else "",
+        )
+        result = self._client.call("quorum", {"member": member.to_dict()}, timeout)
+        return Quorum.from_dict(result["quorum"])
+
+    def heartbeat(self, replica_id: str, timeout: "float | timedelta" = 5.0) -> None:
+        """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms."""
+        self._client.call("heartbeat", {"replica_id": replica_id}, timeout)
+
+    def status(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
+        """Quorum/participant/heartbeat snapshot (the dashboard's data)."""
+        return self._client.call("status", {}, timeout)
+
+    def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
+        self._client.close()
+
+
+class ManagerClient:
+    """Client for ManagerServer. Reference: src/lib.rs:153-281."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0) -> None:
+        ct = (
+            connect_timeout.total_seconds()
+            if isinstance(connect_timeout, timedelta)
+            else connect_timeout
+        )
+        self._addr = addr
+        self._client = _RpcClient(addr, ct)
+
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: "float | timedelta",
+        init_sync: bool = True,
+        commit_failures: int = 0,
+    ) -> QuorumResult:
+        result = self._client.call(
+            "quorum",
+            {
+                "group_rank": group_rank,
+                "step": step,
+                "checkpoint_metadata": checkpoint_metadata,
+                "shrink_only": shrink_only,
+                "init_sync": init_sync,
+                "commit_failures": commit_failures,
+            },
+            timeout,
+        )
+        return QuorumResult.from_dict(result)
+
+    def _checkpoint_metadata(self, rank: int, timeout: "float | timedelta") -> str:
+        result = self._client.call("checkpoint_metadata", {"rank": rank}, timeout)
+        return result["checkpoint_metadata"]
+
+    def should_commit(
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: "float | timedelta",
+    ) -> bool:
+        """Vote on committing ``step``; blocks until all group ranks vote and
+        returns the AND across them (reference src/manager.rs:423-479)."""
+        result = self._client.call(
+            "should_commit",
+            {"group_rank": group_rank, "step": step, "should_commit": should_commit},
+            timeout,
+        )
+        return result["should_commit"]
+
+    def kill(self, msg: str = "", timeout: "float | timedelta" = 5.0) -> None:
+        """Ask the remote replica's manager to exit its process."""
+        try:
+            self._client.call("kill", {"msg": msg}, timeout)
+        except (TimeoutError, ConnectionError, RpcError):
+            pass  # the remote process exits mid-RPC by design
+
+    def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
+        self._client.close()
+
+
+class StoreClient:
+    """Client for StoreServer: set/get(wait)/delete_prefix."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0) -> None:
+        ct = (
+            connect_timeout.total_seconds()
+            if isinstance(connect_timeout, timedelta)
+            else connect_timeout
+        )
+        self._client = _RpcClient(addr, ct)
+
+    def set(self, key: str, value: str, timeout: "float | timedelta" = 10.0) -> None:
+        """Publish ``key`` (wakes any blocked ``get(wait=True)``)."""
+        self._client.call("set", {"key": key, "value": value}, timeout)
+
+    def get(
+        self, key: str, timeout: "float | timedelta" = 10.0, wait: bool = True
+    ) -> str:
+        """Read ``key``; with ``wait`` blocks until it is set or timeout."""
+        result = self._client.call("get", {"key": key, "wait": wait}, timeout)
+        return result["value"]
+
+    def delete_prefix(self, prefix: str, timeout: "float | timedelta" = 10.0) -> int:
+        """Remove all keys under ``prefix``; returns the count removed."""
+        result = self._client.call("delete_prefix", {"prefix": prefix}, timeout)
+        return result["removed"]
+
+    def num_keys(self, timeout: "float | timedelta" = 10.0) -> int:
+        """Total keys currently stored (tests/diagnostics)."""
+        return self._client.call("num_keys", {}, timeout)["count"]
+
+    def close(self) -> None:
+        """Close the underlying connection; the client is unusable after."""
+        self._client.close()
+
+
+def compute_quorum_results(
+    replica_id: str, group_rank: int, quorum: Quorum, init_sync: bool = True
+) -> QuorumResult:
+    """Pure quorum-result math (native). Reference: src/manager.rs:489-624."""
+    lib = _native.get_lib()
+    quorum_json = json.dumps(
+        {
+            "quorum_id": quorum.quorum_id,
+            "participants": [p.to_dict() for p in quorum.participants],
+            "created_ms": quorum.created_ms,
+        }
+    )
+    ptr = lib.tft_compute_quorum_results(
+        replica_id.encode(), group_rank, quorum_json.encode(), 1 if init_sync else 0
+    )
+    return QuorumResult.from_dict(json.loads(_native.take_string(ptr)))

@@ -1,0 +1,52 @@
+"""Standalone Lighthouse CLI (reference: src/bin/lighthouse.rs:11-24 and the
+``lighthouse_main`` entry in src/lib.rs:329-344).
+
+Run one per job; point every replica group's Manager at it:
+
+    python -m torchft_tpu.lighthouse --bind :29510 --min-replicas 2
+
+Serves the quorum RPC protocol and the HTML dashboard (with per-replica
+kill buttons and ``/status.json``) on the same port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from torchft_tpu.coordination import LighthouseServer
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--bind", default=":29510", help="host:port (port 0 = ephemeral)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--join-timeout-ms", type=int, default=60000,
+                   help="straggler wait before forming a smaller quorum "
+                        "(reference CLI default 60s)")
+    p.add_argument("--quorum-tick-ms", type=int, default=100)
+    p.add_argument("--heartbeat-timeout-ms", type=int, default=5000)
+    args = p.parse_args(argv)
+
+    server = LighthouseServer(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    print(f"lighthouse serving at {server.address()} "
+          f"(dashboard: http://{server.address()}/)", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,372 @@
+"""LocalSGD and (Streaming) DiLoCo: communication-reducing semi-sync DP.
+
+TPU-native rebuild of the reference algorithms
+(reference: torchft/local_sgd.py:46-797).  Functional JAX adaptation: model
+parameters are a flat ``{name: array}`` pytree owned by the trainer and
+accessed through get/set callables; fragments are key subsets; backup
+("global") parameters live on host (numpy) — the CPU-backup analog of
+reference :237-254; outer optimizers are optax transforms.
+
+Semantics parity:
+- LocalSGD (:46-173): every ``sync_every`` inner steps, average parameters
+  across the quorum and commit.
+- DiLoCo / Streaming DiLoCo (:176-797): the model is split into fragments,
+  each with its own outer optimizer and host backup.  Per fragment cycle of
+  ``sync_every // n_fragments`` inner steps: at ``cycle - fragment_sync_delay``
+  start quorum + kick off an async allreduce of the fragment's pseudogradients
+  (backup - local, optionally quantized); at ``cycle`` wait, restore backup
+  params, vote commit, and on success outer-step + merge local/global by
+  ``fragment_update_alpha``.  Fragment order is driven by
+  ``manager.current_step() % n_fragments`` so all replicas reduce the same
+  fragment — avoiding the cross-replica deadlock described in reference
+  :746-792.  Requires a synchronous quorum (reference :618-643).
+"""
+
+from __future__ import annotations
+
+import logging
+from types import TracebackType
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import jax
+import numpy as np
+import optax
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.work import Work
+
+logger = logging.getLogger(__name__)
+
+Params = Dict[str, Any]
+GetParams = Callable[[], Params]
+SetParams = Callable[[Params], None]
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class LocalSGD:
+    """Synchronize by averaging parameters every ``sync_every`` steps.
+
+    Usage::
+
+        with LocalSGD(manager, get_params, set_params, sync_every=32) as lsgd:
+            for batch in data:
+                params = inner_step(params, batch)   # local-only update
+                set_params(params)
+                lsgd.step()                          # counts; syncs on schedule
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        get_params: GetParams,
+        set_params: SetParams,
+        sync_every: int,
+    ) -> None:
+        if sync_every < 1:
+            raise ValueError("sync_every must be >= 1")
+        self._manager = manager
+        self._get_params = get_params
+        self._set_params = set_params
+        self._sync_every = sync_every
+        self._local_step = 0
+        manager.register_state_dict_fn(
+            "LocalSGD", self._load_state_dict, lambda: _to_host(self._get_params())
+        )
+
+    def _load_state_dict(self, state_dict: Params) -> None:
+        self._set_params(state_dict)
+
+    def __enter__(self) -> "LocalSGD":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: "Optional[Type[BaseException]]",
+        exc_value: "Optional[BaseException]",
+        traceback: "Optional[TracebackType]",
+    ) -> bool:
+        return False
+
+    def step(self) -> None:
+        """Count one inner optimizer step; sync when the schedule fires."""
+        self._local_step += 1
+        if self._local_step >= self._sync_every:
+            self.sync()
+
+    def sync(self) -> None:
+        """Average parameters across the quorum (reference :112-173)."""
+        self._local_step = 0
+        self._manager.start_quorum()
+        params = self._get_params()
+        avg = self._manager.allreduce(params).wait(timeout=self._manager._timeout)
+        if self._manager.should_commit():
+            # Guard the mutation: an async quorum thread may be snapshotting
+            # the state dict for a healing peer (reference :112-124).
+            self._manager.disallow_state_dict_read()
+            try:
+                self._set_params(avg)
+            finally:
+                self._manager.allow_state_dict_read()
+
+
+class _Fragment:
+    """One DiLoCo fragment: key subset + host backup + outer optimizer.
+
+    Reference: _StreamingDiLoCoFragment (local_sgd.py:176-567).
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        fragment_id: int,
+        keys: "List[str]",
+        get_params: GetParams,
+        set_params: SetParams,
+        outer_optimizer: optax.GradientTransformation,
+        should_quantize: bool,
+        fragment_update_alpha: float,
+    ) -> None:
+        self._manager = manager
+        self._fragment_id = fragment_id
+        self._keys = keys
+        self._get_params = get_params
+        self._set_params = set_params
+        self._outer = outer_optimizer
+        self._should_quantize = should_quantize
+        self._alpha = fragment_update_alpha
+
+        # host ("global") backup of this fragment's params
+        self.original_parameters: Params = {}
+        self._outer_state: Any = None
+        self._allreduce_work: "List[Work]" = []
+        self._local_parameters: "Optional[Params]" = None
+        self.save_parameters()
+        self._outer_state = self._outer.init(self.original_parameters)
+        self.register_state_dict_fn()
+
+    def _fragment_params(self) -> Params:
+        params = self._get_params()
+        return {k: params[k] for k in self._keys}
+
+    def _write_fragment(self, frag: Params) -> None:
+        params = dict(self._get_params())
+        params.update(frag)
+        self._set_params(params)
+
+    def save_parameters(self) -> None:
+        self.original_parameters = _to_host(self._fragment_params())
+
+    def restore_parameters(self) -> None:
+        self._write_fragment(
+            jax.tree_util.tree_map(np.array, self.original_parameters)
+        )
+
+    def register_state_dict_fn(self) -> None:
+        # per-fragment healing slice (reference :256-287)
+        key = f"StreamingDiLoCoFragment_{self._fragment_id}"
+
+        def load_fn(sd: "Dict[str, Any]") -> None:
+            self.original_parameters = jax.tree_util.tree_map(
+                np.array, sd["original_parameters"]
+            )
+            self._outer_state = sd["outer_optimizer"]
+
+        def save_fn() -> "Dict[str, Any]":
+            return {
+                "original_parameters": jax.tree_util.tree_map(
+                    np.array, self.original_parameters
+                ),
+                "outer_optimizer": self._outer_state,
+            }
+
+        self._manager.register_state_dict_fn(key, load_fn, save_fn)
+
+    def prepare_sync(self) -> None:
+        """Pseudograds = backup - local; kick off the async allreduce
+        (reference :402-421)."""
+        local = _to_host(self._fragment_params())
+        pseudograds = jax.tree_util.tree_map(
+            lambda g, l: g.astype(np.float32) - l.astype(np.float32),
+            self.original_parameters,
+            local,
+        )
+        assert not self._allreduce_work
+        self._allreduce_work.append(
+            self._manager.allreduce(pseudograds, should_quantize=self._should_quantize)
+        )
+
+    def discard_pending_work(self) -> None:
+        """Drop any queued allreduce work (error-path cleanup so the next
+        prepare_sync's not-already-pending assert holds)."""
+        self._allreduce_work.clear()
+        self._local_parameters = None
+
+    def perform_sync(self) -> bool:
+        """Wait for the allreduce, vote, and outer-step on success
+        (reference :423-476)."""
+        assert self._allreduce_work, "perform_sync before prepare_sync"
+        work = self._allreduce_work.pop()
+        avg_pseudograds = work.wait(timeout=self._manager._timeout)
+
+        # save local then roll back to the global backup: a failed commit
+        # must leave us on consistent (pre-divergence) state
+        self._local_parameters = _to_host(self._fragment_params())
+        self.restore_parameters()
+
+        should_commit = self._manager.should_commit()
+        if should_commit:
+            # outer update on the backup params; optax's sgd(+momentum,
+            # nesterov) is the reference's default outer optimizer
+            tm = jax.tree_util.tree_map
+            grads = tm(lambda v: np.asarray(v, dtype=np.float32), avg_pseudograds)
+            updates, self._outer_state = self._outer.update(
+                grads, self._outer_state, self.original_parameters
+            )
+            new_global = optax.apply_updates(
+                tm(lambda v: v.astype(np.float32), self.original_parameters),
+                updates,
+            )
+            new_global = tm(
+                lambda v, o: np.asarray(v, dtype=o.dtype),
+                new_global,
+                self.original_parameters,
+            )
+            self.original_parameters = new_global
+            # merge: params = (1-alpha) * global + alpha * local
+            merged = tm(
+                lambda g, l: np.asarray(
+                    (1.0 - self._alpha) * g.astype(np.float32)
+                    + self._alpha * l.astype(np.float32),
+                    dtype=g.dtype,
+                ),
+                new_global,
+                self._local_parameters,
+            )
+            self._write_fragment(merged)
+        self._local_parameters = None
+        return should_commit
+
+
+class DiLoCo:
+    """(Streaming) DiLoCo over fragment key subsets.
+
+    Args:
+        manager: must use a synchronous quorum (use_async_quorum=False).
+        fragments: list of key lists partitioning the flat param dict; one
+            entry behaves as classic DiLoCo, several as Streaming DiLoCo.
+        outer_optimizer: optax transform (or list, one per fragment);
+            the paper (and reference) default is SGD + nesterov momentum.
+        sync_every: inner steps per full round; must be divisible by the
+            fragment count.
+        fragment_sync_delay: inner steps between kicking off a fragment's
+            allreduce and blocking on it ("tau" in Streaming DiLoCo).
+        fragment_update_alpha: local/global mixing factor.
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        fragments: "List[List[str]]",
+        get_params: GetParams,
+        set_params: SetParams,
+        outer_optimizer: "optax.GradientTransformation | List[optax.GradientTransformation]",
+        sync_every: int,
+        should_quantize: bool = False,
+        fragment_sync_delay: int = 0,
+        fragment_update_alpha: float = 0.0,
+    ) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False"
+            )
+        if not fragments or not all(fragments):
+            raise ValueError("fragments must be non-empty key lists")
+        if sync_every < len(fragments):
+            raise ValueError("only 1 fragment can be synchronized at a time")
+        if sync_every % len(fragments) != 0:
+            raise ValueError("sync_every must be divisible by the number of fragments")
+        self._cycle = sync_every // len(fragments)
+        if fragment_sync_delay >= self._cycle:
+            raise ValueError("fragment must be synced before it is reduced again")
+        if not (0.0 <= fragment_update_alpha <= 1.0):
+            raise ValueError("fragment_update_alpha must be within [0, 1]")
+
+        if isinstance(outer_optimizer, list):
+            if len(outer_optimizer) != len(fragments):
+                raise ValueError("need one outer optimizer per fragment")
+            outers = outer_optimizer
+        else:
+            outers = [outer_optimizer] * len(fragments)
+
+        self._manager = manager
+        self._local_step = 0
+        self._fragment_sync_delay = fragment_sync_delay
+        self._fragments = [
+            _Fragment(
+                manager,
+                i,
+                keys,
+                get_params,
+                set_params,
+                outers[i],
+                should_quantize,
+                fragment_update_alpha,
+            )
+            for i, keys in enumerate(fragments)
+        ]
+
+    def __enter__(self) -> "DiLoCo":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: "Optional[Type[BaseException]]",
+        exc_value: "Optional[BaseException]",
+        traceback: "Optional[TracebackType]",
+    ) -> bool:
+        return False
+
+    def _current_fragment(self) -> int:
+        # driven by the committed step so every replica reduces the same
+        # fragment (reference :735-741)
+        return self._manager.current_step() % len(self._fragments)
+
+    def step(self) -> None:
+        """Call after each inner optimizer step (the post-hook analog,
+        reference :746-792)."""
+        self._local_step += 1
+
+        if self._local_step == self._cycle - self._fragment_sync_delay:
+            self._manager.start_quorum()
+            fragment = self._current_fragment()
+            logger.info("preparing fragment=%d step=%d", fragment, self._local_step)
+            self._fragments[fragment].prepare_sync()
+
+        if self._local_step < self._cycle:
+            return
+        if self._local_step == self._cycle:
+            fragment = self._current_fragment()
+            logger.info(
+                "syncing fragment=%d step=%d manager_step=%d",
+                fragment,
+                self._local_step,
+                self._manager.current_step(),
+            )
+            # Reset before the fallible sync (like LocalSGD.sync): if
+            # perform_sync raises (e.g. allreduce wait timeout), a caller
+            # that catches per-step errors and keeps stepping must start a
+            # fresh cycle, not hit the exceeded-cycle assert below forever.
+            self._local_step = 0
+            try:
+                self._fragments[fragment].perform_sync()
+            except Exception:
+                self._fragments[fragment].discard_pending_work()
+                raise
+            return
+        raise AssertionError(
+            f"local_step {self._local_step} exceeded cycle {self._cycle}"
+        )

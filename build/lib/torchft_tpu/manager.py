@@ -1,0 +1,734 @@
+"""Manager: the per-worker fault-tolerance state machine.
+
+TPU-native rebuild of the reference Manager (reference: torchft/manager.py).
+Orchestrates the per-step protocol: quorum (async, overlapped with forward),
+process-group reconfiguration on quorum change, live healing (send/recv of
+the composite state dict), error capture, and the commit vote.
+
+JAX-first adaptations:
+- state dicts are pytrees (params/opt-state/step), not torch module dicts;
+- no CUDA streams: JAX dispatch is async on its own, and the DCN collective
+  layer runs host-side with Work handles; ``should_commit`` blocks on any
+  outstanding recovery future instead of stream events;
+- the allreduce hot path zero-fills non-participants and divides by the live
+  participant count (reference manager.py:416-417,447-454) so membership
+  changes never change compiled shapes — no re-jit on fail/join.
+
+Env knobs (parity with reference manager.py:76-89):
+``TORCHFT_LIGHTHOUSE``, ``TORCHFT_MANAGER_PORT``, ``TORCHFT_TIMEOUT_SEC``,
+``TORCHFT_QUORUM_TIMEOUT_SEC``, ``TORCHFT_CONNECT_TIMEOUT_SEC``,
+``TORCHFT_QUORUM_RETRIES``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+
+import jax
+import numpy as np
+
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, StoreServer
+from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_SUM
+from torchft_tpu.parallel.work import Work, completed_work
+from torchft_tpu.utils.logging import ReplicaLogger, log_event
+from torchft_tpu.utils.rwlock import RWLock
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+MANAGER_ADDR_KEY = "manager_addr"
+REPLICA_ID_KEY = "replica_id"
+
+TIMEOUT_SEC = float(os.environ.get("TORCHFT_TIMEOUT_SEC", 60.0))
+QUORUM_TIMEOUT_SEC = float(os.environ.get("TORCHFT_QUORUM_TIMEOUT_SEC", 60.0))
+CONNECT_TIMEOUT_SEC = float(os.environ.get("TORCHFT_CONNECT_TIMEOUT_SEC", 10.0))
+QUORUM_RETRIES = int(os.environ.get("TORCHFT_QUORUM_RETRIES", 0))
+
+
+def _to_sec(t: "float | timedelta | None", default: float) -> float:
+    if t is None:
+        return default
+    if isinstance(t, timedelta):
+        return t.total_seconds()
+    return float(t)
+
+
+def _is_floating(dtype: Any) -> bool:
+    """True for float dtypes incl. ml_dtypes (bfloat16/fp8 — the TPU training
+    dtypes), which np.issubdtype does not classify as np.floating."""
+    return jax.numpy.issubdtype(dtype, jax.numpy.floating)
+
+
+class WorldSizeMode(Enum):
+    """How the quorum world size behaves (reference manager.py:112-127).
+
+    DYNAMIC: the world grows/shrinks with membership; gradients are averaged
+    over the live participant count.
+    FIXED_WITH_SPARES: the world is capped at min_replica_size; extra healthy
+    replicas are warm spares that compute but do not contribute.
+    """
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class Manager:
+    """Fault-tolerance manager for one worker of one replica group.
+
+    Args:
+        pg: the replica-dimension process group (reconfigured per quorum).
+        min_replica_size: minimum replicas for a commit to count.
+        load_state_dict / state_dict: callables for the user training state
+            (pytree); more can be registered via register_state_dict_fn.
+        use_async_quorum: overlap quorum with the forward pass.
+        checkpoint_transport: transport for live healing (HTTPTransport by
+            default).
+        store_addr: address of this replica group's rendezvous store; if
+            None and group_rank == 0, an in-process StoreServer is started.
+        replica_id: stable id of this replica group; a ``:uuid`` suffix is
+            appended for fast-restart disambiguation (reference :300-306).
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        min_replica_size: int,
+        load_state_dict: "Optional[Callable[[Any], None]]" = None,
+        state_dict: "Optional[Callable[[], Any]]" = None,
+        use_async_quorum: bool = True,
+        timeout: "float | timedelta" = TIMEOUT_SEC,
+        quorum_timeout: "float | timedelta" = QUORUM_TIMEOUT_SEC,
+        connect_timeout: "float | timedelta" = CONNECT_TIMEOUT_SEC,
+        group_rank: "Optional[int]" = None,
+        group_world_size: "Optional[int]" = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        store_addr: "Optional[str]" = None,
+        lighthouse_addr: "Optional[str]" = None,
+        replica_id: "Optional[str]" = None,
+        port: "Optional[int]" = None,
+        checkpoint_transport: "Optional[CheckpointTransport[Any]]" = None,
+        init_sync: bool = True,
+        max_retries: "Optional[int]" = None,
+        quorum_retries: int = QUORUM_RETRIES,
+        heartbeat_interval: float = 0.1,
+    ) -> None:
+        self._pg = pg
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout = _to_sec(timeout, TIMEOUT_SEC)
+        self._quorum_timeout = _to_sec(quorum_timeout, QUORUM_TIMEOUT_SEC)
+        self._connect_timeout = _to_sec(connect_timeout, CONNECT_TIMEOUT_SEC)
+        self._replica_world_size_mode = world_size_mode
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+
+        self._group_rank = (
+            group_rank if group_rank is not None else int(os.environ.get("RANK", 0))
+        )
+        self._group_world_size = (
+            group_world_size
+            if group_world_size is not None
+            else int(os.environ.get("WORLD_SIZE", 1))
+        )
+
+        self._load_state_dict_fns: Dict[str, Callable[[Any], None]] = {}
+        self._user_state_dicts: Dict[str, Callable[[], Any]] = {}
+        if load_state_dict is not None and state_dict is not None:
+            self.register_state_dict_fn("default", load_state_dict, state_dict)
+
+        if checkpoint_transport is None:
+            from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+            checkpoint_transport = HTTPTransport(timeout=self._timeout)
+        self._checkpoint_transport: CheckpointTransport[Any] = checkpoint_transport
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="torchft_quorum"
+        )
+        self._quorum_future: "Optional[concurrent.futures.Future[None]]" = None
+
+        self._state_dict_lock = RWLock(timeout=self._timeout)
+        self._pending_state_dict: "Optional[Dict[str, Any]]" = None
+        self._errored: "Optional[Exception]" = None
+        self._healing = False
+        self._recovery_future: "Optional[concurrent.futures.Future[None]]" = None
+        self._participating_replica_rank: "Optional[int]" = None
+        self._participating_replica_world_size: int = 0
+
+        self._step = 0
+        self._batches_committed = 0
+        self._commit_failures = 0
+        self._quorum_id = -1
+
+        # Wall-clock spent in each protocol phase since the last
+        # ``pop_phase_times`` — the FT-overhead observability surface
+        # (the reference only exposes these as profiler spans,
+        # torchft/manager.py:385,591,790).
+        self._phase_acc: Dict[str, float] = {}
+        self._phase_lock = threading.Lock()
+
+        # --- coordination wiring (reference manager.py:277-325) -----------
+        lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
+        if lighthouse_addr is None:
+            raise ValueError(
+                "lighthouse_addr (or TORCHFT_LIGHTHOUSE) is required"
+            )
+
+        self._owned_store: "Optional[StoreServer]" = None
+        if store_addr is None:
+            if self._group_world_size != 1:
+                raise ValueError(
+                    "store_addr is required when group_world_size > 1"
+                )
+            self._owned_store = StoreServer()
+            store_addr = self._owned_store.address()
+        self._store_addr = store_addr
+        store = StoreClient(store_addr, connect_timeout=self._connect_timeout)
+
+        self._manager_server: "Optional[ManagerServer]" = None
+        if self._group_rank == 0:
+            if replica_id is None:
+                replica_id = ""
+            # uuid suffix: a fast-restarted replica must not be confused with
+            # its dead predecessor in lighthouse state.
+            new_replica_id = replica_id + ":" + str(uuid.uuid4())
+            bind_port = port or int(os.environ.get("TORCHFT_MANAGER_PORT", 0))
+            self._manager_server = ManagerServer(
+                replica_id=new_replica_id,
+                lighthouse_addr=lighthouse_addr,
+                store_address=store_addr,
+                world_size=self._group_world_size,
+                bind=f":{bind_port}",
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=self._connect_timeout,
+                quorum_retries=quorum_retries,
+            )
+            store.set(MANAGER_ADDR_KEY, self._manager_server.address())
+            store.set(REPLICA_ID_KEY, new_replica_id)
+
+        addr = store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout)
+        self._replica_id = store.get(REPLICA_ID_KEY, timeout=self._connect_timeout)
+        self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
+        store.close()
+
+        self._logger = ReplicaLogger(self, self._replica_id, self._group_rank)
+
+    # ------------------------------------------------------------------
+    # state dict registry
+    # ------------------------------------------------------------------
+
+    def register_state_dict_fn(
+        self,
+        key: str,
+        load_state_dict_fn: "Callable[[Any], None]",
+        state_dict_fn: "Callable[[], Any]",
+    ) -> None:
+        """Register a named slice of user state for healing
+        (reference manager.py:355-366)."""
+        self._load_state_dict_fns[key] = load_state_dict_fn
+        self._user_state_dicts[key] = state_dict_fn
+
+    def _manager_state_dict(self) -> "Dict[str, Any]":
+        with self._state_dict_lock.r_lock():
+            assert self._user_state_dicts, "user state_dict is not initialized"
+            return {
+                "user": {k: fn() for k, fn in self._user_state_dicts.items()},
+                "torchft": self.state_dict(),
+            }
+
+    def state_dict(self) -> "Dict[str, int]":
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def load_state_dict(self, state_dict: "Dict[str, int]") -> None:
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    # Hooks for callers that mutate user state outside the step protocol
+    # (reference local_sgd.py:112-124 toggles these around optimizer
+    # mutation): disallow takes the state-dict write lock so a concurrent
+    # checkpoint send cannot snapshot mid-mutation.
+    def disallow_state_dict_read(self) -> None:
+        self._state_dict_lock.acquire_write()
+
+    def allow_state_dict_read(self) -> None:
+        self._state_dict_lock.release_write()
+
+    # ------------------------------------------------------------------
+    # quorum
+    # ------------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: "float | timedelta | None" = None,
+    ) -> None:
+        """Begin a new step: compute quorum (possibly async) and ready the PG.
+
+        Reference: torchft/manager.py:534-589.
+        """
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=_to_sec(timeout, self._quorum_timeout),
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # eagerly apply the healed state so the forward pass runs on
+                # recovered weights
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        assert (
+            self._quorum_future is not None
+        ), "must call start_quorum before wait_quorum"
+        t0 = time.perf_counter()
+        self._quorum_future.result()
+        self._record_phase("quorum_wait", time.perf_counter() - t0)
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        try:
+            t_rpc = time.perf_counter()
+            with jax.profiler.TraceAnnotation("torchft::manager::_client::_quorum"):
+                quorum = self._client._quorum(
+                    group_rank=self._group_rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    timeout=quorum_timeout,
+                    init_sync=self._init_sync,
+                    commit_failures=self._commit_failures,
+                )
+            self._record_phase("quorum_rpc", time.perf_counter() - t_rpc)
+        except Exception as e:  # noqa: BLE001 - captured into the protocol
+            # Graceful capture (the reference leaves this as a TODO,
+            # manager.py:566-567): the replica sits out this step and votes
+            # False rather than crashing the training loop.
+            self._logger.exception(f"got exception in quorum: {e}")
+            self._participating_replica_rank = None
+            self._participating_replica_world_size = 0
+            self.report_error(e if isinstance(e, Exception) else RuntimeError(str(e)))
+            return
+
+        # Async quorum participates with the max-step cohort (healing
+        # replicas contribute zeros this step); sync quorum heals eagerly so
+        # everyone participates (reference manager.py:641-657).
+        self._participating_replica_rank, self._participating_replica_world_size = (
+            (quorum.max_replica_rank, quorum.max_world_size)
+            if self._use_async_quorum or not allow_heal
+            else (quorum.replica_rank, quorum.replica_world_size)
+        )
+
+        if self._replica_world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            self._participating_replica_world_size = min(
+                self._participating_replica_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_replica_rank is not None
+                and self._participating_replica_rank >= self._min_replica_size
+            ):
+                self._participating_replica_rank = None
+
+        if quorum.quorum_id != self._quorum_id:
+            log_event(
+                "quorum",
+                "quorum changed",
+                job_id=os.environ.get("JOB_ID", "unknown"),
+                replica_id=self._replica_id,
+                rank=self._group_rank,
+                quorum_id=quorum.quorum_id,
+                step=quorum.max_step,
+            )
+            store_prefixed_addr = (
+                f"{quorum.store_address}/torchft/{quorum.quorum_id}/{self._group_rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum.quorum_id} store={store_prefixed_addr}"
+            )
+            try:
+                t_cfg = time.perf_counter()
+                with jax.profiler.TraceAnnotation("torchft::manager::_pg::configure"):
+                    self._pg.configure(
+                        store_prefixed_addr,
+                        self._replica_id,
+                        quorum.replica_rank,
+                        quorum.replica_world_size,
+                    )
+                self._record_phase("pg_configure", time.perf_counter() - t_cfg)
+                self._quorum_id = quorum.quorum_id
+            except Exception as e:  # noqa: BLE001 - captured into the protocol
+                self._logger.exception(f"got exception in pg configure: {e}")
+                self.report_error(e)
+                return
+
+        if not allow_heal:
+            return
+
+        try:
+            if quorum.recover_dst_replica_ranks:
+                self._logger.info(
+                    f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
+                )
+                t_send = time.perf_counter()
+                with jax.profiler.TraceAnnotation(
+                    "torchft::manager::_checkpoint_transport::send_checkpoint"
+                ):
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=quorum.recover_dst_replica_ranks,
+                        step=quorum.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+                self._record_phase("heal_send", time.perf_counter() - t_send)
+
+            if quorum.heal:
+                self._healing = True
+                t_recv = time.perf_counter()
+                self._logger.info(
+                    f"healing required, fetching checkpoint metadata from "
+                    f"{quorum.recover_src_manager_address} max_step={quorum.max_step}"
+                )
+                primary_client = ManagerClient(
+                    quorum.recover_src_manager_address,
+                    connect_timeout=self._connect_timeout,
+                )
+                checkpoint_metadata = primary_client._checkpoint_metadata(
+                    self._group_rank, timeout=self._timeout
+                )
+                primary_client.close()
+                assert (
+                    quorum.recover_src_replica_rank is not None
+                ), "must have a recover rank when healing"
+                with jax.profiler.TraceAnnotation(
+                    "torchft::manager::_checkpoint_transport::recv_checkpoint"
+                ):
+                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=quorum.recover_src_replica_rank,
+                        metadata=checkpoint_metadata,
+                        step=quorum.max_step,
+                        timeout=self._timeout,
+                    )
+                self.load_state_dict(self._pending_state_dict["torchft"])
+                # loading the torchft dict restores the step; set it anyway
+                # to make reasoning (and tests) simpler
+                self._step = quorum.max_step
+                self._record_phase("heal_recv", time.perf_counter() - t_recv)
+        except Exception as e:  # noqa: BLE001 - captured into the protocol
+            self._logger.exception(f"got exception in recovery: {e}")
+            self.report_error(e)
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        assert self._quorum_future is not None, "must call start_quorum first"
+        self._quorum_future.result()
+
+        pending = self._pending_state_dict
+        if pending is None:
+            assert self.errored() is not None, (
+                "checkpoint was not staged and no error occurred"
+            )
+            return
+        self._logger.info("applying pending state dict")
+        assert self._load_state_dict_fns, "user load_state_dict is not initialized"
+        user_state = cast(Dict[str, Any], pending["user"])
+        for key, load_fn in self._load_state_dict_fns.items():
+            load_fn(user_state[key])
+        self._pending_state_dict = None
+
+    # ------------------------------------------------------------------
+    # collectives
+    # ------------------------------------------------------------------
+
+    def allreduce(
+        self, value: Any, should_quantize: bool = False, reduce_op: str = REDUCE_AVG
+    ) -> Work:
+        """Fault-tolerant allreduce of an array or pytree of arrays.
+
+        Averages over the live participant count; non-participants (healing
+        replicas) contribute zeros.  On error the Work completes *cleanly*
+        with the input (zeroed) value and the error is tracked for
+        ``should_commit`` (reference manager.py:385-467).
+        """
+        if self.errored():
+            return completed_work(value)
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+
+        t_host = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        if should_quantize and self.is_participating():
+            # Leave device arrays on device: the quantized collective runs
+            # the Pallas quantize kernel on-chip (when on TPU) so only the
+            # int8 payload + row scales cross the device→host boundary
+            # (reference wires its Triton kernels the same way,
+            # torchft/collectives.py:297-415).  The device→host hop is then
+            # inside the collective and counted in the ``ring`` phase.
+            # Non-array leaves (Python scalars) still need numpy wrapping
+            # for the dtype checks below.
+            send_leaves: "List[Any]" = [
+                x if isinstance(x, (np.ndarray, jax.Array)) else np.asarray(x)
+                for x in leaves
+            ]
+        elif not self.is_participating():
+            send_leaves = [np.zeros_like(np.asarray(x)) for x in leaves]
+        else:
+            # Leaves pass through unmaterialized: the PG converts on its
+            # worker thread, so the device→host sync overlaps whatever the
+            # caller does next instead of blocking this thread (counted in
+            # the ``ring`` phase; the DiLoCo fragment-overlap pattern
+            # depends on this submit being non-blocking).  Non-array leaves
+            # (Python scalars) still need numpy wrapping for the dtype
+            # checks below.
+            send_leaves = [
+                x if isinstance(x, (np.ndarray, jax.Array)) else np.asarray(x)
+                for x in leaves
+            ]
+        self._record_phase("host_sync", time.perf_counter() - t_host)
+
+        if reduce_op == REDUCE_AVG:
+            if not all(_is_floating(x.dtype) for x in send_leaves):
+                raise ValueError(
+                    "average reduce op is only supported for floating point arrays"
+                )
+            pg_reduce_op = REDUCE_SUM
+        else:
+            pg_reduce_op = reduce_op
+
+        try:
+            t_submit = time.perf_counter()
+            if should_quantize:
+                from torchft_tpu.ops.collectives import allreduce_quantized
+
+                work = allreduce_quantized(send_leaves, pg_reduce_op, self._pg)
+            else:
+                work = self._pg.allreduce(send_leaves, pg_reduce_op)
+
+            def _postprocess(reduced: "List[np.ndarray]") -> Any:
+                if reduce_op == REDUCE_AVG:
+                    reduced = [x / num_participants for x in reduced]
+                return jax.tree_util.tree_unflatten(treedef, reduced)
+
+            chained = work.then(_postprocess)
+
+            # Track errors out-of-band: the returned Work must complete
+            # cleanly so the training loop proceeds to should_commit.
+            out: concurrent.futures.Future = concurrent.futures.Future()
+
+            def _done(f: "concurrent.futures.Future[Any]") -> None:
+                self._record_phase("ring", time.perf_counter() - t_submit)
+                exc = f.exception()
+                if exc is not None:
+                    self.report_error(
+                        exc if isinstance(exc, Exception) else RuntimeError(str(exc))
+                    )
+                    out.set_result(
+                        jax.tree_util.tree_unflatten(treedef, send_leaves)
+                    )
+                else:
+                    out.set_result(f.result())
+
+            chained.get_future().add_done_callback(_done)
+            managed = Work(out)
+            # surface the quantized path's wire accounting on the returned
+            # handle (set synchronously by allreduce_quantized)
+            for attr in ("wire_bytes", "unquantized_wire_bytes", "device_quantized"):
+                if hasattr(work, attr):
+                    setattr(managed, attr, getattr(work, attr))
+            return managed
+        except Exception as e:  # noqa: BLE001 - captured into the protocol
+            self._logger.exception(f"got exception in allreduce -- skipping: {e}")
+            self.report_error(e)
+            return completed_work(value)
+
+    # ------------------------------------------------------------------
+    # errors & commit
+    # ------------------------------------------------------------------
+
+    def report_error(self, e: Exception) -> None:
+        """Latch an async error; the current step will not be committed
+        (reference manager.py:469-482)."""
+        self._errored = e
+        log_event(
+            "error",
+            str(e),
+            job_id=os.environ.get("JOB_ID", "unknown"),
+            replica_id=self._replica_id,
+            rank=self._group_rank,
+            quorum_id=self._quorum_id,
+            step=self._step,
+        )
+
+    def errored(self) -> "Optional[Exception]":
+        return self._errored
+
+    def should_commit(self, timeout: "float | timedelta | None" = None) -> bool:
+        """Vote on committing this step; all group workers return the same
+        value (reference manager.py:790-878)."""
+        # recovery (send/recv checkpoint) must be complete before committing
+        if self._quorum_future is not None:
+            t_q = time.perf_counter()
+            try:
+                self._quorum_future.result()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(
+                    e if isinstance(e, Exception) else RuntimeError(str(e))
+                )
+            finally:
+                self._record_phase("quorum_wait", time.perf_counter() - t_q)
+
+        if (err := self._pg.errored()) is not None:
+            self.report_error(err)
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        t_commit = time.perf_counter()
+        should_commit = self._client.should_commit(
+            self._group_rank,
+            self._step,
+            local_should_commit,
+            timeout=_to_sec(timeout, self._timeout),
+        )
+        self._record_phase("commit", time.perf_counter() - t_commit)
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas}, "
+            f"errored={self._errored}"
+        )
+        log_event(
+            "commit",
+            "commit vote",
+            job_id=os.environ.get("JOB_ID", "unknown"),
+            replica_id=self._replica_id,
+            rank=self._group_rank,
+            quorum_id=self._quorum_id,
+            step=self._step,
+            commit_result=should_commit,
+        )
+
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+        else:
+            self._commit_failures += 1
+            if (
+                self._max_retries is not None
+                and self._commit_failures > self._max_retries
+            ):
+                msg = (
+                    f"should_commit failed {self._commit_failures} times "
+                    f"consecutively, exceeding max_retries={self._max_retries}"
+                )
+                self._logger.exception(msg)
+                raise RuntimeError(msg)
+        return should_commit
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def _record_phase(self, name: str, dt: float) -> None:
+        with self._phase_lock:
+            self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dt
+
+    def pop_phase_times(self) -> "Dict[str, float]":
+        """Wall-clock seconds spent per protocol phase since the last call.
+
+        Caller-thread keys: ``quorum_wait`` (blocked waiting for the async
+        quorum work — the part NOT hidden behind the forward pass; includes
+        the wait in ``should_commit``), ``host_sync`` (caller-thread
+        flatten + zero-fill; the device→host materialisation itself runs on
+        the PG worker and lands in ``ring``), ``ring`` (collective
+        submit→completion: device sync, queueing, the wire, and the
+        host-side AVG division chained after the raw collective),
+        ``commit`` (should_commit RPC barrier).
+
+        Async-quorum-thread keys (run inside the executor, so they OVERLAP
+        ``quorum_wait`` rather than adding to it — they break down what the
+        caller was waiting FOR): ``quorum_rpc`` (the lighthouse-mediated
+        quorum round trip), ``pg_configure`` (collective reconfigure on
+        quorum change), ``heal_send`` / ``heal_recv`` (live checkpoint
+        transfer to/from a recovering peer, incl. the metadata fetch).
+
+        Resets the accumulator.
+        """
+        with self._phase_lock:
+            out, self._phase_acc = self._phase_acc, {}
+        return out
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def participating_rank(self) -> "Optional[int]":
+        if self._quorum_future is None:
+            return None
+        self.wait_quorum()
+        return self._participating_replica_rank
+
+    def num_participants(self) -> int:
+        if self._quorum_future is None:
+            return 0
+        self.wait_quorum()
+        assert self._participating_replica_world_size >= 0, "internal error"
+        return self._participating_replica_world_size
+
+    def is_participating(self) -> bool:
+        if self._participating_replica_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._checkpoint_transport.shutdown(wait=wait)
+        if self._manager_server is not None:
+            self._manager_server.shutdown()
+        if self._owned_store is not None:
+            self._owned_store.shutdown()
+        self._client.close()
+        self._executor.shutdown(wait=wait)
+
+    def __enter__(self) -> "Manager":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.shutdown()

@@ -1,0 +1,24 @@
+"""Model families: flagship llama-style transformer + the reference's
+example-scale CNN/MLP (reference train_ddp.py:84-102, train_diloco.py:76-120)."""
+
+from torchft_tpu.models import cnn, mlp, transformer
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    make_grad_step,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "cnn",
+    "mlp",
+    "transformer",
+    "TransformerConfig",
+    "init_params",
+    "param_specs",
+    "shard_params",
+    "make_train_step",
+    "make_grad_step",
+]

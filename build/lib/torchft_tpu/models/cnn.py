@@ -1,0 +1,62 @@
+"""Small convnet — the reference's DDP example model family
+(reference: train_ddp.py:84-102, a CIFAR10 CNN).
+
+JAX-native: NHWC layout (TPU-preferred), `lax.conv_general_dilated` convs
+so XLA tiles them onto the MXU."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_params(rng: jax.Array, num_classes: int = 10, channels: int = 3) -> Params:
+    k = jax.random.split(rng, 4)
+
+    def conv(key, kh, kw, cin, cout):
+        fan_in = kh * kw * cin
+        return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) / jnp.sqrt(
+            fan_in
+        )
+
+    return {
+        "conv1": {"w": conv(k[0], 3, 3, channels, 32), "b": jnp.zeros((32,))},
+        "conv2": {"w": conv(k[1], 3, 3, 32, 64), "b": jnp.zeros((64,))},
+        "fc1": {
+            "w": jax.random.normal(k[2], (64 * 8 * 8, 128), jnp.float32) / 64.0,
+            "b": jnp.zeros((128,)),
+        },
+        "fc2": {
+            "w": jax.random.normal(k[3], (128, num_classes), jnp.float32) / 16.0,
+            "b": jnp.zeros((num_classes,)),
+        },
+    }
+
+
+def _conv2d(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + b
+
+
+def _max_pool(x: jax.Array) -> jax.Array:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def forward(params: Params, x: jax.Array) -> jax.Array:
+    """x [B, 32, 32, C] NHWC -> logits [B, num_classes]."""
+    x = jax.nn.relu(_conv2d(x, params["conv1"]["w"], params["conv1"]["b"]))
+    x = _max_pool(x)
+    x = jax.nn.relu(_conv2d(x, params["conv2"]["w"], params["conv2"]["b"]))
+    x = _max_pool(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    return x @ params["fc2"]["w"] + params["fc2"]["b"]

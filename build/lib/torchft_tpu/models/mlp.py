@@ -1,0 +1,51 @@
+"""Small MLP — the reference's DiLoCo example model family
+(reference: train_diloco.py:76-120 trains an MLP split into fragments).
+
+Pure-functional JAX; the param dict's top-level keys double as DiLoCo
+fragment boundaries (each layer is a fragment candidate, mirroring how the
+reference splits with torch.distributed.pipelining SplitPoints)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_params(
+    rng: jax.Array, sizes: "Sequence[int]" = (784, 128, 128, 10)
+) -> Params:
+    params: Params = {}
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(keys[i], (n_in, n_out), jnp.float32)
+            / jnp.sqrt(n_in),
+            "b": jnp.zeros((n_out,), jnp.float32),
+        }
+    return params
+
+
+def forward(params: Params, x: jax.Array) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        p = params[f"layer_{i}"]
+        x = x @ p["w"] + p["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def fragment_keys(params: Params, n_fragments: int) -> "List[List[str]]":
+    """Partition top-level param keys into n contiguous fragments (DiLoCo)."""
+    keys = sorted(params.keys(), key=lambda k: int(k.rsplit("_", 1)[1]))
+    base, rem = divmod(len(keys), n_fragments)
+    out, start = [], 0
+    for i in range(n_fragments):
+        n = base + (1 if i < rem else 0)
+        out.append(keys[start : start + n])
+        start += n
+    return out

@@ -1,0 +1,213 @@
+"""Mixture-of-Experts FFN with expert parallelism (``ep`` mesh axis).
+
+A TPU-first capability beyond the reference (which has no expert
+parallelism — SURVEY §2.3): GShard-style capacity-based top-k routing
+expressed entirely as dense one-hot einsums, so the whole layer is static-
+shaped, jit-friendly, and MXU-resident. Experts are sharded over the ``ep``
+mesh axis via sharding constraints on the ``[E, C, d]`` dispatch tensor —
+XLA inserts the token all-to-alls; no hand-written collectives.
+
+Routing: top-k (default 2) experts per token, probabilities renormalized
+over the chosen k; per-expert capacity ``C = ceil(capacity_factor * N * k /
+E)``; tokens past capacity are dropped (their combine weight is zero, so
+the residual connection passes them through unchanged — standard GShard
+semantics). The load-balance auxiliary loss (Switch/GShard ``E * Σ_e
+fraction_tokens_e * mean_prob_e``) is returned for the trainer to add.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    ep_axis: str = "ep"
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig, n_layers: int = 0) -> Params:
+    """Expert + router weights; with ``n_layers`` > 0 a leading stacked
+    layer dim is added (for `lax.scan` blocks)."""
+    e, f, ne = cfg.d_model, cfg.d_ff, cfg.n_experts
+    pd = cfg.param_dtype
+    lead = (n_layers,) if n_layers else ()
+    keys = jax.random.split(rng, 4)
+
+    def dense(key, *shape):
+        fan_in = shape[-2]
+        return (jax.random.normal(key, shape, pd) / np.sqrt(fan_in)).astype(pd)
+
+    return {
+        "router": dense(keys[0], *lead, e, ne),
+        "w_gate": dense(keys[1], *lead, ne, e, f),
+        "w_up": dense(keys[2], *lead, ne, e, f),
+        "w_down": dense(keys[3], *lead, ne, f, e),
+    }
+
+
+def moe_param_specs(cfg: MoEConfig, stacked: bool = False) -> Params:
+    """PartitionSpecs: experts sharded over ep, inner dims over fsdp/tp."""
+    lead = (None,) if stacked else ()
+    ep, fs, tp = cfg.ep_axis, cfg.fsdp_axis, cfg.tp_axis
+    return {
+        "router": P(*lead, None, None),
+        "w_gate": P(*lead, ep, fs, tp),
+        "w_up": P(*lead, ep, fs, tp),
+        "w_down": P(*lead, ep, tp, fs),
+    }
+
+
+def _capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    return max(
+        1, math.ceil(cfg.capacity_factor * n_tokens * cfg.top_k / cfg.n_experts)
+    )
+
+
+def moe_ffn(
+    x: jax.Array,
+    params: Params,
+    cfg: MoEConfig,
+    mesh: "Optional[Mesh]" = None,
+) -> "Tuple[jax.Array, jax.Array]":
+    """MoE feed-forward: ``x [B, T, d] -> (y [B, T, d], aux_loss scalar)``.
+
+    With a mesh, the ``[E, C, d]`` expert buffers get ``P(ep, ...)``
+    sharding constraints so XLA dispatches tokens to expert shards over the
+    ep axis (all-to-all on ICI).  ``mesh="manual"`` applies the constraint
+    with a bare PartitionSpec — the form required inside a partial-manual
+    shard_map (e.g. the pipeline), where ep stays automatic but a
+    NamedSharding over the full mesh is rejected for mentioning manual
+    axes.
+    """
+    b, t, d = x.shape
+    n = b * t
+    ne, k = cfg.n_experts, cfg.top_k
+    cap = _capacity(n, cfg)
+    act = cfg.dtype
+
+    flat = x.reshape(n, d)
+    logits = (
+        flat.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    )  # [N, E] — routing in f32 always
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k assignment (distinct experts per token)
+    _, top_idx = jax.lax.top_k(logits, k)  # [N, k]
+    expert_masks = [
+        jax.nn.one_hot(top_idx[:, kk], ne, dtype=jnp.float32) for kk in range(k)
+    ]
+
+    # renormalize gates over the chosen k
+    gates = jnp.stack(
+        [(probs * m).sum(axis=-1) for m in expert_masks], axis=0
+    )  # [k, N]
+    gates = gates / jnp.maximum(gates.sum(axis=0, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert: earlier choices
+    # get priority, then token order (GShard scheme). Counts in int32 —
+    # f32 cumsum would collide capacity slots past 2^24 assignments.
+    prev_per_expert = jnp.zeros((ne,), jnp.int32)
+    dispatch = jnp.zeros((n, ne, cap), jnp.float32)
+    combine = jnp.zeros((n, ne, cap), jnp.float32)
+    for kk in range(k):
+        mask = expert_masks[kk]  # [N, E]
+        imask = mask.astype(jnp.int32)
+        pos = jnp.cumsum(imask, axis=0) - 1 + prev_per_expert[None, :]
+        prev_per_expert = prev_per_expert + imask.sum(axis=0)
+        within = (pos < cap) & (imask > 0)
+        pos_oh = jax.nn.one_hot(pos, cap, dtype=jnp.float32)
+        sel = jnp.where(within[..., None], pos_oh, 0.0)  # [N, E, C]
+        dispatch = dispatch + sel
+        combine = combine + sel * gates[kk][:, None, None]
+
+    # dispatch tokens into per-expert buffers on the MXU
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch, flat.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(act)
+    if mesh is not None:
+        spec = (
+            P(cfg.ep_axis, None, None)
+            if isinstance(mesh, str)
+            else NamedSharding(mesh, P(cfg.ep_axis, None, None))
+        )
+        expert_in = jax.lax.with_sharding_constraint(expert_in, spec)
+
+    wg = params["w_gate"].astype(act)
+    wu = params["w_up"].astype(act)
+    wd = params["w_down"].astype(act)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, wg)) * jnp.einsum(
+        "ecd,edf->ecf", expert_in, wu
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(expert_out, spec)
+
+    y = jnp.einsum(
+        "nec,ecd->nd", combine, expert_out.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+    # load-balance auxiliary loss (Switch eq. 4): E * sum_e f_e * p_e over
+    # the FIRST choice (standard), where f_e = fraction of tokens routed
+    fraction = expert_masks[0].mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = ne * jnp.sum(fraction * mean_prob)
+
+    return y.reshape(b, t, d).astype(x.dtype), aux
+
+
+def moe_ffn_reference(
+    x: jax.Array, params: Params, cfg: MoEConfig
+) -> jax.Array:
+    """Brute-force per-token reference (no capacity drops): for tests."""
+    b, t, d = x.shape
+    flat = x.reshape(b * t, d).astype(jnp.float32)
+    logits = flat @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, top_idx = jax.lax.top_k(logits, cfg.top_k)
+    out = jnp.zeros_like(flat)
+    gates = jnp.take_along_axis(probs, top_idx, axis=-1)
+    gates = gates / gates.sum(axis=-1, keepdims=True)
+
+    def one_expert(e):
+        wg = params["w_gate"][e].astype(jnp.float32)
+        wu = params["w_up"][e].astype(jnp.float32)
+        wd = params["w_down"][e].astype(jnp.float32)
+        h = jax.nn.silu(flat @ wg) * (flat @ wu)
+        return h @ wd
+
+    all_out = jnp.stack([one_expert(e) for e in range(cfg.n_experts)])  # [E, N, d]
+    for kk in range(cfg.top_k):
+        idx = top_idx[:, kk]
+        out = out + gates[:, kk:kk + 1] * jnp.take_along_axis(
+            all_out, idx[None, :, None], axis=0
+        )[0]
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
+__all__ = [
+    "MoEConfig",
+    "init_moe_params",
+    "moe_param_specs",
+    "moe_ffn",
+    "moe_ffn_reference",
+]

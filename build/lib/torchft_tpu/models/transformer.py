@@ -323,21 +323,14 @@ def _resolve_attn_impl(
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         if sizes.get(cfg.cp_axis, 1) > 1:
             return "ring"
-    # The Pallas kernel only pays on real TPU hardware; off-TPU it would
-    # run in interpreter mode (orders of magnitude slower than XLA dense),
-    # so "auto" means dense there — CPU debugging / virtual-mesh dryruns
-    # keep their speed, and the flash path itself is covered off-TPU by
-    # its interpret-mode kernel tests.
-    if seq_len % 128 == 0 and jax.default_backend() == "tpu":
+    if seq_len % 128 == 0:
         return "flash"
-    key = (seq_len, jax.default_backend())
-    if key not in _warned_attn_fallback:
-        _warned_attn_fallback.add(key)
+    if seq_len not in _warned_attn_fallback:
+        _warned_attn_fallback.add(seq_len)
         logger.info(
-            "attn_impl='auto': %s; using dense attention",
-            f"T={seq_len} is not 128-lane-aligned"
-            if seq_len % 128
-            else f"backend={jax.default_backend()} runs pallas interpreted",
+            "attn_impl='auto': T=%d is not 128-lane-aligned; "
+            "falling back to dense attention",
+            seq_len,
         )
     return "dense"
 

@@ -1,0 +1,10 @@
+"""Compute ops: quantized collectives, Pallas kernels, SP attention.
+
+Heavy modules (jax/pallas) import lazily via their submodules:
+
+- ``torchft_tpu.ops.quantization`` — host int8 wire codec
+- ``torchft_tpu.ops.pallas_quant`` — fused device quantize/dequant/reduce
+- ``torchft_tpu.ops.collectives`` — quantized allreduce / reduce-scatter
+- ``torchft_tpu.ops.ring_attention`` — ring (context-parallel) attention
+- ``torchft_tpu.ops.ulysses`` — all-to-all sequence parallelism
+"""

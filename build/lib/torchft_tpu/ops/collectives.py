@@ -1,0 +1,247 @@
+"""Quantized collectives: 8-bit allreduce / reduce-scatter over the FT PG.
+
+Analog of the reference's quantized collectives
+(reference: torchft/collectives.py:159-415): quantize per-rank row-slices,
+``alltoall`` the slices, locally dequant-reduce-requant the owned slice,
+``allgather`` the reduced slices, dequantize.  Cuts DCN bytes ~4x for f32
+gradients (int8 payload + f32 row scales) at the cost of quantization error
+— the DiLoCo outer-gradient path is tolerant to this by design.
+
+Two bit-compatible quantizers feed the same wire format (the analog of the
+reference wiring its Triton kernels into the collective,
+reference collectives.py:297-415):
+
+- **device path** (default for jax arrays on a TPU backend): the Pallas
+  fused absmax-quantize kernel (torchft_tpu/ops/pallas_quant.py) runs
+  *before* the device→host copy, so only int8 payload + f32 row scales
+  cross PCIe/host memory — ~4x fewer device→host AND wire bytes;
+- **host path** (numpy codec, torchft_tpu/ops/quantization.py) for host
+  arrays or non-TPU backends.
+
+SUM and AVG only, floating-point inputs only (parity: reference
+collectives.py:336-344).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.ops import quantization as q
+from torchft_tpu.parallel.process_group import (
+    ProcessGroup,
+    REDUCE_AVG,
+    REDUCE_SUM,
+)
+from torchft_tpu.parallel.work import Work, completed_work
+
+
+def _slice_rows(rows: int, world: int) -> "List[tuple[int, int]]":
+    """Contiguous row ranges per rank (last rank takes the remainder)."""
+    base = rows // world
+    bounds = []
+    start = 0
+    for r in range(world):
+        n = base + (1 if r < rows % world else 0)
+        bounds.append((start, start + n))
+        start += n
+    return bounds
+
+
+def _device_send_bufs(
+    arrays: "List[Any]", bounds: "List[tuple[int, int]]", rows: int, cols: int
+) -> "List[np.ndarray]":
+    """Quantize the whole flattened matrix ON DEVICE (one Pallas launch),
+    then copy only the int8 payload + f32 scales to the host and pack
+    per-destination row-slices in the shared wire layout.  Quantization is
+    per-row, so slicing after the kernel is bit-identical to quantizing
+    each slice — and costs one device→host round trip instead of
+    ``world``."""
+    from torchft_tpu.ops import pallas_quant as pq
+
+    flat = jnp.concatenate(
+        [jnp.ravel(a).astype(jnp.float32) for a in arrays]
+    )
+    mat = jnp.zeros((rows * cols,), jnp.float32).at[: flat.size].set(flat)
+    scales, payload = pq.fused_quantize_into_int8(mat.reshape(rows, cols))
+    scales_np, payload_np = np.asarray(scales), np.asarray(payload)
+    return [
+        q.pack(scales_np[start:end], payload_np[start:end])
+        for start, end in bounds
+    ]
+
+
+def allreduce_quantized(
+    arrays: "List[Any]",
+    op: str,
+    pg: ProcessGroup,
+    average_by: "int | None" = None,
+    device_quantize: "Optional[bool]" = None,
+) -> Work:
+    """8-bit quantized allreduce of a list of float arrays.
+
+    Returns a Work resolving to the dequantized reduced arrays (f32
+    precision loss ~1e-2 relative; see tests for bounds).  The Work
+    carries ``wire_bytes`` / ``unquantized_wire_bytes`` attributes with
+    the measured per-rank alltoall payload size.
+
+    Args:
+        average_by: divide the sum by this count (fused into the requant
+            step); defaults to pg.size() when op is AVG.
+        device_quantize: quantize on-device with the Pallas kernel before
+            the device→host copy.  Default: auto — on when every input is
+            a jax array and the default backend is TPU.
+    """
+    if op not in (REDUCE_SUM, REDUCE_AVG):
+        raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
+    # normalize non-array inputs (lists, Python scalars) without touching
+    # device arrays
+    arrays = [a if isinstance(a, jax.Array) else np.asarray(a) for a in arrays]
+    for a in arrays:
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            raise ValueError("quantized allreduce requires floating point arrays")
+    if device_quantize is None:
+        device_quantize = jax.default_backend() == "tpu" and all(
+            isinstance(a, jax.Array) for a in arrays
+        )
+
+    shapes = [a.shape for a in arrays]
+    sizes = [int(a.size) for a in arrays]
+    out_dtypes = [a.dtype for a in arrays]
+
+    world = pg.size()
+    if world <= 1:
+        out = [np.array(a) for a in arrays]
+        if op == REDUCE_AVG and average_by:
+            out = [a / average_by for a in out]
+        solo = completed_work(out)
+        solo.wire_bytes = 0  # nothing crosses the wire at world 1
+        solo.unquantized_wire_bytes = 0
+        solo.device_quantized = False
+        return solo
+    divisor = average_by if average_by is not None else (world if op == REDUCE_AVG else 0)
+
+    # Flatten all arrays into one (rows, cols) matrix of quantization rows so
+    # a single alltoall/allgather round covers every gradient (the reference
+    # fuses arrays into one comm buffer the same way).
+    total = sum(sizes)
+    cols = 2048 if total >= 2048 else max(total, 1)
+    rows = -(-total // cols)
+    # pad rows to a multiple of world so row-slices are even
+    rows = -(-rows // world) * world
+    bounds = _slice_rows(rows, world)
+
+    if device_quantize:
+        send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
+    else:
+        np_arrays = [np.asarray(a) for a in arrays]
+        flat = np.concatenate([a.astype(np.float32).ravel() for a in np_arrays])
+        mat = np.zeros((rows, cols), dtype=np.float32)
+        mat.ravel()[: flat.size] = flat
+        # quantize each destination rank's row-slice separately
+        send_bufs = []
+        for start, end in bounds:
+            scales, payload = q.quantize(mat[start:end])
+            send_bufs.append(q.pack(scales, payload))
+
+    def _finish_alltoall(received: "List[np.ndarray]") -> Work:
+        my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
+        reduced = q.reduce_quantized(received, my_rows, cols, average_by=divisor)
+        return pg.allgather(reduced)
+
+    def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
+        pieces = []
+        for r, buf in enumerate(gathered):
+            n_rows = bounds[r][1] - bounds[r][0]
+            scales, payload = q.unpack(buf, n_rows, cols)
+            pieces.append(q.dequantize(scales, payload, (n_rows, cols), np.float32))
+        full = np.concatenate(pieces).ravel()[:total]
+        out = []
+        offset = 0
+        for shape, size, dtype in zip(shapes, sizes, out_dtypes):
+            out.append(full[offset : offset + size].reshape(shape).astype(dtype))
+            offset += size
+        return out
+
+    # Chain: alltoall -> local fused reduce -> allgather -> dequantize.
+    work = pg.alltoall(send_bufs)
+
+    from concurrent.futures import Future
+
+    out_fut: Future = Future()
+
+    def _stage2(f) -> None:
+        exc = f.exception()
+        if exc is not None:
+            out_fut.set_exception(exc)
+            return
+        try:
+            gather_work = _finish_alltoall(f.result())
+
+            def _stage3(g) -> None:
+                exc2 = g.exception()
+                if exc2 is not None:
+                    out_fut.set_exception(exc2)
+                    return
+                try:
+                    out_fut.set_result(_finish_allgather(g.result()))
+                except Exception as e:  # noqa: BLE001
+                    out_fut.set_exception(e)
+
+            gather_work.get_future().add_done_callback(_stage3)
+        except Exception as e:  # noqa: BLE001
+            out_fut.set_exception(e)
+
+    work.get_future().add_done_callback(_stage2)
+    out_work = Work(out_fut)
+    # Observability: measured wire bytes vs the unquantized f32 equivalent
+    # (the ~4x reduction the codec exists for).
+    out_work.wire_bytes = sum(b.nbytes for b in send_bufs)
+    out_work.unquantized_wire_bytes = 4 * total
+    out_work.device_quantized = bool(device_quantize)
+    return out_work
+
+
+def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
+    """8-bit quantized reduce-scatter: like allreduce_quantized without the
+    allgather (reference collectives.py:159-294). Resolves to this rank's
+    dequantized row-slice of the reduction."""
+    if op not in (REDUCE_SUM, REDUCE_AVG):
+        raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
+    np_array = np.asarray(array)
+    if not jnp.issubdtype(np_array.dtype, jnp.floating):
+        raise ValueError("quantized reduce_scatter requires floating point arrays")
+    world = pg.size()
+    if world <= 1:
+        return completed_work(np_array.astype(np.float32))
+    if np_array.shape[0] % world != 0:
+        raise ValueError(
+            f"reduce_scatter dim0 {np_array.shape[0]} not divisible by {world}"
+        )
+    divisor = world if op == REDUCE_AVG else 0
+
+    rows_total = np_array.shape[0]
+    cols = int(np.prod(np_array.shape[1:], dtype=np.int64)) or 1
+    mat = np_array.reshape(rows_total, cols).astype(np.float32)
+    bounds = _slice_rows(rows_total, world)
+    send_bufs = []
+    for start, end in bounds:
+        scales, payload = q.quantize(mat[start:end])
+        send_bufs.append(q.pack(scales, payload))
+
+    my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
+    out_shape = (my_rows,) + np_array.shape[1:]
+
+    def _finish(received: "List[np.ndarray]") -> np.ndarray:
+        # raw f32 result: the reduced slice stays local, so requantizing
+        # (needed in allreduce for the allgather hop) would only add error
+        acc = q.reduce_quantized(
+            received, my_rows, cols, average_by=divisor, requantize=False
+        )
+        return acc.reshape(out_shape)
+
+    return pg.alltoall(send_bufs).then(_finish)

@@ -1,0 +1,555 @@
+"""Flash attention for TPU: fused tiled causal attention in Pallas.
+
+The framework's hot-op kernel (the reference's hot ops are its Triton
+quantization kernels, torchft/quantization.py:44-430; attention itself it
+leaves to torch — on TPU the [T, T] score materialization is the dominant
+HBM cost of the transformer, so this is where a Pallas kernel pays).
+
+Standard FlashAttention-2 scheme, fwd + bwd:
+
+- forward: one pass over K/V blocks per Q block with the online-softmax
+  running (m, l) statistics in VMEM scratch; writes O and the per-row
+  logsumexp L. Never materializes [T, T].
+- backward: recomputes p = exp(q·kᵀ·scale − L) per tile from the saved L
+  (no stored probabilities), accumulating dK/dV over Q blocks in one
+  kernel and dQ over K/V blocks in another.
+- causal block skipping: fully-masked tiles are skipped via ``pl.when``
+  (half the FLOPs at long T), diagonal tiles masked elementwise.
+- dtypes: matmuls run in the input dtype (bf16 on TPU) with f32
+  accumulation; softmax statistics and accumulators are f32 scratch.
+
+Layouts follow the guide (/opt/skills/guides/pallas_guide.md): blocks are
+(sublane × lane)-aligned, row statistics ride a 128-lane minor dim.  Off
+TPU every kernel runs in interpreter mode so the CPU test suite covers
+the same code path.
+
+Wired into the model as ``TransformerConfig(attn_impl="flash")``
+(torchft_tpu/models/transformer.py); requires T % 128 == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_size(t: int) -> int:
+    for blk in (512, 256, 128):
+        if t % blk == 0:
+            return blk
+    raise ValueError(f"flash attention requires seq len % 128 == 0, got {t}")
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
+    *, scale, causal, blk_q, blk_k
+):
+    """offs_ref: SMEM int32 [2] = (q_offset, k_offset) GLOBAL positions of
+    this call's first query/key row — the ring composition runs the kernel
+    on local chunks whose causal relation depends on the shard offsets."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # causal: this tile is live unless every key position exceeds every
+    # query position in the block
+    needed = jnp.logical_or(
+        not causal, k_off + j * blk_k <= q_off + i * blk_q + blk_q - 1
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q,
+            k_ref[0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [blk_q, blk_k]
+        if causal:
+            rq = q_off + i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            rk = k_off + j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(rq >= rk, s, _NEG_INF)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        # A query row with zero live keys so far has m_new == _NEG_INF, so
+        # s - m_new == 0 for every MASKED entry and p would be 1 — O would
+        # become a garbage mean of V.  Zero p for such rows instead: l
+        # stays 0, O resolves to 0 and lse to ~-inf, so callers passing
+        # offsets (ring chunks where q precedes every k) get an exact
+        # zero-weight chunk rather than relying on the combiner's
+        # exp-underflow to hide it.
+        p = jnp.where(m_new > _NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:] = jnp.broadcast_to(
+            l_s[:, :1] * corr + p.sum(axis=1, keepdims=True), l_s.shape
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(q.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        # [blk, 1] column -> [1, blk] lane vector (Mosaic relayout)
+        lse_ref[0] = (m_s[:, :1] + jnp.log(l)).reshape(1, -1)
+
+
+def _fwd(
+    q3: jax.Array,
+    k3: jax.Array,
+    v3: jax.Array,
+    scale: float,
+    causal: bool,
+    offsets: "Optional[jax.Array]" = None,
+) -> "Tuple[jax.Array, jax.Array]":
+    bh, tq, d = q3.shape
+    tk = k3.shape[1]
+    blk_q = _block_size(tq)
+    blk_k = _block_size(tk)
+    if offsets is None:
+        offsets = jnp.zeros((2,), jnp.int32)
+    grid = (bh, tq // blk_q, tk // blk_k)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            # row stats as [bh, 1, t]: a (1, 1, blk) block keeps the
+            # sublane dim equal to the array's (TPU block-shape rule) and
+            # the per-row scalars on lanes — 128x less HBM than
+            # broadcasting to a [bh, t, 128] stat plane
+            pl.BlockSpec((1, 1, blk_q), lambda b, i, j: (b, 0, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, _LANE), jnp.float32),
+            pltpu.VMEM((blk_q, _LANE), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(offsets.astype(jnp.int32), q3, k3, v3)
+    return o, lse[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q, k, lse_row, scale, causal, q_pos0, k_pos0):
+    """exp(q·kᵀ·scale − L) with the causal mask — shared by both bwd
+    kernels.  lse_row: [1, blk_q] f32 lane vector (reshaped to a column
+    here; Mosaic relayout).  q_pos0/k_pos0: GLOBAL position of the first
+    row of each block."""
+    lse_col = lse_row.reshape(-1, 1)  # lane vector -> column
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    p = jnp.exp(s - lse_col)
+    if causal:
+        rq = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+        rk = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        p = jnp.where(rq >= rk, p, 0.0)
+    return p
+
+
+def _bwd_kv_kernel(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, blk_q, blk_k,
+):
+    j = pl.program_id(1)  # K/V block (outer)
+    i = pl.program_id(2)  # Q block (inner, accumulated)
+    ni = pl.num_programs(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = jnp.logical_or(
+        not causal, q_off + i * blk_q + blk_q - 1 >= k_off + j * blk_k
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        do = do_ref[0]
+        p = _recompute_p(
+            q, k_ref[0], lse_ref[0], scale, causal,
+            q_off + i * blk_q, k_off + j * blk_k,
+        )
+        pt = p.astype(q.dtype)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == ni - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc, *, scale, causal, blk_q, blk_k,
+):
+    i = pl.program_id(1)  # Q block (outer)
+    j = pl.program_id(2)  # K/V block (inner, accumulated)
+    nj = pl.num_programs(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = jnp.logical_or(
+        not causal, k_off + j * blk_k <= q_off + i * blk_q + blk_q - 1
+    )
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        p = _recompute_p(
+            q, k_ref[0], lse_ref[0], scale, causal,
+            q_off + i * blk_q, k_off + j * blk_k,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(
+    q3, k3, v3, o3, lse, do3, scale: float, causal: bool,
+    offsets: "Optional[jax.Array]" = None,
+    delta: "Optional[jax.Array]" = None,
+) -> "Tuple[jax.Array, jax.Array, jax.Array]":
+    bh, tq, d = q3.shape
+    tk = k3.shape[1]
+    blk = _block_size(tq)
+    blk_kk = _block_size(tk)
+    n = tq // blk
+    nk = tk // blk_kk
+    if offsets is None:
+        offsets = jnp.zeros((2,), jnp.int32)
+    offsets = offsets.astype(jnp.int32)
+    if delta is None:
+        # delta_i = rowsum(dO * O): tiny elementwise pass, plain XLA
+        delta = jnp.sum(
+            do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+        )
+    delta = delta[:, None, :]  # [bh, 1, t]
+    lse3 = lse[:, None, :]
+
+    # kv kernel grid = (b, j, i): index maps receive (b, kv_block, q_block)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kv_kernel, scale=scale, causal=causal, blk_q=blk,
+            blk_k=blk_kk,
+        ),
+        grid=(bh, nk, n),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),     # q
+            pl.BlockSpec((1, blk_kk, d), lambda b, jj, ii: (b, jj, 0)),  # k
+            pl.BlockSpec((1, blk_kk, d), lambda b, jj, ii: (b, jj, 0)),  # v
+            pl.BlockSpec((1, blk, d), lambda b, jj, ii: (b, ii, 0)),     # do
+            pl.BlockSpec((1, 1, blk), lambda b, jj, ii: (b, 0, ii)),  # lse
+            pl.BlockSpec((1, 1, blk), lambda b, jj, ii: (b, 0, ii)),  # delta
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk_kk, d), lambda b, jj, ii: (b, jj, 0)),
+            pl.BlockSpec((1, blk_kk, d), lambda b, jj, ii: (b, jj, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tk, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), q3.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk_kk, d), jnp.float32),
+            pltpu.VMEM((blk_kk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(offsets, q3, k3, v3, do3, lse3, delta)
+
+    # q kernel grid = (b, i, j): index maps receive (b, q_block, kv_block)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_q_kernel, scale=scale, causal=causal, blk_q=blk,
+            blk_k=blk_kk,
+        ),
+        grid=(bh, n, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),     # q
+            pl.BlockSpec((1, blk_kk, d), lambda b, ii, jj: (b, jj, 0)),  # k
+            pl.BlockSpec((1, blk_kk, d), lambda b, ii, jj: (b, jj, 0)),  # v
+            pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),     # do
+            pl.BlockSpec((1, 1, blk), lambda b, ii, jj: (b, 0, ii)),  # lse
+            pl.BlockSpec((1, 1, blk), lambda b, ii, jj: (b, 0, ii)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, blk, d), lambda b, ii, jj: (b, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(offsets, q3, k3, v3, do3, lse3, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash3(q3, k3, v3, scale, causal):
+    return _fwd(q3, k3, v3, scale, causal)[0]
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal):
+    o, lse = _fwd(q3, k3, v3, scale, causal)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Tiled fused causal attention, ``[B, T, H, D] -> [B, T, H, D]``.
+
+    Drop-in for :func:`~torchft_tpu.ops.ring_attention.dense_attention`
+    with O(T) memory instead of the O(T^2) score matrix.  GQA K/V with
+    fewer heads are broadcast up (the kernel is per-head).  Requires
+    ``T % 128 == 0``; other shapes should use ``dense_attention``.
+    """
+    b, t, h, d = q.shape
+    if h % k.shape[2] != 0:
+        raise ValueError(
+            f"query heads {h} not a multiple of kv heads {k.shape[2]}"
+        )
+    k, v = _expand_gqa(k, v, h)
+    scale = 1.0 / math.sqrt(d)
+    out3 = _flash3(_to3(q), _to3(k), _to3(v), scale, causal)
+    return _from3(out3, b, h)
+
+
+__all__ = ["flash_attention"]
+
+
+# ---------------------------------------------------------------------------
+# ring composition: flash tiles inside sequence-parallel ring attention
+# ---------------------------------------------------------------------------
+
+
+def _to3(x: jax.Array) -> jax.Array:
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from3(x3: jax.Array, b: int, h: int) -> jax.Array:
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _expand_gqa(k: jax.Array, v: jax.Array, h: int):
+    rep = h // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_local(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool = True
+) -> jax.Array:
+    """Per-shard ring attention with FLASH tiles: the K/V chunks rotate
+    around the ``axis_name`` ring exactly like
+    :func:`~torchft_tpu.ops.ring_attention.ring_attention_local`, but each
+    (local-Q x visiting-KV) tile runs the fused Pallas kernel with global
+    position offsets instead of materializing [T_local, T_local] scores —
+    the single-chip flash memory/speed profile composed with cp sharding.
+
+    Same contract as ring_attention_local: must run inside shard_map over
+    ``axis_name``; q/k/v are local chunks [B, T_local, H, D] rotary-
+    embedded with GLOBAL positions; GQA K/V rotate unexpanded.  Requires
+    T_local % 128 == 0.  The backward pass re-rotates K/V and runs the
+    flash bwd kernels per tile against the globally-combined logsumexp
+    (the standard ring-attention backward), so [T, T] is never built in
+    either direction.
+    """
+    o, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal)
+    return o
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    q3 = _to3(q)
+
+    def step(carry, s):
+        o3, lse, kc, vc = carry
+        kv_idx = (idx - s) % size
+        ke, ve = _expand_gqa(kc, vc, h)
+        offs = jnp.stack([idx * tq, kv_idx * tk]).astype(jnp.int32)
+        o_s, lse_s = _fwd(q3, _to3(ke), _to3(ve), scale, causal, offs)
+        # blockwise softmax combination over chunks (f32)
+        m = jnp.maximum(lse, lse_s)
+        w1 = jnp.exp(lse - m)
+        w2 = jnp.exp(lse_s - m)
+        denom = jnp.maximum(w1 + w2, 1e-30)
+        o3 = (
+            o3.astype(jnp.float32) * (w1 / denom)[..., None]
+            + o_s.astype(jnp.float32) * (w2 / denom)[..., None]
+        )
+        lse = m + jnp.log(denom)
+        perm = [(r, (r + 1) % size) for r in range(size)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o3, lse, kc, vc), None
+
+    # zeros derived from q carry its device-varying axis set (vma rule)
+    o0 = jnp.zeros_like(q3, dtype=jnp.float32)
+    lse0 = jnp.zeros((b * h, tq), jnp.float32) + (
+        jnp.zeros_like(q3[:, :, 0]) + _NEG_INF
+    )
+    (o3, lse, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(size)
+    )
+    return _from3(o3.astype(q.dtype), b, h), lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal):
+    o, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, res, do):
+    q, k, v, o, lse = res
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    hkv = k.shape[2]
+    rep = h // hkv
+    scale = 1.0 / math.sqrt(d)
+    q3, o3, do3 = _to3(q), _to3(o), _to3(do)
+    # loop-invariant: rowsum(dO * O), computed once for all ring steps
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    def step(carry, s):
+        dq3, kc, vc, dkc, dvc = carry
+        kv_idx = (idx - s) % size
+        ke, ve = _expand_gqa(kc, vc, h)
+        offs = jnp.stack([idx * tq, kv_idx * tk]).astype(jnp.int32)
+        dq_s, dk_s, dv_s = _bwd(
+            q3, _to3(ke), _to3(ve), o3, lse, do3, scale, causal, offs,
+            delta=delta,
+        )
+        dq3 = dq3 + dq_s.astype(jnp.float32)
+        # fold expanded-head grads back onto the unexpanded K/V heads
+        dk4 = _from3(dk_s, b, h).reshape(b, tk, hkv, rep, d).sum(3)
+        dv4 = _from3(dv_s, b, h).reshape(b, tk, hkv, rep, d).sum(3)
+        dkc = dkc + dk4.astype(jnp.float32)
+        dvc = dvc + dv4.astype(jnp.float32)
+        # K/V and their grad accumulators rotate together: after the full
+        # cycle each chunk (and its accumulated grad) is home again
+        perm = [(r, (r + 1) % size) for r in range(size)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dkc = jax.lax.ppermute(dkc, axis_name, perm)
+        dvc = jax.lax.ppermute(dvc, axis_name, perm)
+        return (dq3, kc, vc, dkc, dvc), None
+
+    dq0 = jnp.zeros_like(q3, dtype=jnp.float32)
+    dk0 = jnp.zeros_like(k, dtype=jnp.float32)
+    dv0 = jnp.zeros_like(v, dtype=jnp.float32)
+    (dq3, _, _, dk_acc, dv_acc), _ = jax.lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(size)
+    )
+    return (
+        _from3(dq3, b, h).astype(q.dtype),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+    )
+
+
+ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+__all__.append("ring_flash_local")

@@ -1,0 +1,258 @@
+"""Pallas TPU kernels for fused 8-bit quantization.
+
+Device-side analog of the reference's fused Triton kernels
+(reference: torchft/quantization.py:54-430): per-row absmax scale
+computation fused with int8 quantization, dequantization, and
+dequant-accumulate-requant reduction.  Shares the wire format of the host
+path (torchft_tpu/ops/quantization.py): int8 payload + one f32 scale per
+row, ``scale = absmax/127`` (1.0 for all-zero rows), round-half-even.
+
+The reference targets fp8e4nv on SM90 with an int8 fallback
+(reference quantization.py:30-41); TPU VPUs have no fp8 compute path worth
+taking for a comm codec, so int8 — the reference's fallback format and the
+format the DCN wire expects — is the single payload type here.
+
+Use: quantize gradients on-chip *before* the device→host copy that feeds
+the TCP/DCN collective, cutting host-transfer and wire bytes ~4x; dequant
+on-chip after.  All wrappers fall back to interpreter mode off-TPU so tests
+run on CPU.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md): rows are tiled in
+blocks of 32 sublanes (int8 min tile), columns padded to the 128-lane
+boundary.  Scales are carried as an (rows, 128) f32 block column-broadcast
+inside the kernel and sliced to (rows,) on the host side — keeping every
+ref layout-legal without scalar-memory gymnastics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INT8_MAX = 127.0
+_ROW_TILE = 32  # int8 min sublane tile
+_LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _pad2d(x: jax.Array, rows: int, cols: int) -> jax.Array:
+    pr, pc = rows - x.shape[0], cols - x.shape[1]
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _quantize_kernel(x_ref, scales_ref, payload_ref):
+    """Per-row absmax scale + int8 quantize, one fused pass over the block.
+
+    Mirrors reference quantization.py:44-165 (scale compute fused into the
+    quantize kernel); zero rows get scale 1.0 so dequant is exact.
+    """
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0.0, absmax / INT8_MAX, 1.0)
+    scales_ref[:] = jnp.broadcast_to(scale, scales_ref.shape)
+    q = jnp.clip(jnp.round(x / scale), -INT8_MAX, INT8_MAX)
+    payload_ref[:] = q.astype(jnp.int8)
+
+
+def _dequantize_kernel(scales_ref, payload_ref, out_ref):
+    scale = scales_ref[:, :1]
+    out_ref[:] = payload_ref[:].astype(jnp.float32) * scale
+
+
+def _reduce_kernel(scales_ref, payloads_ref, inv_ref, out_scales_ref, out_payload_ref):
+    """Fused dequant → accumulate(f32) → optional average → requantize.
+
+    Analog of reference quantization.py:262-430.  The block carries all
+    world-size shards (leading axis); world sizes on the elastic replica
+    dim are small, so the whole stack fits VMEM alongside one row tile.
+    """
+    scales = scales_ref[:, :, :1].astype(jnp.float32)  # (n, rows, 1)
+    deq = payloads_ref[:].astype(jnp.float32) * scales  # (n, rows, cols)
+    acc = jnp.sum(deq, axis=0) * inv_ref[0]  # (rows, cols)
+    absmax = jnp.max(jnp.abs(acc), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0.0, absmax / INT8_MAX, 1.0)
+    out_scales_ref[:] = jnp.broadcast_to(scale, out_scales_ref.shape)
+    q = jnp.clip(jnp.round(acc / scale), -INT8_MAX, INT8_MAX)
+    out_payload_ref[:] = q.astype(jnp.int8)
+
+
+# ---------------------------------------------------------------------------
+# host-callable wrappers
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quantize_2d(x: jax.Array, interpret: bool) -> Tuple[jax.Array, jax.Array]:
+    rows, cols = x.shape
+    pr = _cdiv(rows, _ROW_TILE) * _ROW_TILE
+    pc = _cdiv(cols, _LANE) * _LANE
+    xp = _pad2d(x.astype(jnp.float32), pr, pc)
+    grid = (pr // _ROW_TILE,)
+    scales, payload = pl.pallas_call(
+        _quantize_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((pr, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((pr, pc), jnp.int8),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, pc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROW_TILE, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_TILE, pc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(xp)
+    return scales[:rows, 0], payload[:rows, :cols]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dequantize_2d(scales: jax.Array, payload: jax.Array, interpret: bool) -> jax.Array:
+    rows, cols = payload.shape
+    pr = _cdiv(rows, _ROW_TILE) * _ROW_TILE
+    pc = _cdiv(cols, _LANE) * _LANE
+    sp = jnp.pad(scales.astype(jnp.float32), (0, pr - rows))
+    sp = jnp.broadcast_to(sp[:, None], (pr, _LANE))
+    pp = _pad2d(payload, pr, pc)
+    grid = (pr // _ROW_TILE,)
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        out_shape=jax.ShapeDtypeStruct((pr, pc), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_TILE, pc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_ROW_TILE, pc), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(sp, pp)
+    return out[:rows, :cols]
+
+
+@functools.partial(jax.jit, static_argnames=("average_by", "interpret"))
+def _reduce_2d(
+    scales: jax.Array,
+    payloads: jax.Array,
+    average_by: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    n, rows, cols = payloads.shape
+    pr = _cdiv(rows, _ROW_TILE) * _ROW_TILE
+    pc = _cdiv(cols, _LANE) * _LANE
+    sp = jnp.pad(scales.astype(jnp.float32), ((0, 0), (0, pr - rows)))
+    sp = jnp.broadcast_to(sp[:, :, None], (n, pr, _LANE))
+    pp = jnp.pad(payloads, ((0, 0), (0, pr - rows), (0, pc - cols)))
+    inv = jnp.array([1.0 / average_by if average_by > 0 else 1.0], jnp.float32)
+    grid = (pr // _ROW_TILE,)
+    out_scales, out_payload = pl.pallas_call(
+        _reduce_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((pr, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((pr, pc), jnp.int8),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (n, _ROW_TILE, _LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (n, _ROW_TILE, pc), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROW_TILE, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_TILE, pc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(sp, pp, inv)
+    return out_scales[:rows, 0], out_payload[:rows, :cols]
+
+
+def _as_rows(a) -> jax.Array:
+    """View as 2-D (rows, cols) — same convention as the host codec."""
+    a = jnp.asarray(a)
+    if a.ndim == 0:
+        return a.reshape(1, 1)
+    if a.ndim == 1:
+        return a.reshape(1, -1)
+    return a.reshape(a.shape[0], -1)
+
+
+def fused_quantize_into_int8(a) -> Tuple[jax.Array, jax.Array]:
+    """Per-row absmax int8 quantization on device.
+
+    Returns ``(scales f32 [rows], payload int8 [rows, cols])`` — bit-
+    compatible with the host codec's ``quantize`` (same scales, same
+    round-half-even payload), so a device-quantized buffer can be packed
+    straight onto the DCN wire.
+    """
+    return _quantize_2d(_as_rows(a), interpret=_interpret())
+
+
+def fused_dequantize_from_int8(scales, payload, shape=None, dtype=jnp.float32):
+    """Inverse of :func:`fused_quantize_into_int8`; reshapes to ``shape``."""
+    out = _dequantize_2d(jnp.asarray(scales), jnp.asarray(payload), interpret=_interpret())
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def fused_reduce_int8(scales, payloads, average_by: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Fused dequant-accumulate-requant over stacked per-rank shards.
+
+    Args:
+        scales: f32 ``(n, rows)`` per-rank row scales.
+        payloads: int8 ``(n, rows, cols)`` per-rank payloads.
+        average_by: if > 0, divide the accumulated sum (AVG fusion,
+            reference collectives.py:336-344).
+
+    Returns requantized ``(scales [rows], payload [rows, cols])`` ready to
+    go back on the wire.
+    """
+    return _reduce_2d(
+        jnp.asarray(scales), jnp.asarray(payloads), int(average_by), _interpret()
+    )
+
+
+def quantize_pytree(tree):
+    """Quantize every leaf of a pytree on device.
+
+    Returns a pytree with the same structure whose leaves are
+    ``(scales, payload)`` tuples from :func:`fused_quantize_into_int8`.
+    """
+    return jax.tree_util.tree_map(
+        fused_quantize_into_int8, tree, is_leaf=lambda x: hasattr(x, "shape")
+    )
+
+
+__all__ = [
+    "fused_quantize_into_int8",
+    "fused_dequantize_from_int8",
+    "fused_reduce_int8",
+    "quantize_pytree",
+]

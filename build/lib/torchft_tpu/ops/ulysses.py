@@ -1,0 +1,95 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+The second long-context strategy alongside ring attention (the reference
+has neither — SURVEY §2.3; both are TPU-first capabilities, not ports).
+Where ring attention rotates K/V chunks around the mesh axis (N-1 ppermute
+steps, attention stays sequence-sharded), Ulysses re-shards once per
+direction with ``jax.lax.all_to_all``: scatter heads / gather sequence, run
+plain full-sequence attention on the local head group, then the inverse
+all-to-all.
+
+Trade-off (How-to-Scale-Your-Model framing): Ulysses moves 2 all-to-alls of
+activations per attention call and needs ``heads % axis_size == 0``, but
+each device then runs a single dense [T, T/head-group] attention — better
+MXU utilization for moderate T and cheap on all-to-all-friendly ICI
+topologies; ring keeps memory strictly local-T and overlaps compute with
+neighbor transfers — better for extreme T. Both compose with the same
+mesh/axis contract, so models can switch per config
+(models/transformer.py ``attn_impl``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from torchft_tpu.ops.ring_attention import dense_attention, sharded_attention
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard Ulysses body. Must run inside shard_map over ``axis_name``;
+    q/k/v are local sequence chunks ``[B, T_local, H, D]`` (rotary-embedded
+    with *global* positions by the caller, same contract as ring attention).
+
+    GQA: K/V may carry fewer heads; they cross the all-to-all *unexpanded*
+    (H/H_kv fewer bytes) and are broadcast up inside the local attention.
+
+    Requires both head counts divisible by ``axis_size``.
+    Returns ``[B, T_local, H, D]``.
+    """
+    size = jax.lax.axis_size(axis_name)
+    h, hkv = q.shape[2], k.shape[2]
+    if h % size != 0 or hkv % size != 0:
+        raise ValueError(
+            f"ulysses attention needs query heads ({h}) and kv heads "
+            f"({hkv}) divisible by the sequence-parallel axis size ({size})"
+        )
+
+    def seq_gather(x: jax.Array) -> jax.Array:
+        # [B, T_local, H, D] -> [B, T_local*size, H/size, D]
+        # split heads across the axis, concatenate sequence chunks in axis
+        # order (contiguous sequence sharding => global order).
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def seq_scatter(x: jax.Array) -> jax.Array:
+        # inverse: [B, T, H/size, D] -> [B, T_local, H, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qf, kf, vf = seq_gather(q), seq_gather(k), seq_gather(v)
+    # dense_attention broadcasts GQA kv heads up locally (post-transfer)
+    out = dense_attention(qf, kf, vf, causal=causal)
+    return seq_scatter(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "cp",
+    causal: bool = True,
+    batch_axes: "Optional[tuple]" = None,
+    head_axis: "Optional[str]" = None,
+) -> jax.Array:
+    """shard_map'd Ulysses attention over ``mesh`` axis ``axis_name``
+    (same contract as :func:`ring_attention`; see
+    :func:`torchft_tpu.ops.ring_attention.sharded_attention`)."""
+    return sharded_attention(
+        ulysses_attention_local, q, k, v, mesh, axis_name, causal,
+        batch_axes, head_axis,
+    )
+
+
+__all__ = ["ulysses_attention", "ulysses_attention_local"]

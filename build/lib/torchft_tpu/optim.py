@@ -1,0 +1,60 @@
+"""Fault-tolerant optimizer wrapper (optax).
+
+Analog of the reference OptimizerWrapper (reference: torchft/optim.py:48-55):
+the step boundary hooks the FT protocol — ``begin_step`` (the zero_grad
+analog) starts the quorum; ``step`` applies the optax update only if
+``should_commit`` votes yes.  Functional JAX adaptation: instead of mutating
+module parameters, ``step`` returns the (possibly unchanged) new
+``(params, opt_state, committed)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import optax
+
+from torchft_tpu.manager import Manager
+
+
+class OptimizerWrapper:
+    """Wraps an optax GradientTransformation with the Manager protocol.
+
+    Usage::
+
+        opt = OptimizerWrapper(manager, optax.adamw(3e-4))
+        opt_state = opt.init(params)
+        ...
+        opt.begin_step()                       # starts quorum (zero_grad analog)
+        grads = grad_fn(params, batch)
+        avg = manager.allreduce(grads).wait()
+        params, opt_state, committed = opt.step(params, avg, opt_state)
+    """
+
+    def __init__(self, manager: Manager, optimizer: optax.GradientTransformation) -> None:
+        self._manager = manager
+        self._optimizer = optimizer
+
+    def init(self, params: Any) -> Any:
+        return self._optimizer.init(params)
+
+    def begin_step(self) -> None:
+        """Start the new step's quorum (reference: zero_grad -> start_quorum)."""
+        self._manager.start_quorum()
+
+    # torch-API-compatible alias
+    zero_grad = begin_step
+
+    def step(
+        self, params: Any, grads: Any, opt_state: Any
+    ) -> "Tuple[Any, Any, bool]":
+        """Apply the update iff the group votes to commit.
+
+        Returns ``(params, opt_state, committed)`` — unchanged on a failed
+        commit so the step is retried on consistent state.
+        """
+        if not self._manager.should_commit():
+            return params, opt_state, False
+        updates, new_opt_state = self._optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, True

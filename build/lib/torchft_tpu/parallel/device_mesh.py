@@ -1,0 +1,121 @@
+"""FT-aware device mesh composition (the HSDP story).
+
+Analog of the reference's ManagedDeviceMesh (reference:
+torchft/device_mesh.py:51-340) — but designed the JAX way.  The reference
+must *lie* to torch's DeviceMesh (registering a fake world-size-1 backend)
+because torch parallelism APIs demand every dim be a real process group.  In
+JAX, inner parallelism (FSDP/TP/SP over ICI within a slice) is a
+``jax.sharding.Mesh`` + pjit shardings, and the elastic replica dimension
+lives *above* jit entirely: the FT allreduce runs on host gradients between
+jitted steps.  So the composition is explicit rather than spoofed:
+
+- ``ManagedDeviceMesh.mesh`` — the static inner mesh handed to pjit; its
+  membership never changes (a slice is fault-free by assumption; if a chip
+  dies, the whole replica group dies and heals as a unit).
+- the replicate dim is virtual: ``num_participants`` / ``replica_rank`` are
+  live quorum values used for loss scaling and data sharding.
+
+Zero-fill + divide-by-participants keeps compiled shapes static, so
+membership changes never trigger a re-jit (SURVEY §7 / reference
+manager.py:416-417).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from torchft_tpu.manager import Manager
+
+
+class ManagedDeviceMesh:
+    """An inner JAX mesh plus the elastic FT replicate dimension.
+
+    Args:
+        manager: FT manager owning the replica dimension.
+        mesh: inner ``jax.sharding.Mesh`` (ICI dims: fsdp/tp/sp/...).
+        replicate_dim_name: name reported for the virtual FT dim.
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        mesh: "jax.sharding.Mesh",
+        replicate_dim_name: str = "dp_replicate",
+    ) -> None:
+        self._manager = manager
+        self.mesh = mesh
+        self.replicate_dim_name = replicate_dim_name
+
+    # -- virtual replicate dim (live quorum values) ------------------------
+
+    def num_participants(self) -> int:
+        return self._manager.num_participants()
+
+    def replica_rank(self) -> "Optional[int]":
+        return self._manager.participating_rank()
+
+    def is_participating(self) -> bool:
+        return self._manager.is_participating()
+
+    # -- composed topology -------------------------------------------------
+
+    @property
+    def axis_names(self) -> "Tuple[str, ...]":
+        return (self.replicate_dim_name,) + tuple(self.mesh.axis_names)
+
+    def shape(self) -> "Dict[str, int]":
+        """Axis sizes; the replicate dim reports the live participant count
+        (>=1 during 0-participant init, mirroring reference :169-184)."""
+        sizes = {self.replicate_dim_name: max(self.num_participants(), 1)}
+        sizes.update(dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
+        return sizes
+
+    def global_batch_slice(self, global_batch_size: int) -> "Tuple[int, int]":
+        """This replica's contiguous [start, end) share of the global batch,
+        given the live quorum (DistributedSampler analog at batch level).
+
+        Returns the empty slice (0, 0) while not participating (healing /
+        no quorum yet) — defaulting to rank 0's slice would silently train
+        on another replica's data."""
+        rank = self.replica_rank()
+        if rank is None or not self.is_participating():
+            return 0, 0
+        n = max(self.num_participants(), 1)
+        per, rem = divmod(global_batch_size, n)
+        # first `rem` ranks take one extra example so every example in the
+        # global batch is assigned under any elastic membership
+        start = rank * per + min(rank, rem)
+        end = start + per + (1 if rank < rem else 0)
+        return start, end
+
+    def __repr__(self) -> str:
+        return (
+            f"ManagedDeviceMesh({self.replicate_dim_name}="
+            f"{max(self.num_participants(), 1)} x inner {self.mesh!r})"
+        )
+
+
+def ft_init_device_mesh(
+    manager: Manager,
+    mesh_shape: "Dict[str, int]",
+    devices: "Optional[Sequence[Any]]" = None,
+    replicate_dim_name: str = "dp_replicate",
+) -> ManagedDeviceMesh:
+    """Build the inner mesh over this replica group's devices and wrap it
+    with the FT dim (reference ft_init_device_mesh, device_mesh.py:307-340).
+
+    ``mesh_shape`` maps inner axis names to sizes, e.g.
+    ``{"fsdp": 4, "tp": 2}``; the product must equal the local device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    total = int(np.prod(list(mesh_shape.values()), dtype=np.int64))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh_shape {mesh_shape} needs {total} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices).reshape(tuple(mesh_shape.values()))
+    mesh = jax.sharding.Mesh(dev_array, tuple(mesh_shape.keys()))
+    return ManagedDeviceMesh(manager, mesh, replicate_dim_name)

@@ -1,0 +1,223 @@
+"""Pipeline parallelism: a GPipe schedule over a ``pp`` mesh axis.
+
+A TPU-first capability beyond the reference (which has no pipeline
+schedule — SURVEY §2.3: torch pipelining appears there only as a
+model-splitting tool for DiLoCo fragments). Layer-stacked parameters
+``[L, ...]`` are sharded over the ``pp`` axis (each stage holds ``L/S``
+consecutive layers); inside ``shard_map`` the classic GPipe tick loop runs
+as a ``lax.scan``: at tick ``t`` stage ``s`` processes microbatch
+``t - s``, then activations hop one stage forward via neighbor
+``ppermute`` (riding ICI). Reverse-mode AD through the scan + ppermute
+gives the backward schedule for free.
+
+Shapes are fully static: every stage computes every tick (bubble ticks are
+masked with ``where``), so the whole schedule jits once. Bubble overhead is
+the standard ``(S-1)/(M+S-1)`` — pick ``microbatches >= 4*stages`` to
+amortize.
+
+Composes with the other axes: the per-stage ``fn`` may itself use tp/cp
+collectives (its shard_map axis names remain visible), and dp/fsdp shard
+the microbatch dim through ``in_specs``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Any
+
+
+def _stage_apply(
+    fn: "Callable[[jax.Array, Params], jax.Array]",
+    x: jax.Array,
+    stage_params: Params,
+) -> jax.Array:
+    """Run this stage's local layer stack ``[L/S, ...]`` over x."""
+
+    def body(h, layer_params):
+        return fn(h, layer_params), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline_apply_local(
+    params: Params,
+    microbatches: Any,
+    fn: "Callable[[Any, Params], Any]",
+    axis_name: str = "pp",
+) -> Any:
+    """Per-shard GPipe body; must run inside shard_map over ``axis_name``.
+
+    Args:
+        params: this stage's layer stack, pytree with leading ``[L/S]`` dim.
+        microbatches: activation pytree (an array is the common case),
+            every leaf ``[M, mb, ...]`` — full microbatch set (replicated
+            across stages; only stage 0 feeds it into the pipe).
+            Multi-leaf activations let side streams ride the pipe (e.g.
+            the MoE load-balance aux loss accumulating across stages).
+        fn: one decoder-layer step ``fn(x, layer_params) -> x`` over the
+            activation pytree.
+
+    Returns ``[M, mb, ...]``-leaved outputs, identical on every stage (the
+    last stage's results are broadcast back via psum).
+    """
+    tmap = jax.tree_util.tree_map
+    stage = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    m = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    n_ticks = m + size - 1
+    perm_fwd = [(i, i + 1) for i in range(size - 1)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        mb_idx = t - stage
+        active = (mb_idx >= 0) & (mb_idx < m)
+        idx = jnp.clip(mb_idx, 0, m - 1)
+        # stage 0 pulls the next microbatch; later stages consume the
+        # activation that hopped in last tick
+        feed = tmap(
+            lambda mbs: jax.lax.dynamic_index_in_dim(
+                mbs, idx, axis=0, keepdims=False
+            ),
+            microbatches,
+        )
+        x_in = tmap(lambda f, b: jnp.where(stage == 0, f, b), feed, buf)
+        y = _stage_apply(fn, x_in, params)
+        # bubble ticks produce garbage; zero it so the output scatter and
+        # the ppermute hand clean values downstream
+        y = tmap(lambda v: jnp.where(active, v, jnp.zeros_like(v)), y)
+        is_last = stage == size - 1
+        outputs = tmap(
+            lambda outs, v: jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(
+                    active & is_last,
+                    v,
+                    jax.lax.dynamic_index_in_dim(
+                        outs, idx, axis=0, keepdims=False
+                    ),
+                ),
+                idx,
+                axis=0,
+            ),
+            outputs,
+            y,
+        )
+        buf = tmap(lambda v: jax.lax.ppermute(v, axis_name, perm_fwd), y)
+        return (buf, outputs), None
+
+    # pvary: the carry becomes device-varying after one tick (it depends on
+    # the stage index), so the initial carry must carry the same varying-
+    # axis type or scan rejects the carry signature (shard_map vma rule)
+    _pcast = getattr(jax.lax, "pcast", None)
+    if _pcast is not None:
+        vary = lambda v: _pcast(v, axis_name, to="varying")  # noqa: E731
+    else:  # older jax
+        vary = lambda v: jax.lax.pvary(v, (axis_name,))  # noqa: E731
+    buf0 = tmap(lambda mbs: vary(jnp.zeros_like(mbs[0])), microbatches)
+    out0 = tmap(lambda mbs: vary(jnp.zeros_like(mbs)), microbatches)
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
+    # only the last stage holds real outputs; broadcast to all stages
+    return tmap(
+        lambda outs: jax.lax.psum(
+            jnp.where(stage == size - 1, outs, jnp.zeros_like(outs)), axis_name
+        ),
+        outputs,
+    )
+
+
+def pipeline_apply(
+    params: Params,
+    x: jax.Array,
+    fn: "Callable[[jax.Array, Params], jax.Array]",
+    mesh: Mesh,
+    axis_name: str = "pp",
+    microbatches: int = 4,
+    batch_axes: "Optional[tuple]" = None,
+    seq_axis: "Optional[str]" = None,
+    seq_dim: int = 1,
+) -> jax.Array:
+    """GPipe-apply a stacked-layer model over the ``pp`` mesh axis.
+
+    The shard_map is *partial-manual* (``axis_names={pp[, seq_axis]}``):
+    only the pipeline axis (and, when given, the sequence-parallel axis the
+    stage fn handles itself, e.g. ring attention over cp) is manual; every
+    other mesh axis stays automatic, so dp/fsdp batch sharding and fsdp/tp
+    weight sharding flow through from the inputs' shardings with XLA
+    placing the collectives — stage weights are NOT replicated.
+
+    Args:
+        params: pytree with leading layer dim ``[L]``; ``L`` must divide by
+            the pp axis size (each stage takes ``L/S`` consecutive layers).
+        x: ``[B, ...]`` activations; ``B`` must divide by ``microbatches``.
+            May be a PYTREE of ``[B, ...]`` leaves (side streams ride the
+            pipe — e.g. a per-example MoE aux-loss accumulator); the
+            sequence sharding (``seq_axis``) applies to leaves with a
+            ``seq_dim`` to shard (ndim > seq_dim).
+        fn: one layer step ``fn(x_mb, layer_params) -> x_mb`` over the
+            activation (pytree). With ``seq_axis`` the fn runs in manual
+            context over that axis too (it may call e.g.
+            ring_attention_local or ulysses_attention_local over it) and
+            receives the local sequence chunk.
+        mesh: mesh containing ``axis_name``.
+        microbatches: GPipe microbatch count M (bubble = (S-1)/(M+S-1)).
+        batch_axes: unused (kept for call-site stability); batch sharding
+            over dp/fsdp/ep is automatic in partial-manual mode.
+        seq_axis: optional mesh axis the sequence dim is sharded over
+            (manual: the stage fn owns its collectives).
+        seq_dim: which dim of ``x`` is the sequence (default 1, [B, T, E]).
+
+    Returns outputs with x's structure and sharding.
+    """
+    del batch_axes  # automatic in partial-manual mode
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    if seq_axis is not None and seq_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {seq_axis!r} axis: {mesh.axis_names}")
+    stages = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    n_layers = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if n_layers % stages != 0:
+        raise ValueError(
+            f"layer count {n_layers} not divisible by pp axis size {stages}"
+        )
+    x_leaves, x_treedef = jax.tree_util.tree_flatten(x)
+    b = x_leaves[0].shape[0]
+    if b % microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {microbatches}")
+    mb = b // microbatches
+    x_mb = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((microbatches, mb) + leaf.shape[1:]), x
+    )
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), params
+    )
+
+    def leaf_spec(leaf: jax.Array) -> P:
+        entries: "list" = [None] * (leaf.ndim + 1)
+        if seq_axis is not None and leaf.ndim > seq_dim:
+            entries[seq_dim + 1] = seq_axis  # +1 for the microbatch dim
+        return P(*entries)
+
+    data_specs = jax.tree_util.tree_map(leaf_spec, x)
+
+    manual = {axis_name} if seq_axis is None else {axis_name, seq_axis}
+    out = jax.shard_map(
+        functools.partial(pipeline_apply_local, fn=fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, data_specs),
+        out_specs=data_specs,
+        axis_names=manual,
+    )(params, x_mb)
+    return jax.tree_util.tree_map(
+        lambda o, leaf: o.reshape(leaf.shape), out, x
+    )
+
+
+__all__ = ["pipeline_apply", "pipeline_apply_local"]

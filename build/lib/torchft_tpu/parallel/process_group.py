@@ -555,25 +555,12 @@ class ProcessGroupTCP(ProcessGroup):
             return
         from torchft_tpu.utils.logging import log_event
 
-        # Entire dump is best-effort inside the try: abort() may race the
-        # worker/sender threads still inserting keys into the same dict
-        # (dict(f) can raise "changed size during iteration"), and nothing
-        # here may ever mask the underlying collective error.
+        f = dict(f)
+        deadline = f.pop("deadline_mono", None)
+        if deadline is not None:
+            f["deadline_remaining_s"] = round(deadline - time.monotonic(), 3)
+        f["in_flight_s"] = round(time.time() - f.pop("started_at"), 3)
         try:
-            for _ in range(3):
-                try:
-                    f = dict(f)
-                    break
-                except RuntimeError:  # concurrent key insertion mid-copy
-                    continue
-            deadline = f.pop("deadline_mono", None)
-            if deadline is not None:
-                f["deadline_remaining_s"] = round(
-                    deadline - time.monotonic(), 3
-                )
-            started = f.pop("started_at", None)
-            if started is not None:
-                f["in_flight_s"] = round(time.time() - started, 3)
             log_event("abort", reason, **f)
         except Exception:  # noqa: BLE001 - recorder must never mask the error
             logger.exception("flight-recorder dump failed")

@@ -1,0 +1,17 @@
+from torchft_tpu.utils.futures import (
+    context_timeout,
+    future_timeout,
+    future_wait,
+)
+from torchft_tpu.utils.logging import ReplicaLogger, log_event, recent_events
+from torchft_tpu.utils.rwlock import RWLock
+
+__all__ = [
+    "RWLock",
+    "context_timeout",
+    "future_timeout",
+    "future_wait",
+    "log_event",
+    "recent_events",
+    "ReplicaLogger",
+]

@@ -61,27 +61,19 @@ class EventExporter(ABC):
 
 
 class RingExporter(EventExporter):
-    """Bounded in-memory ring of the most recent events.
-
-    Internally locked: exports arrive from any thread (the pipeline calls
-    exporters outside its own lock for re-entrancy) while readers snapshot
-    — iterating a deque concurrently with appends raises RuntimeError."""
+    """Bounded in-memory ring of the most recent events."""
 
     def __init__(self, maxlen: int = _EVENT_RING_SIZE) -> None:
         self._events: "Deque[Dict[str, Any]]" = collections.deque(maxlen=maxlen)
-        self._ring_lock = threading.Lock()
 
     def export(self, record: "Dict[str, Any]") -> None:
-        with self._ring_lock:
-            self._events.append(record)
+        self._events.append(record)
 
     def events(self) -> "List[Dict[str, Any]]":
-        with self._ring_lock:
-            return list(self._events)
+        return list(self._events)
 
     def clear(self) -> None:
-        with self._ring_lock:
-            self._events.clear()
+        self._events.clear()
 
 
 class CallbackExporter(EventExporter):

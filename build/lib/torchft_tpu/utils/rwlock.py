@@ -1,0 +1,84 @@
+"""Readers-writer lock with timeout-guarded acquisition.
+
+TPU-native analog of the reference's checkpoint RWLock
+(reference: torchft/checkpointing/_rwlock.py:41-131): many readers may hold
+the lock concurrently (e.g. checkpoint transports serving a state snapshot)
+while a single writer (the optimizer step mutating parameters) excludes all
+readers.  Every acquisition takes a timeout so a stuck peer can never wedge
+the training loop forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+
+class RWLock:
+    """A two-mutex readers-writer lock.
+
+    Writer preference is not enforced; fairness comes from the underlying
+    primitive. All acquires raise TimeoutError on expiry rather than blocking
+    forever, which is the property the fault-tolerance protocol needs.
+    """
+
+    def __init__(self, timeout: float = -1) -> None:
+        # Default timeout applied when an acquire doesn't pass its own.
+        self._default_timeout = timeout
+        self._reader_lock = threading.Lock()
+        self._writer_lock = threading.Lock()
+        self._readers = 0
+
+    def _resolve(self, timeout: float | None) -> float:
+        return self._default_timeout if timeout is None else timeout
+
+    def acquire_read(self, timeout: float | None = None) -> None:
+        t = self._resolve(timeout)
+        # Single deadline across both mutex acquisitions so the configured
+        # timeout bounds the total wait, not each stage.
+        deadline = time.monotonic() + t if t >= 0 else None
+        if not self._reader_lock.acquire(timeout=t):
+            raise TimeoutError(f"acquire_read timed out after {t}s")
+        try:
+            self._readers += 1
+            if self._readers == 1:
+                # First reader takes the writer lock on behalf of all readers.
+                remaining = t if deadline is None else max(0.0, deadline - time.monotonic())
+                if not self._writer_lock.acquire(timeout=remaining):
+                    self._readers -= 1
+                    raise TimeoutError(f"acquire_read timed out after {t}s")
+        finally:
+            self._reader_lock.release()
+
+    def release_read(self) -> None:
+        with self._reader_lock:
+            assert self._readers > 0, "release_read without acquire_read"
+            self._readers -= 1
+            if self._readers == 0:
+                self._writer_lock.release()
+
+    def acquire_write(self, timeout: float | None = None) -> None:
+        t = self._resolve(timeout)
+        if not self._writer_lock.acquire(timeout=t):
+            raise TimeoutError(f"acquire_write timed out after {t}s")
+
+    def release_write(self) -> None:
+        self._writer_lock.release()
+
+    @contextmanager
+    def r_lock(self, timeout: float | None = None) -> Iterator[None]:
+        self.acquire_read(timeout)
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def w_lock(self, timeout: float | None = None) -> Iterator[None]:
+        self.acquire_write(timeout)
+        try:
+            yield
+        finally:
+            self.release_write()

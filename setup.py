@@ -1,0 +1,37 @@
+"""Build hook: compile the native coordination core into the wheel.
+
+``pip wheel .`` / ``pip install .`` (non-editable) run the ``native/``
+Makefile and ship the resulting ``libtorchft_tpu_native.so`` inside the
+``torchft_tpu`` package (found at import time by ``torchft_tpu._native``'s
+search order).  Editable installs (``pip install -e .``) skip this — the
+repo-layout ``native/`` directory is used directly, building on first
+import if needed.
+
+Reference analog: the Rust core's build.rs + maturin wiring
+(/root/reference/pyproject.toml, /root/reference/build.rs); C++ here.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNativeThenPy(build_py):
+    def run(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        native_dir = os.path.join(root, "native")
+        lib = os.path.join(native_dir, "libtorchft_tpu_native.so")
+        if os.path.isdir(native_dir):
+            subprocess.run(
+                ["make", "-C", native_dir, "-j", str(os.cpu_count() or 2)],
+                check=True,
+            )
+            # stage the .so inside the package so package-data picks it up
+            shutil.copy2(lib, os.path.join(root, "torchft_tpu"))
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildNativeThenPy})

@@ -125,3 +125,51 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(n)
+
+
+class TestAutoAttnImpl:
+    """attn_impl='auto' (the default) resolves TPU-first: flash when the
+    sequence is lane-aligned and unsharded, ring on cp meshes, dense as
+    the logged fallback (VERDICT r03 #7)."""
+
+    def test_default_is_auto(self):
+        assert tfm.TransformerConfig().attn_impl == "auto"
+
+    def test_resolution_rules(self, monkeypatch):
+        cfg = _tiny_cfg()  # attn_impl defaults to auto
+        # platform-aware: flash only where the Pallas kernel compiles
+        # natively (interpret mode off-TPU is orders of magnitude slower
+        # than XLA dense, so auto prefers dense there)
+        on_tpu = "flash" if jax.default_backend() == "tpu" else "dense"
+        assert tfm._resolve_attn_impl(cfg, None, False, 128) == on_tpu
+        assert tfm._resolve_attn_impl(cfg, None, False, 100) == "dense"
+        assert tfm._resolve_attn_impl(cfg, None, True, 128) == "ring"
+        cp_mesh = _mesh((2,), ("cp",))
+        assert tfm._resolve_attn_impl(cfg, cp_mesh, False, 128) == "ring"
+        dp_mesh = _mesh((2,), ("dp",))
+        assert tfm._resolve_attn_impl(cfg, dp_mesh, False, 128) == on_tpu
+        # explicit settings are never overridden
+        cfg_d = _tiny_cfg(attn_impl="dense")
+        assert tfm._resolve_attn_impl(cfg_d, None, False, 128) == "dense"
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert tfm._resolve_attn_impl(cfg, None, False, 1024) == "flash"
+
+    def test_auto_forward_matches_explicit_flash(self):
+        # the auto path's numerics must agree with both explicit impls at
+        # an aligned T (flash itself is verified against dense in
+        # test_flash_attention; here we pin the auto dispatch)
+        cfg_a = _tiny_cfg(dtype=jnp.float32)
+        cfg_d = _tiny_cfg(dtype=jnp.float32, attn_impl="dense")
+        assert cfg_a.attn_impl == "auto"
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg_a)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, cfg_a.vocab_size)
+        la = tfm.forward(params, toks, cfg_a)
+        ld = tfm.forward(params, toks, cfg_d)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(ld), atol=2e-5, rtol=1e-5)
+
+    def test_auto_unaligned_falls_back_to_dense(self, caplog):
+        cfg = _tiny_cfg(dtype=jnp.float32)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 20), 0, cfg.vocab_size)
+        logits = tfm.forward(params, toks, cfg)  # must not raise
+        assert logits.shape == (1, 20, cfg.vocab_size)
