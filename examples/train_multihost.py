@@ -49,6 +49,13 @@ def parse_args(argv=None):
     p.add_argument("--cpu-devices", type=int, default=2,
                    help="virtual CPU devices per process (test mode)")
     p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--chaos", action="store_true",
+                   help="kill one whole group's processes mid-run, restart "
+                        "them, and require bitwise convergence after the "
+                        "supersession rejoin + live heal")
+    p.add_argument("--step-sleep", type=float, default=0.0,
+                   help="pacing sleep per training step (gives the chaos "
+                        "restart a window to overlap the survivors' run)")
     # worker mode (spawned by the launcher above, or run per-host manually)
     p.add_argument("--worker", action="store_true")
     p.add_argument("--group-id", type=int, default=0)
@@ -122,10 +129,15 @@ def worker(args) -> int:
         out_shardings=(None, repl),
     )
 
+    import time
+
     rng = np.random.default_rng(1000 + gid)  # same data on every group rank
+    first_commit = None
     try:
         while manager.current_step() < args.steps:
             step = manager.current_step()
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
             xs_np = rng.standard_normal((batch, dim)).astype(np.float32)
             ys_np = xs_np @ np.arange(dim, dtype=np.float32)
             # every process contributes only its addressable shards of the
@@ -144,6 +156,14 @@ def worker(args) -> int:
                 timeout=30
             )
             if manager.should_commit():
+                if first_commit is None:
+                    # a healed rejoiner's first commit lands at the
+                    # survivors' step, not 0 — the chaos launcher asserts
+                    # this to prove the live heal actually ran.  Read the
+                    # step from the manager (post-commit, minus one), NOT
+                    # the loop's pre-quorum `step`: healing updates
+                    # current_step inside start_quorum.
+                    first_commit = manager.current_step() - 1
                 state["params"] = {
                     "w": state["params"]["w"] - 0.1 * jnp.asarray(avg["w"])
                 }
@@ -151,6 +171,7 @@ def worker(args) -> int:
             np.asarray(state["params"]["w"]).tobytes()
         ).hexdigest()[:16]
         print(f"[{tag}] done step={manager.current_step()} "
+              f"first_commit={first_commit} "
               f"loss={float(loss):.5f} params_sha={digest}", flush=True)
         return 0
     finally:
@@ -165,7 +186,18 @@ def _free_port() -> int:
 
 
 def launch(args) -> int:
-    """Spawn groups x procs real worker processes against one Lighthouse."""
+    """Spawn groups x procs real worker processes against one Lighthouse.
+
+    ``--chaos``: mid-run, one whole group's processes are SIGKILLed (no
+    shutdown, no leave RPC — the hard-failure shape) and respawned with a
+    fresh jax.distributed coordinator; the new incarnation supersedes the
+    dead one at the lighthouse, heals its state live from a surviving
+    group, and the run must still end with every process bitwise-equal.
+    Reference analog: restart semantics torchft/manager_integ_test.py:
+    236-249 over real spawned workers (fsdp_test.py:96-120).
+    """
+    import time
+
     from torchft_tpu.coordination import LighthouseServer, StoreServer
 
     # quorum formation waits for every group — otherwise a fast-starting
@@ -174,26 +206,65 @@ def launch(args) -> int:
         min_replicas=args.groups, join_timeout_ms=200
     )
     stores = [StoreServer() for _ in range(args.groups)]
-    procs = []
+
+    def spawn_group(g: int) -> "list[subprocess.Popen]":
+        coord = f"127.0.0.1:{_free_port()}"
+        group_procs = []
+        for p in range(args.procs_per_group):
+            cmd = [
+                sys.executable, os.path.abspath(__file__), "--worker",
+                "--group-id", str(g), "--process-id", str(p),
+                "--procs-per-group", str(args.procs_per_group),
+                "--cpu-devices", str(args.cpu_devices),
+                "--steps", str(args.steps),
+                "--min-replicas", str(args.min_replicas),
+                "--step-sleep", str(args.step_sleep),
+                "--coordinator", coord,
+                "--store-addr", stores[g].address(),
+                "--lighthouse", lighthouse.address(),
+            ]
+            group_procs.append(subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        return group_procs
+
+    groups = [spawn_group(g) for g in range(args.groups)]
+    killed_out = ""
     try:
-        for g in range(args.groups):
-            coord = f"127.0.0.1:{_free_port()}"
-            for p in range(args.procs_per_group):
-                cmd = [
-                    sys.executable, os.path.abspath(__file__), "--worker",
-                    "--group-id", str(g), "--process-id", str(p),
-                    "--procs-per-group", str(args.procs_per_group),
-                    "--cpu-devices", str(args.cpu_devices),
-                    "--steps", str(args.steps),
-                    "--min-replicas", str(args.min_replicas),
-                    "--coordinator", coord,
-                    "--store-addr", stores[g].address(),
-                    "--lighthouse", lighthouse.address(),
-                ]
-                procs.append(subprocess.Popen(
-                    cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                    text=True,
-                ))
+        if args.chaos:
+            victim = args.groups - 1
+            # kill only after real progress: poll the lighthouse (quorum
+            # members report their step) until every group has committed a
+            # few steps, then hard-kill the victim group's processes
+            # (SIGKILL: no Manager.shutdown, no store cleanup, heartbeats
+            # just stop)
+            from torchft_tpu.coordination import LighthouseClient
+
+            lc = LighthouseClient(lighthouse.address())
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                status = lc.status()
+                members = (status.get("prev_quorum") or {}).get(
+                    "participants", []
+                )
+                if members and min(m["step"] for m in members) >= 3:
+                    break
+                time.sleep(0.25)
+            else:
+                raise RuntimeError("no training progress before chaos kill")
+            lc.close()
+            for p in groups[victim]:
+                p.kill()
+            for p in groups[victim]:
+                killed_out += p.communicate()[0] or ""
+            print(f"[chaos] killed group {victim} "
+                  f"({args.procs_per_group} processes)", flush=True)
+            # respawn: new incarnation, fresh coordinator, same store
+            groups[victim] = spawn_group(victim)
+            print(f"[chaos] restarted group {victim}", flush=True)
+
+        procs = [p for grp in groups for p in grp]
         outs = [p.communicate(timeout=240)[0] for p in procs]
         rc = max(p.returncode for p in procs)
         hashes = set()
@@ -202,18 +273,43 @@ def launch(args) -> int:
             for line in out.splitlines():
                 if "params_sha=" in line:
                     hashes.add(line.rsplit("params_sha=", 1)[1].strip())
+        if killed_out:
+            print("[chaos] killed incarnation output:")
+            print(killed_out, end="")
+        if args.chaos and rc == 0:
+            # prove the LIVE HEAL ran: the restarted incarnation's first
+            # commit must land at the survivors' step, not replay from 0
+            victim_firsts = []
+            for p_ in groups[args.groups - 1]:
+                i = procs.index(p_)
+                for line in outs[i].splitlines():
+                    if "first_commit=" in line:
+                        victim_firsts.append(
+                            int(line.split("first_commit=")[1].split()[0])
+                        )
+            if not victim_firsts or min(victim_firsts) == 0:
+                print(f"ERROR: restarted group did not heal forward "
+                      f"(first commits {victim_firsts}) — kill landed "
+                      f"before any survivor commit, or heal was skipped")
+                rc = 1
+            else:
+                print(f"[chaos] restarted group healed to step "
+                      f"{min(victim_firsts)} before its first commit")
         if rc == 0 and len(hashes) == 1 and outs:
             n = args.groups * args.procs_per_group
+            suffix = " after chaos kill+rejoin" if args.chaos else ""
             print(f"params converged bitwise across {n} processes "
-                  f"({args.groups} groups x {args.procs_per_group} hosts)")
+                  f"({args.groups} groups x {args.procs_per_group} hosts)"
+                  f"{suffix}")
         elif rc == 0:
             print(f"ERROR: divergent params across processes: {hashes}")
             rc = 1
         return rc
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        for grp in groups:
+            for p in grp:
+                if p.poll() is None:
+                    p.kill()
         for s in stores:
             s.shutdown()
         lighthouse.shutdown()

@@ -24,13 +24,23 @@ class BuildNativeThenPy(build_py):
         root = os.path.dirname(os.path.abspath(__file__))
         native_dir = os.path.join(root, "native")
         lib = os.path.join(native_dir, "libtorchft_tpu_native.so")
+        staged = os.path.join(root, "torchft_tpu", "libtorchft_tpu_native.so")
         if os.path.isdir(native_dir):
             subprocess.run(
                 ["make", "-C", native_dir, "-j", str(os.cpu_count() or 2)],
                 check=True,
             )
             # stage the .so inside the package so package-data picks it up
-            shutil.copy2(lib, os.path.join(root, "torchft_tpu"))
+            shutil.copy2(lib, staged)
+        elif not os.path.exists(staged):
+            # never produce a green build with no native core in it: an
+            # sdist missing native/ (MANIFEST.in grafts it) would otherwise
+            # ship a package that fails at first import
+            raise RuntimeError(
+                "native/ source tree not found and no prebuilt "
+                "libtorchft_tpu_native.so staged — refusing to build a "
+                "wheel without the native core"
+            )
         super().run()
 
 

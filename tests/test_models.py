@@ -154,13 +154,19 @@ class TestAutoAttnImpl:
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
         assert tfm._resolve_attn_impl(cfg, None, False, 1024) == "flash"
 
-    def test_auto_forward_matches_explicit_flash(self):
-        # the auto path's numerics must agree with both explicit impls at
-        # an aligned T (flash itself is verified against dense in
-        # test_flash_attention; here we pin the auto dispatch)
+    def test_auto_forward_matches_explicit_flash(self, monkeypatch):
+        # force the auto->flash dispatch even off-TPU (interpret-mode
+        # kernel), so the dispatch wiring is actually exercised in CI —
+        # without the patch auto resolves to dense here and the test
+        # would compare dense against dense
+        from torchft_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(tfm.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(fa, "_interpret", lambda: True)
         cfg_a = _tiny_cfg(dtype=jnp.float32)
         cfg_d = _tiny_cfg(dtype=jnp.float32, attn_impl="dense")
         assert cfg_a.attn_impl == "auto"
+        assert tfm._resolve_attn_impl(cfg_a, None, False, 128) == "flash"
         params = tfm.init_params(jax.random.PRNGKey(0), cfg_a)
         toks = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, cfg_a.vocab_size)
         la = tfm.forward(params, toks, cfg_a)

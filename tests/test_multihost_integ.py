@@ -30,3 +30,22 @@ def test_two_groups_of_two_processes_converge():
     # handoff and committed every step
     for tag in ("g0p0", "g0p1", "g1p0", "g1p1"):
         assert f"[{tag}] done step=3" in out.stdout, out.stdout
+
+
+def test_chaos_kill_group_rejoin_heal_converge():
+    """VERDICT r3 item #4: kill one whole group's REAL processes mid-run
+    (SIGKILL, no shutdown), restart them; the new incarnation supersedes
+    the dead one at the lighthouse, heals live from a surviving group
+    (first commit lands at the survivors' step, not 0), and the run ends
+    bitwise-converged across every process.
+    Reference: torchft/manager_integ_test.py:236-249 (restart semantics),
+    fsdp_test.py:96-120 (real spawned workers)."""
+    out = subprocess.run(
+        [sys.executable, "examples/train_multihost.py",
+         "--groups", "2", "--procs-per-group", "2", "--steps", "10",
+         "--chaos", "--step-sleep", "0.4"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "after chaos kill+rejoin" in out.stdout, out.stdout
+    assert "restarted group healed to step" in out.stdout, out.stdout

@@ -149,6 +149,16 @@ class QuorumResult:
 # ---------------------------------------------------------------------------
 
 
+def parse_host_port(addr: str) -> "tuple[str, int]":
+    """Split "host:port" (including "[v6]:port" and ":port") — the one
+    address parser shared by every client/probe in the package."""
+    if addr.startswith("["):
+        host, _, port = addr[1:].partition("]:")
+        return host, int(port)
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
 class RpcError(RuntimeError):
     pass
 
@@ -163,11 +173,7 @@ class _RpcClient:
         self._lock = threading.Lock()
 
     def _host_port(self) -> "tuple[str, int]":
-        if self._addr.startswith("["):
-            host, _, port = self._addr[1:].partition("]:")
-            return host, int(port)
-        host, _, port = self._addr.rpartition(":")
-        return host or "127.0.0.1", int(port)
+        return parse_host_port(self._addr)
 
     def _connect(self, deadline: float) -> socket.socket:
         host, port = self._host_port()

@@ -218,12 +218,41 @@ class Manager:
             store.set(MANAGER_ADDR_KEY, self._manager_server.address())
             store.set(REPLICA_ID_KEY, new_replica_id)
 
-        addr = store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout)
-        self._replica_id = store.get(REPLICA_ID_KEY, timeout=self._connect_timeout)
+        # Non-zero ranks discover the group's ManagerServer through the
+        # store.  After a whole-group fast restart the store still holds
+        # the DEAD incarnation's address until the new rank 0 republishes
+        # — probe the endpoint and re-read until a live server answers
+        # (bounded by connect_timeout), instead of wiring this Manager to
+        # a corpse for its whole lifetime.
+        deadline = time.monotonic() + self._connect_timeout
+        while True:
+            addr = store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout)
+            self._replica_id = store.get(
+                REPLICA_ID_KEY, timeout=self._connect_timeout
+            )
+            if self._manager_server is not None or self._endpoint_alive(addr):
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"manager server at {addr} (from store) unreachable "
+                    f"within connect_timeout={self._connect_timeout}s"
+                )
+            time.sleep(0.25)
         self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
         store.close()
 
         self._logger = ReplicaLogger(self, self._replica_id, self._group_rank)
+
+    @staticmethod
+    def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
+        """True if a TCP listener answers at ``addr`` ("host:port")."""
+        from torchft_tpu.coordination import parse_host_port
+
+        try:
+            with socket.create_connection(parse_host_port(addr), probe_timeout):
+                return True
+        except OSError:
+            return False
 
     # ------------------------------------------------------------------
     # state dict registry

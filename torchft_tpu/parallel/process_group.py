@@ -321,9 +321,13 @@ class ProcessGroupTCP(ProcessGroup):
         self._errored: Optional[Exception] = None
         self._aborted = False
         self._generation = 0
-        # In-flight op record for the abort flight recorder (written by the
-        # worker thread; read best-effort by _dump_flight from abort()).
+        # In-flight op record for the abort flight recorder.  Guarded by
+        # _flight_lock: written by the worker + sender threads, dumped by
+        # abort() from any thread (an unguarded dict copy can raise
+        # "changed size during iteration" on exactly the contended aborts
+        # the recorder exists for).
         self._flight: "Optional[Dict[str, Any]]" = None
+        self._flight_lock = threading.Lock()
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
         self._sender: "Optional[concurrent_futures.ThreadPoolExecutor]" = None
@@ -509,16 +513,18 @@ class ProcessGroupTCP(ProcessGroup):
                     errored or _PGAborted("process group reconfigured")
                 )
                 continue
-            self._flight = {
-                "op": op,
-                "generation": item_gen,
-                "rank": self._rank,
-                "world": self._world,
-                "started_at": time.time(),
-            }
+            with self._flight_lock:
+                self._flight = {
+                    "op": op,
+                    "generation": item_gen,
+                    "rank": self._rank,
+                    "world": self._world,
+                    "started_at": time.time(),
+                }
             try:
                 fut.set_result(fn())
-                self._flight = None
+                with self._flight_lock:
+                    self._flight = None
             except Exception as e:  # noqa: BLE001 - latch every op failure
                 # Flight-recorder dump BEFORE latching: when a wedged
                 # collective dies (deadline, peer reset), the op-level state
@@ -535,37 +541,31 @@ class ProcessGroupTCP(ProcessGroup):
     # -- flight recorder ---------------------------------------------------
 
     def _flight_io(self, **kw: Any) -> None:
-        """Worker-thread-only: merge current transfer state (direction,
-        peer, tag, bytes) into the in-flight op record."""
-        f = self._flight
-        if f is not None:
-            f.update(kw)
+        """Merge current transfer state (direction, peer, tag, bytes) into
+        the in-flight op record (worker or sender thread)."""
+        with self._flight_lock:
+            if self._flight is not None:
+                self._flight.update(kw)
 
     def _flight_progress(self, nbytes: int) -> None:
-        f = self._flight
-        if f is not None:
-            f["bytes_done"] = f.get("bytes_done", 0) + nbytes
+        with self._flight_lock:
+            f = self._flight
+            if f is not None:
+                f["bytes_done"] = f.get("bytes_done", 0) + nbytes
 
     def _dump_flight(self, reason: str) -> None:
         """Write the in-flight op table to the structured event pipeline
         (JSONL sink when TORCHFT_EVENTS_FILE is set)."""
-        f = self._flight
-        self._flight = None
-        if f is None:
-            return
+        with self._flight_lock:
+            f = self._flight
+            self._flight = None
+            if f is None:
+                return
+            f = dict(f)
         from torchft_tpu.utils.logging import log_event
 
-        # Entire dump is best-effort inside the try: abort() may race the
-        # worker/sender threads still inserting keys into the same dict
-        # (dict(f) can raise "changed size during iteration"), and nothing
-        # here may ever mask the underlying collective error.
+        # Best-effort: the recorder must never mask the collective error.
         try:
-            for _ in range(3):
-                try:
-                    f = dict(f)
-                    break
-                except RuntimeError:  # concurrent key insertion mid-copy
-                    continue
             deadline = f.pop("deadline_mono", None)
             if deadline is not None:
                 f["deadline_remaining_s"] = round(
