@@ -284,10 +284,12 @@ def launch(args) -> int:
                 i = procs.index(p_)
                 for line in outs[i].splitlines():
                     if "first_commit=" in line:
-                        victim_firsts.append(
-                            int(line.split("first_commit=")[1].split()[0])
-                        )
-            if not victim_firsts or min(victim_firsts) == 0:
+                        val = line.split("first_commit=")[1].split()[0]
+                        # "None" = the restarted worker healed straight to
+                        # the final step and never committed — counts as
+                        # heal-not-proven, not a launcher crash
+                        victim_firsts.append(-1 if val == "None" else int(val))
+            if not victim_firsts or min(victim_firsts) <= 0:
                 print(f"ERROR: restarted group did not heal forward "
                       f"(first commits {victim_firsts}) — kill landed "
                       f"before any survivor commit, or heal was skipped")

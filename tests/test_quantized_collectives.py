@@ -226,3 +226,147 @@ def test_quantize_subnormal_rows_stay_finite():
     np.testing.assert_array_equal(out[0], 0.0)  # sub-quantizable -> zero
     np.testing.assert_array_equal(out[1], 0.0)
     np.testing.assert_allclose(out[2], 1.0, atol=1e-2)
+
+
+class TestFp8Wire:
+    """fp8_e4m3 wire format (the reference's SM90 fp8e4nv analog,
+    torchft/quantization.py:30-41): same 1 byte/element wire size as int8,
+    host codec only (device kernel path stays int8, mirroring the
+    reference's hardware gating)."""
+
+    def test_codec_round_trip(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((16, 256)).astype(np.float32)
+        scales, payload = q.quantize(a, q.WIRE_FP8)
+        assert payload.itemsize == 1
+        out = q.dequantize(scales, payload, a.shape, a.dtype)
+        # e4m3 relative step is 2^-3 of the exponent bucket; bound per
+        # element by absmax/448 * (448/|x| rounding) <= |x| * 2^-3 + lsb
+        bound = np.abs(a) * (2.0 ** -3) + (
+            np.abs(a).max(axis=1, keepdims=True) / 448.0
+        )
+        assert np.all(np.abs(out - a) <= bound + 1e-7)
+
+    def test_pack_unpack_fp8(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        scales, payload = q.quantize(a, q.WIRE_FP8)
+        s2, p2 = q.unpack(
+            q.pack(scales, payload, q.WIRE_FP8), 3, 4, q.WIRE_FP8
+        )
+        np.testing.assert_array_equal(scales, s2)
+        np.testing.assert_array_equal(
+            payload.view(np.uint8), p2.view(np.uint8)
+        )
+
+    def test_allreduce_fp8_wire(self, store):  # noqa: F811
+        world = 2
+        pgs = make_group(store, world, prefix="fp8ar")
+        rng = np.random.default_rng(11)
+        data = [
+            [rng.standard_normal((40, 50)).astype(np.float32)]
+            for _ in range(world)
+        ]
+        expected = sum(d[0] for d in data)
+
+        def run(rank, _):
+            w = allreduce_quantized(
+                data[rank], REDUCE_SUM, pgs[rank], wire_dtype=q.WIRE_FP8
+            )
+            out = w.wait(timeout=30)
+            return out, w.wire_bytes, w.wire_dtype
+
+        results = run_parallel(world, run)
+        for (got,), wire_bytes, wd in results:
+            assert wd == q.WIRE_FP8
+            rel = np.abs(got - expected).max() / np.abs(expected).max()
+            assert rel < 0.1, f"fp8 error too large: {rel}"
+        # identical wire size to the int8 leg (1 byte payload + f32 scales)
+        def run_int8(rank, _):
+            w = allreduce_quantized(data[rank], REDUCE_SUM, pgs[rank])
+            w.wait(timeout=30)
+            return w.wire_bytes
+
+        int8_bytes = run_parallel(world, run_int8)
+        assert results[0][1] == int8_bytes[0]
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter_fp8(self, store):  # noqa: F811
+        world = 2
+        pgs = make_group(store, world, prefix="fp8rs")
+        rng = np.random.default_rng(12)
+        data = [rng.standard_normal((8, 6)).astype(np.float32) for _ in range(world)]
+        expected = sum(data)
+
+        def run(rank, _):
+            return reduce_scatter_quantized(
+                data[rank], REDUCE_SUM, pgs[rank], wire_dtype=q.WIRE_FP8
+            ).wait(timeout=30)
+
+        results = run_parallel(world, run)
+        for rank, got in enumerate(results):
+            want = expected[rank * 4 : (rank + 1) * 4]
+            rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+            assert rel < 0.1
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_device_quantize_rejects_fp8(self, store):  # noqa: F811
+        (pg,) = make_group(store, 1, prefix="fp8dev")
+        with pytest.raises(ValueError, match="int8 wire only"):
+            allreduce_quantized(
+                [np.ones(4, np.float32)], REDUCE_SUM, pg,
+                device_quantize=True, wire_dtype=q.WIRE_FP8,
+            )
+        pg.shutdown()
+
+    def test_env_default_wire(self, store, monkeypatch):  # noqa: F811
+        monkeypatch.setenv("TORCHFT_QUANT_WIRE", q.WIRE_FP8)
+        world = 2
+        pgs = make_group(store, world, prefix="fp8env")
+
+        def run(rank, _):
+            w = allreduce_quantized(
+                [np.full(8, float(rank + 1), np.float32)], REDUCE_SUM, pgs[rank]
+            )
+            w.wait(timeout=30)
+            return w.wire_dtype
+
+        assert set(run_parallel(world, run)) == {q.WIRE_FP8}
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_unknown_wire_rejected(self, store):  # noqa: F811
+        (pg,) = make_group(store, 1, prefix="badwire")
+        with pytest.raises(ValueError, match="wire_dtype"):
+            allreduce_quantized(
+                [np.ones(4, np.float32)], REDUCE_SUM, pg, wire_dtype="int4"
+            )
+        pg.shutdown()
+
+    def test_wire_mismatch_fails_loudly(self):
+        # divergent TORCHFT_QUANT_WIRE across ranks must error at unpack,
+        # never silently decode the other grid (the on-wire header check)
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        buf = q.pack(*q.quantize(a, q.WIRE_FP8), q.WIRE_FP8)
+        with pytest.raises(ValueError, match="wire format mismatch"):
+            q.unpack(buf, 2, 4, q.WIRE_INT8)
+        buf8 = q.pack(*q.quantize(a))
+        with pytest.raises(ValueError, match="wire format mismatch"):
+            q.unpack(buf8, 2, 4, q.WIRE_FP8)
+
+    def test_reduce_scatter_env_default(self, store, monkeypatch):  # noqa: F811
+        monkeypatch.setenv("TORCHFT_QUANT_WIRE", q.WIRE_FP8)
+        world = 2
+        pgs = make_group(store, world, prefix="fp8rsenv")
+        data = [np.full((4, 4), float(r + 1), np.float32) for r in range(world)]
+
+        def run(rank, _):
+            return reduce_scatter_quantized(
+                data[rank], REDUCE_SUM, pgs[rank]
+            ).wait(timeout=30)
+
+        for rank, got in enumerate(run_parallel(world, run)):
+            np.testing.assert_allclose(got, 3.0, rtol=0.1)
+        for pg in pgs:
+            pg.shutdown()

@@ -584,7 +584,12 @@ class Manager:
             managed = Work(out)
             # surface the quantized path's wire accounting on the returned
             # handle (set synchronously by allreduce_quantized)
-            for attr in ("wire_bytes", "unquantized_wire_bytes", "device_quantized"):
+            for attr in (
+                "wire_bytes",
+                "unquantized_wire_bytes",
+                "device_quantized",
+                "wire_dtype",
+            ):
                 if hasattr(work, attr):
                     setattr(managed, attr, getattr(work, attr))
             return managed

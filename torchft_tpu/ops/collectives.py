@@ -81,6 +81,7 @@ def allreduce_quantized(
     pg: ProcessGroup,
     average_by: "int | None" = None,
     device_quantize: "Optional[bool]" = None,
+    wire_dtype: "Optional[str]" = None,
 ) -> Work:
     """8-bit quantized allreduce of a list of float arrays.
 
@@ -94,10 +95,21 @@ def allreduce_quantized(
             step); defaults to pg.size() when op is AVG.
         device_quantize: quantize on-device with the Pallas kernel before
             the device→host copy.  Default: auto — on when every input is
-            a jax array and the default backend is TPU.
+            a jax array and the default backend is TPU.  int8 wire only
+            (the fp8 leg is host-codec, mirroring the reference gating
+            its fp8 kernels on SM90 hardware).
+        wire_dtype: ``"int8"`` (default) or ``"fp8_e4m3"`` — the payload
+            format on the DCN wire (same byte count either way; the
+            reference's fp8e4nv/int8 pair, torchft/quantization.py:30-41).
+            Defaults to ``TORCHFT_QUANT_WIRE`` when set.
     """
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
+    if wire_dtype is None:
+        import os
+
+        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", q.WIRE_INT8)
+    q._wire(wire_dtype)  # validate early, before any comm is queued
     # normalize non-array inputs (lists, Python scalars) without touching
     # device arrays
     arrays = [a if isinstance(a, jax.Array) else np.asarray(a) for a in arrays]
@@ -105,8 +117,15 @@ def allreduce_quantized(
         if not jnp.issubdtype(a.dtype, jnp.floating):
             raise ValueError("quantized allreduce requires floating point arrays")
     if device_quantize is None:
-        device_quantize = jax.default_backend() == "tpu" and all(
-            isinstance(a, jax.Array) for a in arrays
+        device_quantize = (
+            wire_dtype == q.WIRE_INT8
+            and jax.default_backend() == "tpu"
+            and all(isinstance(a, jax.Array) for a in arrays)
+        )
+    elif device_quantize and wire_dtype != q.WIRE_INT8:
+        raise ValueError(
+            "device_quantize supports the int8 wire only (no fp8 quantize "
+            "kernel on current TPU Mosaic — the host codec carries fp8)"
         )
 
     shapes = [a.shape for a in arrays]
@@ -145,19 +164,21 @@ def allreduce_quantized(
         # quantize each destination rank's row-slice separately
         send_bufs = []
         for start, end in bounds:
-            scales, payload = q.quantize(mat[start:end])
-            send_bufs.append(q.pack(scales, payload))
+            scales, payload = q.quantize(mat[start:end], wire_dtype)
+            send_bufs.append(q.pack(scales, payload, wire_dtype))
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
         my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
-        reduced = q.reduce_quantized(received, my_rows, cols, average_by=divisor)
+        reduced = q.reduce_quantized(
+            received, my_rows, cols, average_by=divisor, wire_dtype=wire_dtype
+        )
         return pg.allgather(reduced)
 
     def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
         pieces = []
         for r, buf in enumerate(gathered):
             n_rows = bounds[r][1] - bounds[r][0]
-            scales, payload = q.unpack(buf, n_rows, cols)
+            scales, payload = q.unpack(buf, n_rows, cols, wire_dtype)
             pieces.append(q.dequantize(scales, payload, (n_rows, cols), np.float32))
         full = np.concatenate(pieces).ravel()[:total]
         out = []
@@ -203,15 +224,25 @@ def allreduce_quantized(
     out_work.wire_bytes = sum(b.nbytes for b in send_bufs)
     out_work.unquantized_wire_bytes = 4 * total
     out_work.device_quantized = bool(device_quantize)
+    out_work.wire_dtype = wire_dtype
     return out_work
 
 
-def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
+def reduce_scatter_quantized(
+    array: Any, op: str, pg: ProcessGroup, wire_dtype: "Optional[str]" = None
+) -> Work:
     """8-bit quantized reduce-scatter: like allreduce_quantized without the
     allgather (reference collectives.py:159-294). Resolves to this rank's
-    dequantized row-slice of the reduction."""
+    dequantized row-slice of the reduction.  ``wire_dtype`` defaults to
+    ``TORCHFT_QUANT_WIRE`` like the allreduce (one env knob, both
+    collectives)."""
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
+    if wire_dtype is None:
+        import os
+
+        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", q.WIRE_INT8)
+    q._wire(wire_dtype)
     np_array = np.asarray(array)
     if not jnp.issubdtype(np_array.dtype, jnp.floating):
         raise ValueError("quantized reduce_scatter requires floating point arrays")
@@ -230,8 +261,8 @@ def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
     bounds = _slice_rows(rows_total, world)
     send_bufs = []
     for start, end in bounds:
-        scales, payload = q.quantize(mat[start:end])
-        send_bufs.append(q.pack(scales, payload))
+        scales, payload = q.quantize(mat[start:end], wire_dtype)
+        send_bufs.append(q.pack(scales, payload, wire_dtype))
 
     my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
     out_shape = (my_rows,) + np_array.shape[1:]
@@ -240,7 +271,8 @@ def reduce_scatter_quantized(array: Any, op: str, pg: ProcessGroup) -> Work:
         # raw f32 result: the reduced slice stays local, so requantizing
         # (needed in allreduce for the allgather hop) would only add error
         acc = q.reduce_quantized(
-            received, my_rows, cols, average_by=divisor, requantize=False
+            received, my_rows, cols, average_by=divisor, requantize=False,
+            wire_dtype=wire_dtype,
         )
         return acc.reshape(out_shape)
 

@@ -1,18 +1,29 @@
 """Row-scaled 8-bit quantization for bandwidth-reduced DCN collectives.
 
 Analog of the reference's fused quantization kernels
-(reference: torchft/quantization.py:44-686): per-row absmax scales, int8
+(reference: torchft/quantization.py:44-686): per-row absmax scales, 8-bit
 payload, and scales interleaved into one flat comm buffer; dequant-reduce-
 requant fuses the reduction.  The reference targets fp8e4nv on SM90 with an
-int8 fallback; the DCN payloads here are int8 (numpy has no fp8), matching
-the reference's fallback format (:30-41).
+int8 fallback (:30-41); both wire formats exist here:
 
-Two implementations share the wire format:
+- ``wire_dtype="int8"`` (default): uniform-grid int8 — the reference's
+  fallback format, and the one the Pallas ON-DEVICE quantize kernel emits
+  (torchft_tpu.ops.pallas_quant);
+- ``wire_dtype="fp8_e4m3"``: ml_dtypes ``float8_e4m3fn`` payloads (the
+  reference's fp8e4nv analog) — same 1 byte/element wire size, non-uniform
+  grid with better relative precision for small-magnitude entries.  Host
+  codec only: like the reference gates fp8 on SM90 hardware, the device
+  kernel path stays int8 (no fp8 quantize kernel on current TPU Mosaic).
+
+``TORCHFT_QUANT_WIRE`` selects the collective layer's default.
+
+Two implementations share the int8 wire format:
 - host path (numpy) used by the TCP/DCN collective layer below;
 - device path (jax / Pallas TPU kernel, torchft_tpu.ops.pallas_quant) for
   quantizing on-chip before the host copy — see fused_* wrappers there.
 
-Wire layout per array: ``[rows x f32 scale][rows x cols int8]`` flattened.
+Wire layout per array: ``[rows x f32 scale][rows x cols payload]``
+flattened (payload dtype per ``wire_dtype``).
 """
 
 from __future__ import annotations
@@ -22,6 +33,25 @@ from typing import List, Tuple
 import numpy as np
 
 INT8_MAX = 127.0
+# float8_e4m3fn max finite value (the "fn" variant has no inf, max 448)
+FP8_MAX = 448.0
+
+WIRE_INT8 = "int8"
+WIRE_FP8 = "fp8_e4m3"
+
+
+def _wire(wire_dtype: str) -> "Tuple[np.dtype, float]":
+    """(numpy payload dtype, absmax scale target) for a wire format."""
+    if wire_dtype == WIRE_INT8:
+        return np.dtype(np.int8), INT8_MAX
+    if wire_dtype == WIRE_FP8:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.float8_e4m3fn), FP8_MAX
+    raise ValueError(
+        f"unknown wire_dtype {wire_dtype!r}; expected "
+        f"{WIRE_INT8!r} or {WIRE_FP8!r}"
+    )
 
 
 def _as_rows(a: np.ndarray) -> np.ndarray:
@@ -33,56 +63,104 @@ def _as_rows(a: np.ndarray) -> np.ndarray:
     return a.reshape(a.shape[0], -1)
 
 
-def quantize(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-row absmax int8 quantization -> (scales f32 [rows], payload int8).
+def quantize(
+    a: np.ndarray, wire_dtype: str = WIRE_INT8
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row absmax quantization -> (scales f32 [rows], payload 8-bit).
 
     Memory-bandwidth-bound on big arrays (the DCN host path quantizes
     ~GB-scale pseudograd fragments), so the hot loop is pass-minimal:
     multiply by the reciprocal scale (division is the slow ufunc), round
     in place, and skip the clip — absmax scaling bounds every product to
-    [-127, 127] by construction (1-ulp excursions round back to 127).
+    [-max, max] by construction (1-ulp excursions round back to max).
     """
+    dt, qmax = _wire(wire_dtype)
     rows = _as_rows(np.asarray(a, dtype=np.float32))
     absmax = np.abs(rows).max(axis=1)
-    # Rows with absmax below 127/f32max would overflow the reciprocal to
-    # inf (inf*0 = NaN payload); values that tiny (< ~3.7e-37) carry no
+    # Rows with absmax below qmax/f32max would overflow the reciprocal to
+    # inf (inf*0 = NaN payload); values that tiny (< ~1e-36) carry no
     # quantizable signal, so such rows encode as exact zeros (scale 1.0),
     # same as all-zero rows.
-    nonzero = absmax > INT8_MAX / np.finfo(np.float32).max
-    scales = np.where(nonzero, absmax / INT8_MAX, 1.0).astype(np.float32)
+    nonzero = absmax > qmax / np.finfo(np.float32).max
+    scales = np.where(nonzero, absmax / qmax, 1.0).astype(np.float32)
     inv = np.divide(
-        INT8_MAX, absmax, out=np.ones_like(absmax), where=nonzero
+        qmax, absmax, out=np.ones_like(absmax), where=nonzero
     ).astype(np.float32)
     tmp = rows * inv[:, None]
-    np.rint(tmp, out=tmp)
-    payload = tmp.astype(np.int8)
+    if dt == np.int8:
+        np.rint(tmp, out=tmp)
+    # float8 cast rounds to nearest representable itself — no rint pass
+    payload = tmp.astype(dt)
     return scales, payload
 
 
 def dequantize(
-    scales: np.ndarray, payload: np.ndarray, shape: "Tuple[int, ...]", dtype: np.dtype
+    scales: np.ndarray,
+    payload: np.ndarray,
+    shape: "Tuple[int, ...]",
+    dtype: np.dtype,
 ) -> np.ndarray:
-    # one fused int8 x f32 -> f32 pass; asarray avoids the astype copy
-    # when dtype is already float32 (the common DCN case)
+    # one fused payload x f32 -> f32 pass; asarray avoids the astype copy
+    # when dtype is already float32 (the common DCN case).  ml_dtypes fp8
+    # payloads lack a numpy multiply loop against f32 — widen first (still
+    # one extra pass only on the fp8 leg).
+    if payload.dtype != np.int8:
+        payload = payload.astype(np.float32)
     out = np.multiply(payload, scales[:, None], dtype=np.float32)
     return np.asarray(out.reshape(shape), dtype=dtype)
 
 
-def pack(scales: np.ndarray, payload: np.ndarray) -> np.ndarray:
-    """Interleave scales + payload into one uint8 comm buffer
+# Packed-buffer header: [version u8, wire-format code u8, reserved u16].
+# The format code travels ON the wire so two ranks with divergent
+# TORCHFT_QUANT_WIRE settings (partial rollout, heterogeneous launcher
+# env) fail loudly at unpack instead of silently decoding each other's
+# 1-byte payloads as the wrong grid.
+_PACK_VERSION = 1
+_WIRE_CODES = {WIRE_INT8: 0, WIRE_FP8: 1}
+_WIRE_NAMES = {v: k for k, v in _WIRE_CODES.items()}
+_HEADER_BYTES = 4
+
+
+def pack(
+    scales: np.ndarray, payload: np.ndarray, wire_dtype: str = WIRE_INT8
+) -> np.ndarray:
+    """Interleave header + scales + payload into one uint8 comm buffer
     (reference quantization.py:54-165 packs fp8 payload + f32 scales)."""
-    return np.concatenate([scales.view(np.uint8).ravel(), payload.view(np.uint8).ravel()])
+    header = np.array(
+        [_PACK_VERSION, _WIRE_CODES[wire_dtype], 0, 0], dtype=np.uint8
+    )
+    return np.concatenate(
+        [header, scales.view(np.uint8).ravel(), payload.view(np.uint8).ravel()]
+    )
 
 
-def unpack(buf: np.ndarray, rows: int, cols: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Split a packed wire buffer back into (scales, payload).
+def unpack(
+    buf: np.ndarray, rows: int, cols: int, wire_dtype: str = WIRE_INT8
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Split a packed wire buffer back into (scales, payload), validating
+    the on-wire format header against the locally expected ``wire_dtype``.
 
     Returns VIEWS into ``buf`` (zero-copy): every consumer immediately
     widens the payload in its own f32 pass, so a defensive copy here would
     only add a full memory pass at GB fragment scale."""
-    scale_bytes = rows * 4
-    scales = buf[:scale_bytes].view(np.float32)
-    payload = buf[scale_bytes : scale_bytes + rows * cols].view(np.int8).reshape(rows, cols)
+    dt, _ = _wire(wire_dtype)
+    version, code = int(buf[0]), int(buf[1])
+    if version != _PACK_VERSION:
+        raise ValueError(
+            f"quantized buffer version {version} != {_PACK_VERSION} "
+            "(peer running an incompatible codec)"
+        )
+    if code != _WIRE_CODES[wire_dtype]:
+        raise ValueError(
+            f"wire format mismatch: peer sent "
+            f"{_WIRE_NAMES.get(code, f'code {code}')}, local expects "
+            f"{wire_dtype} (check TORCHFT_QUANT_WIRE on every rank)"
+        )
+    scale_end = _HEADER_BYTES + rows * 4
+    scales = buf[_HEADER_BYTES:scale_end].view(np.float32)
+    payload = buf[scale_end : scale_end + rows * cols].view(dt).reshape(
+        rows, cols
+    )
     return scales, payload
 
 
@@ -92,6 +170,7 @@ def reduce_quantized(
     cols: int,
     average_by: int = 0,
     requantize: bool = True,
+    wire_dtype: str = WIRE_INT8,
 ) -> np.ndarray:
     """Dequantize each packed buffer, accumulate in f32, requantize.
 
@@ -103,9 +182,11 @@ def reduce_quantized(
     """
     acc: "np.ndarray | None" = None
     for buf in bufs:
-        scales, payload = unpack(buf, rows, cols)
-        # fused int8 x f32 -> f32 product in one pass; first buffer becomes
-        # the accumulator directly (no zeros pass, no first add)
+        scales, payload = unpack(buf, rows, cols, wire_dtype)
+        # fused payload x f32 -> f32 product in one pass; first buffer
+        # becomes the accumulator directly (no zeros pass, no first add)
+        if payload.dtype != np.int8:
+            payload = payload.astype(np.float32)
         prod = np.multiply(payload, scales[:, None], dtype=np.float32)
         if acc is None:
             acc = prod
@@ -117,4 +198,4 @@ def reduce_quantized(
         acc /= average_by
     if not requantize:
         return acc
-    return pack(*quantize(acc))
+    return pack(*quantize(acc, wire_dtype), wire_dtype)
