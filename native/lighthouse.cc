@@ -249,7 +249,6 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
 
   std::unique_lock<std::mutex> lk(mu_);
   int64_t now = now_ms();
-  max_rpc_timeout_ms_ = std::max(max_rpc_timeout_ms_, timeout_ms);
   // Supersession is one-directional: an incarnation that has been evicted
   // (a newer incarnation of the same logical replica joined after it) can
   // never re-register or evict its successor, even if the old process is
@@ -305,18 +304,21 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
         }
       }
     }
-    // Prune stamps by AGE, not count: a ghost handler can stay blocked for
-    // its full RPC deadline (and a zombie's heartbeat thread runs
-    // indefinitely), so keep each stamp for 2x the largest quorum deadline
-    // ever requested plus the heartbeat window — a restart storm of any
-    // size cannot age out a stamp that a live ghost still needs.
-    const int64_t keep_ms =
-        2 * std::max(max_rpc_timeout_ms_, opt_.heartbeat_timeout_ms);
-    for (auto it = evicted_at_ms_.begin(); it != evicted_at_ms_.end();) {
-      if (now - it->second > keep_ms)
-        it = evicted_at_ms_.erase(it);
-      else
-        ++it;
+    // Stamps are effectively PERMANENT: supersession is one-directional
+    // for the lifetime of the job, because a superseded-but-still-alive
+    // zombie may go silent for arbitrarily long (its manager stops
+    // heartbeating on the superseded reply; a hung process can sleep
+    // through any timeout) and must still be rejected when it finally
+    // retries — otherwise it re-registers and evicts the live successor.
+    // Each stamp is ~50 bytes and one is created per real restart, so
+    // memory is bounded in practice; the count cap below is an
+    // extreme-storm backstop (oldest first), far beyond any real job.
+    constexpr size_t kMaxEvictionStamps = 100000;
+    while (evicted_at_ms_.size() > kMaxEvictionStamps) {
+      auto oldest = evicted_at_ms_.begin();
+      for (auto it = evicted_at_ms_.begin(); it != evicted_at_ms_.end(); ++it)
+        if (it->second < oldest->second) oldest = it;
+      evicted_at_ms_.erase(oldest);
     }
   }
   int64_t seen_seq = quorum_seq_;

@@ -109,11 +109,11 @@ class LighthouseServer : public RpcServer {
   // Fast-restart supersession bookkeeping: id -> eviction wall time (ms).
   // Presence is the supersession stamp: an evicted incarnation can never
   // re-register, heartbeat, or evict its successor (one-directional — the
-  // lighthouse's arrival order IS the incarnation order).  Entries are
-  // pruned by age relative to the largest RPC deadline ever seen, so a
-  // ghost handler still blocked on a long timeout keeps its stamp.
+  // lighthouse's arrival order IS the incarnation order).  Stamps are
+  // effectively permanent (a zombie may go silent arbitrarily long and
+  // must still be rejected on its eventual retry); a large count cap is
+  // the only prune, as an extreme-restart-storm memory backstop.
   std::map<std::string, int64_t> evicted_at_ms_;
-  int64_t max_rpc_timeout_ms_ = 0;
   std::optional<Quorum> prev_quorum_;
   int64_t quorum_id_ = 0;
   // Broadcast: monotonically increasing sequence of formed quorums.

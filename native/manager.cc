@@ -147,7 +147,19 @@ void ManagerServer::heartbeat_loop() {
     Json params = Json::object();
     params["replica_id"] = opt_.replica_id;
     try {
-      client.call("heartbeat", params, opt_.connect_timeout_ms);
+      Json reply = client.call("heartbeat", params, opt_.connect_timeout_ms);
+      if (reply.get("superseded").as_bool()) {
+        // A newer incarnation of this replica registered at the
+        // lighthouse: this process is a zombie there, permanently (the
+        // eviction stamp never expires).  Stop heartbeating — the
+        // lighthouse ignores us anyway, and the quorum path will
+        // surface the superseded error to the training loop.
+        fprintf(stderr,
+                "[torchft manager %s] superseded by a newer incarnation; "
+                "stopping heartbeats\n",
+                opt_.replica_id.c_str());
+        return;
+      }
     } catch (const std::exception&) {
       // Lighthouse unreachable: keep trying; quorum path surfaces errors.
       client.close();

@@ -358,3 +358,105 @@ class TestStateDict:
         )
         sd = manager._manager_state_dict()
         assert sd["user"]["frag0"] == "s0" and sd["user"]["frag1"] == "s1"
+
+
+class TestStaleManagerAddr:
+    def test_nonzero_rank_probes_past_dead_incarnation_addr(self):
+        """After a whole-group fast restart the store still holds the dead
+        incarnation's manager address until the new rank 0 republishes; a
+        non-zero rank must probe and re-read instead of wiring itself to
+        the corpse (manager.py store-handoff loop)."""
+        import socket
+        import threading
+        import time
+
+        from torchft_tpu.coordination import (
+            LighthouseServer,
+            ManagerServer,
+            StoreClient,
+            StoreServer,
+        )
+
+        lighthouse = LighthouseServer(min_replicas=1)
+        store = StoreServer()
+        sc = StoreClient(store.address())
+        # a port with no listener = the dead incarnation's endpoint
+        with socket.socket() as s:
+            s.bind(("", 0))
+            dead_port = s.getsockname()[1]
+        sc.set("manager_addr", f"127.0.0.1:{dead_port}")
+        sc.set("replica_id", "grp:dead-incarnation")
+
+        server_box = {}
+
+        def republish():
+            time.sleep(0.7)
+            server = ManagerServer(
+                replica_id="grp:new-incarnation",
+                lighthouse_addr=lighthouse.address(),
+                store_address=store.address(),
+                world_size=2,
+                bind=":0",
+                heartbeat_interval=0.1,
+                connect_timeout=5.0,
+                quorum_retries=0,
+            )
+            server_box["server"] = server
+            sc.set("manager_addr", server.address())
+            sc.set("replica_id", "grp:new-incarnation")
+
+        t = threading.Thread(target=republish, daemon=True)
+        t.start()
+        try:
+            manager = Manager(
+                pg=ProcessGroupDummy(),
+                min_replica_size=1,
+                load_state_dict=lambda sd: None,
+                state_dict=lambda: {"x": np.zeros(1)},
+                lighthouse_addr=lighthouse.address(),
+                group_rank=1,
+                group_world_size=2,
+                store_addr=store.address(),
+                connect_timeout=5.0,
+            )
+            # wired to the LIVE incarnation, not the stale published addr
+            assert manager.replica_id() == "grp:new-incarnation"
+            manager.shutdown()
+        finally:
+            t.join(timeout=5)
+            if "server" in server_box:
+                server_box["server"].shutdown()
+            sc.close()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_nonzero_rank_times_out_when_no_live_server_appears(self):
+        import socket
+
+        from torchft_tpu.coordination import LighthouseServer, StoreClient, StoreServer
+
+        lighthouse = LighthouseServer(min_replicas=1)
+        store = StoreServer()
+        sc = StoreClient(store.address())
+        with socket.socket() as s:
+            s.bind(("", 0))
+            dead_port = s.getsockname()[1]
+        sc.set("manager_addr", f"127.0.0.1:{dead_port}")
+        sc.set("replica_id", "grp:dead")
+        try:
+            with pytest.raises(TimeoutError, match="unreachable"):
+                Manager(
+                    pg=ProcessGroupDummy(),
+                    min_replica_size=1,
+                    load_state_dict=lambda sd: None,
+                    state_dict=lambda: {"x": np.zeros(1)},
+                    lighthouse_addr=lighthouse.address(),
+                    group_rank=1,
+                    group_world_size=2,
+                    store_addr=store.address(),
+                    connect_timeout=2.0,
+                )
+        finally:
+            sc.close()
+            store.shutdown()
+            lighthouse.shutdown()

@@ -105,11 +105,7 @@ def allreduce_quantized(
     """
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
-    if wire_dtype is None:
-        import os
-
-        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", q.WIRE_INT8)
-    q._wire(wire_dtype)  # validate early, before any comm is queued
+    wire_dtype = q.resolve_wire(wire_dtype)  # validate before any comm
     # normalize non-array inputs (lists, Python scalars) without touching
     # device arrays
     arrays = [a if isinstance(a, jax.Array) else np.asarray(a) for a in arrays]
@@ -141,6 +137,7 @@ def allreduce_quantized(
         solo.wire_bytes = 0  # nothing crosses the wire at world 1
         solo.unquantized_wire_bytes = 0
         solo.device_quantized = False
+        solo.wire_dtype = wire_dtype
         return solo
     divisor = average_by if average_by is not None else (world if op == REDUCE_AVG else 0)
 
@@ -238,11 +235,7 @@ def reduce_scatter_quantized(
     collectives)."""
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
-    if wire_dtype is None:
-        import os
-
-        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", q.WIRE_INT8)
-    q._wire(wire_dtype)
+    wire_dtype = q.resolve_wire(wire_dtype)
     np_array = np.asarray(array)
     if not jnp.issubdtype(np_array.dtype, jnp.floating):
         raise ValueError("quantized reduce_scatter requires floating point arrays")

@@ -54,6 +54,18 @@ def _wire(wire_dtype: str) -> "Tuple[np.dtype, float]":
     )
 
 
+def resolve_wire(wire_dtype: "str | None") -> str:
+    """Resolve a wire format: explicit value, else the
+    ``TORCHFT_QUANT_WIRE`` env default, else int8 — validated either way.
+    The one entry point every collective uses for the env knob."""
+    import os
+
+    if wire_dtype is None:
+        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", WIRE_INT8)
+    _wire(wire_dtype)
+    return wire_dtype
+
+
 def _as_rows(a: np.ndarray) -> np.ndarray:
     """View as 2-D (rows, cols): leading dim preserved, rest flattened."""
     if a.ndim == 0:
