@@ -359,6 +359,40 @@ class TestFastRestartSupersession:
                 stop.set()
                 t.join(timeout=5)
 
+    def test_restart_storm_only_latest_incarnation_survives(self):
+        # N sequential incarnations of one logical replica: each join
+        # evicts the previous, every superseded id stays permanently
+        # rejected (stamps never age out), and only the newest is in the
+        # final quorum alongside the survivor.
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=5000, heartbeat_timeout_ms=60000
+        ) as server:
+            incarnations = [f"victim:{i}" for i in range(5)]
+            for inc in incarnations:
+                results = _concurrent_quorums(
+                    server.address(),
+                    [{"replica_id": "survivor:aaa"}, {"replica_id": inc}],
+                )
+                assert isinstance(results[inc], Quorum), results
+            # every superseded incarnation is permanently rejected
+            for inc in incarnations[:-1]:
+                res = _concurrent_quorums(
+                    server.address(), [{"replica_id": inc}], timeout=2.0
+                )
+                assert isinstance(res[inc], Exception), (inc, res)
+                assert "superseded" in str(res[inc])
+            # the latest one still forms quorum fast
+            start = time.monotonic()
+            results = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "survivor:aaa"},
+                 {"replica_id": incarnations[-1]}],
+            )
+            assert [p.replica_id for p in results[incarnations[-1]].participants] == [
+                "survivor:aaa", incarnations[-1],
+            ]
+            assert time.monotonic() - start < 2.0
+
     def test_evicted_incarnation_cannot_evict_successor(self):
         # Supersession is one-directional: once evicted, the old incarnation
         # can never re-register — a zombie's quorum retry is rejected with

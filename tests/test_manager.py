@@ -402,8 +402,10 @@ class TestStaleManagerAddr:
                 quorum_retries=0,
             )
             server_box["server"] = server
-            sc.set("manager_addr", server.address())
+            # the store-handoff contract: replica_id BEFORE manager_addr
+            # (a live addr implies the matching id is already visible)
             sc.set("replica_id", "grp:new-incarnation")
+            sc.set("manager_addr", server.address())
 
         t = threading.Thread(target=republish, daemon=True)
         t.start()

@@ -215,8 +215,11 @@ class Manager:
                 connect_timeout=self._connect_timeout,
                 quorum_retries=quorum_retries,
             )
-            store.set(MANAGER_ADDR_KEY, self._manager_server.address())
+            # replica_id BEFORE manager_addr: readers probe the addr and
+            # then read the id, so publishing in this order guarantees a
+            # live addr is never paired with the previous incarnation's id
             store.set(REPLICA_ID_KEY, new_replica_id)
+            store.set(MANAGER_ADDR_KEY, self._manager_server.address())
 
         # Non-zero ranks discover the group's ManagerServer through the
         # store.  After a whole-group fast restart the store still holds
@@ -227,10 +230,13 @@ class Manager:
         deadline = time.monotonic() + self._connect_timeout
         while True:
             addr = store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout)
-            self._replica_id = store.get(
-                REPLICA_ID_KEY, timeout=self._connect_timeout
-            )
             if self._manager_server is not None or self._endpoint_alive(addr):
+                # read the id AFTER the probe succeeds: rank 0 publishes
+                # replica_id before manager_addr, so a live addr implies
+                # the matching incarnation's id is already visible
+                self._replica_id = store.get(
+                    REPLICA_ID_KEY, timeout=self._connect_timeout
+                )
                 break
             if time.monotonic() >= deadline:
                 raise TimeoutError(
