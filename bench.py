@@ -196,9 +196,15 @@ class RecoveryBench:
         try:
             replicas = [Replica(i, lighthouse.address(), self) for i in range(2)]
             t_start = time.perf_counter()
-            with ThreadPoolExecutor(max_workers=2) as ex:
+            # no `with`: the context exit would JOIN a hung worker forever;
+            # a timed-out cycle must return control to bench_recovery (the
+            # worker itself unwedges via its own protocol deadlines)
+            ex = ThreadPoolExecutor(max_workers=2)
+            try:
                 results = [f.result(timeout=300)
                            for f in [ex.submit(r.run) for r in replicas]]
+            finally:
+                ex.shutdown(wait=False, cancel_futures=True)
             wall = time.perf_counter() - t_start
         finally:
             lighthouse.shutdown()
@@ -242,10 +248,29 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
     noise from a protocol pathology; the per-cycle phase breakdown now
     says which)."""
     cycle_results = []
+    errors = []
     for i in range(cycles):
-        r = RecoveryBench().run()
+        # one bad cycle (hung thread, host stall) must not cost the driver
+        # the primary metric — the median of the surviving cycles is still
+        # a better headline than r03's single-sample coin flip.
+        # AssertionError is NOT survivable: bitwise divergence after
+        # recovery is a protocol correctness failure, not host noise.
+        try:
+            r = RecoveryBench().run()
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log(f"recovery cycle {i} FAILED: {e!r}")
+            errors.append(repr(e))
+            # let the abandoned cycle's worker threads unwedge via their
+            # own protocol deadlines (30 s) before timing the next cycle
+            # on this 1-core host
+            time.sleep(35.0)
+            continue
         log(f"recovery cycle {i}: {r['latency_s']:.3f}s phases {r['phases_ms']}")
         cycle_results.append(r)
+    if not cycle_results:
+        raise RuntimeError(f"all recovery cycles failed: {errors}")
 
     latencies = [r["latency_s"] for r in cycle_results]
     median_latency = statistics.median(latencies)
@@ -256,7 +281,7 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
                                     for r in cycle_results]), 1)
         for k in keys
     }
-    return {
+    out = {
         "value": round(median_latency, 3),
         "recovery_cycles_s": [round(x, 3) for x in latencies],
         "recovery_min_s": round(min(latencies), 3),
@@ -265,6 +290,9 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
             statistics.median([r["steady_step_ms"] for r in cycle_results]), 1
         ),
     }
+    if errors:
+        out["recovery_cycle_errors"] = errors
+    return out
 
 
 # ---------------------------------------------------------------------------

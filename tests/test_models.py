@@ -179,3 +179,36 @@ class TestAutoAttnImpl:
         toks = jax.random.randint(jax.random.PRNGKey(1), (1, 20), 0, cfg.vocab_size)
         logits = tfm.forward(params, toks, cfg)  # must not raise
         assert logits.shape == (1, 20, cfg.vocab_size)
+
+
+class TestRematPolicy:
+    def test_dots_policy_matches_full_remat_numerics(self):
+        """remat_policy='dots' (save matmul outputs, recompute elementwise)
+        must be numerically identical to full remat and to no remat — it
+        only changes WHAT is saved for the backward, never the math."""
+        cfgs = [
+            _tiny_cfg(dtype=jnp.float32, remat=True, remat_policy="full"),
+            _tiny_cfg(dtype=jnp.float32, remat=True, remat_policy="dots"),
+            _tiny_cfg(dtype=jnp.float32, remat=False),
+        ]
+        params = tfm.init_params(jax.random.PRNGKey(0), cfgs[0])
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        grads = [
+            jax.grad(lambda p, c=c: tfm.loss_fn(p, toks, c))(params)
+            for c in cfgs
+        ]
+        for other in grads[1:]:
+            for a, b in zip(
+                jax.tree_util.tree_leaves(grads[0]),
+                jax.tree_util.tree_leaves(other),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+                )
+
+    def test_unknown_policy_rejected(self):
+        cfg = _tiny_cfg(remat=True, remat_policy="everything")
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 64)
+        with pytest.raises(ValueError, match="remat_policy"):
+            tfm.forward(params, toks, cfg)
