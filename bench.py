@@ -90,6 +90,12 @@ class _Kill(Exception):
     pass
 
 
+class DivergenceError(AssertionError):
+    """Replicas were NOT bitwise-equal after recovery — a protocol
+    correctness failure that must fail the whole bench (unlike harness
+    asserts or hangs, which only fail their cycle)."""
+
+
 class Replica:
     def __init__(self, replica_id: int, lighthouse_addr: str, bench: "RecoveryBench"):
         self.replica_id = replica_id
@@ -130,6 +136,10 @@ class Replica:
             use_async_quorum=True,
             timeout=30.0,
             quorum_timeout=30.0,
+            # a should_commit=False livelock must terminate (an abandoned
+            # cycle's thread would otherwise spin on the 1-core host
+            # forever — there is no other per-replica wall deadline)
+            max_retries=2 * TOTAL_STEPS,
         )
         healed = attempt > 0
         if healed and self.bench.t_killed is not None:
@@ -196,21 +206,44 @@ class RecoveryBench:
         try:
             replicas = [Replica(i, lighthouse.address(), self) for i in range(2)]
             t_start = time.perf_counter()
-            # no `with`: the context exit would JOIN a hung worker forever;
-            # a timed-out cycle must return control to bench_recovery (the
-            # worker itself unwedges via its own protocol deadlines)
-            ex = ThreadPoolExecutor(max_workers=2)
-            try:
-                results = [f.result(timeout=300)
-                           for f in [ex.submit(r.run) for r in replicas]]
-            finally:
-                ex.shutdown(wait=False, cancel_futures=True)
+            # daemon threads, not a ThreadPoolExecutor: a hung worker must
+            # neither block this cycle past its deadline nor hang process
+            # exit via concurrent.futures' atexit join (the worker itself
+            # unwedges via its protocol deadlines / max_retries)
+            out: "Dict[int, Any]" = {}
+            errs: "Dict[int, BaseException]" = {}
+
+            def runner(r: Replica) -> None:
+                try:
+                    out[r.replica_id] = r.run()
+                except BaseException as e:  # noqa: BLE001
+                    errs[r.replica_id] = e
+
+            threads = [
+                threading.Thread(target=runner, args=(r,), daemon=True)
+                for r in replicas
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 300
+            for t in threads:
+                t.join(timeout=max(deadline - time.monotonic(), 0.001))
+            if errs:
+                raise next(iter(errs.values()))
+            if len(out) != len(replicas):
+                raise TimeoutError("recovery cycle timed out (worker hung)")
+            results = [out[r.replica_id] for r in replicas]
             wall = time.perf_counter() - t_start
         finally:
             lighthouse.shutdown()
 
         assert self.t_killed is not None and self.t_healthy is not None
-        np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
+        try:
+            np.testing.assert_array_equal(
+                results[0]["params"], results[1]["params"]
+            )
+        except AssertionError as e:
+            raise DivergenceError(str(e)) from None
         log("replicas converged bitwise after recovery")
 
         all_steps = [t for r in replicas for t in r.step_times]
@@ -253,19 +286,21 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
         # one bad cycle (hung thread, host stall) must not cost the driver
         # the primary metric — the median of the surviving cycles is still
         # a better headline than r03's single-sample coin flip.
-        # AssertionError is NOT survivable: bitwise divergence after
+        # DivergenceError is NOT survivable: bitwise divergence after
         # recovery is a protocol correctness failure, not host noise.
         try:
             r = RecoveryBench().run()
-        except AssertionError:
+        except DivergenceError:
             raise
         except Exception as e:  # noqa: BLE001
             log(f"recovery cycle {i} FAILED: {e!r}")
             errors.append(repr(e))
-            # let the abandoned cycle's worker threads unwedge via their
-            # own protocol deadlines (30 s) before timing the next cycle
-            # on this 1-core host
-            time.sleep(35.0)
+            if isinstance(e, TimeoutError) and i < cycles - 1:
+                # let the abandoned cycle's worker threads unwedge via
+                # their own protocol deadlines (30 s) before timing the
+                # next cycle on this 1-core host; instant failures and the
+                # last cycle need no grace
+                time.sleep(35.0)
             continue
         log(f"recovery cycle {i}: {r['latency_s']:.3f}s phases {r['phases_ms']}")
         cycle_results.append(r)

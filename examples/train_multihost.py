@@ -20,6 +20,12 @@ Self-launching demo (spawns groups x procs real processes on CPU):
 
     python examples/train_multihost.py --groups 2 --procs-per-group 2 --steps 4
 
+Streaming DiLoCo across the groups (the BASELINE north-star config),
+with optional whole-group kill+rejoin chaos:
+
+    python examples/train_multihost.py --groups 2 --procs-per-group 2 \
+        --algo diloco --steps 6 --chaos --step-sleep 0.25
+
 Real deployment: run one process per host with the env/flags below, a
 shared Lighthouse, one store + one coordinator per group:
 
@@ -49,6 +55,13 @@ def parse_args(argv=None):
     p.add_argument("--cpu-devices", type=int, default=2,
                    help="virtual CPU devices per process (test mode)")
     p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--algo", choices=["ddp", "diloco"], default="ddp",
+                   help="cross-group algorithm: per-step FT-DDP allreduce, "
+                        "or Streaming DiLoCo outer syncs every --sync-every "
+                        "inner steps (the BASELINE north-star config, over "
+                        "real processes)")
+    p.add_argument("--sync-every", type=int, default=4,
+                   help="diloco: inner steps per outer sync")
     p.add_argument("--chaos", action="store_true",
                    help="kill one whole group's processes mid-run, restart "
                         "them, and require bitwise convergence after the "
@@ -110,7 +123,9 @@ def worker(args) -> int:
         group_rank=pid,
         group_world_size=args.procs_per_group,
         store_addr=args.store_addr,
-        use_async_quorum=True,
+        # DiLoCo requires the synchronous quorum (heal applies eagerly
+        # before the inner loop resumes)
+        use_async_quorum=args.algo != "diloco",
         timeout=20.0,
         quorum_timeout=20.0,
         init_sync=False,
@@ -133,40 +148,48 @@ def worker(args) -> int:
 
     rng = np.random.default_rng(1000 + gid)  # same data on every group rank
     first_commit = None
-    try:
-        while manager.current_step() < args.steps:
-            step = manager.current_step()
-            if args.step_sleep:
-                time.sleep(args.step_sleep)
-            xs_np = rng.standard_normal((batch, dim)).astype(np.float32)
-            ys_np = xs_np @ np.arange(dim, dtype=np.float32)
-            # every process contributes only its addressable shards of the
-            # group-global batch
-            xs = host_sharded_array(
-                (batch, dim), batched, lambda idx: xs_np[idx]
-            )
-            ys = host_sharded_array((batch,), batched, lambda idx: ys_np[idx])
 
-            manager.start_quorum()
-            # loss/grads: dp-mean over the group's mesh (compiled XLA
-            # collective spanning the group's processes)
-            loss, grads = grad_step(state["params"], xs, ys)
-            # cross-group: elastic FT ring between same-rank peers
-            avg = manager.allreduce({"w": np.asarray(grads["w"])}).wait(
-                timeout=30
+    def make_batch():
+        xs_np = rng.standard_normal((batch, dim)).astype(np.float32)
+        ys_np = xs_np @ np.arange(dim, dtype=np.float32)
+        # every process contributes only its addressable shards of the
+        # group-global batch
+        xs = host_sharded_array((batch, dim), batched, lambda idx: xs_np[idx])
+        ys = host_sharded_array((batch,), batched, lambda idx: ys_np[idx])
+        return xs, ys
+
+    def note_commit():
+        # a healed rejoiner's first commit lands at the survivors' step,
+        # not 0 — the chaos launcher asserts this to prove the live heal
+        # actually ran.  Read the step from the manager (post-commit,
+        # minus one): healing updates current_step inside start_quorum.
+        nonlocal first_commit
+        if first_commit is None:
+            first_commit = manager.current_step() - 1
+
+    try:
+        if args.algo == "diloco":
+            loss = _diloco_loop(
+                args, manager, state, grad_step, make_batch, note_commit,
             )
-            if manager.should_commit():
-                if first_commit is None:
-                    # a healed rejoiner's first commit lands at the
-                    # survivors' step, not 0 — the chaos launcher asserts
-                    # this to prove the live heal actually ran.  Read the
-                    # step from the manager (post-commit, minus one), NOT
-                    # the loop's pre-quorum `step`: healing updates
-                    # current_step inside start_quorum.
-                    first_commit = manager.current_step() - 1
-                state["params"] = {
-                    "w": state["params"]["w"] - 0.1 * jnp.asarray(avg["w"])
-                }
+        else:
+            while manager.current_step() < args.steps:
+                if args.step_sleep:
+                    time.sleep(args.step_sleep)
+                xs, ys = make_batch()
+                manager.start_quorum()
+                # loss/grads: dp-mean over the group's mesh (compiled XLA
+                # collective spanning the group's processes)
+                loss, grads = grad_step(state["params"], xs, ys)
+                # cross-group: elastic FT ring between same-rank peers
+                avg = manager.allreduce({"w": np.asarray(grads["w"])}).wait(
+                    timeout=30
+                )
+                if manager.should_commit():
+                    note_commit()
+                    state["params"] = {
+                        "w": state["params"]["w"] - 0.1 * jnp.asarray(avg["w"])
+                    }
         digest = hashlib.sha256(
             np.asarray(state["params"]["w"]).tobytes()
         ).hexdigest()[:16]
@@ -177,6 +200,59 @@ def worker(args) -> int:
     finally:
         manager.shutdown()
         jax.distributed.shutdown()
+
+
+def _diloco_loop(args, manager, state, grad_step, make_batch, note_commit):
+    """Streaming DiLoCo across replica groups over REAL processes: inner
+    steps train on the group's own data (dp-mean over the group mesh);
+    every ``--sync-every`` inner steps the pseudogradients allreduce
+    across groups and the outer Nesterov step applies.  ``--steps`` counts
+    OUTER syncs here; the loop exits right after a sync boundary, where
+    params are bitwise-identical across groups by construction."""
+    import time
+
+    import jax.numpy as jnp
+
+    import torchft_tpu as ft
+
+    def get_params():
+        return dict(state["params"])
+
+    def set_params(flat):
+        state["params"] = {**state["params"], **flat}
+
+    import optax
+
+    outer_opt = optax.sgd(0.7, momentum=0.9, nesterov=True)
+    committed_before = manager.current_step()
+    with ft.DiLoCo(
+        manager,
+        [["w"]],  # one fragment: the whole (tiny) model
+        get_params,
+        set_params,
+        outer_opt,
+        sync_every=args.sync_every,
+        fragment_sync_delay=0,
+    ) as diloco:
+        while manager.current_step() < args.steps:
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+            xs, ys = make_batch()
+            loss, grads = grad_step(state["params"], xs, ys)
+            # inner step: plain SGD on the group-mean gradient
+            state["params"] = {
+                "w": state["params"]["w"] - 0.05 * jnp.asarray(grads["w"])
+            }
+            # gate on batches_committed, NOT current_step: a heal jumps
+            # current_step inside start_quorum even when that round's
+            # commit vote fails, but batches_committed moves only on a
+            # real commit — first_commit must prove a commit happened
+            before = manager.batches_committed()
+            diloco.step()  # counts inner steps; syncs on its schedule
+            if manager.batches_committed() > before:
+                note_commit()
+    assert manager.current_step() > committed_before
+    return loss
 
 
 def _free_port() -> int:
@@ -218,6 +294,8 @@ def launch(args) -> int:
                 "--cpu-devices", str(args.cpu_devices),
                 "--steps", str(args.steps),
                 "--min-replicas", str(args.min_replicas),
+                "--algo", args.algo,
+                "--sync-every", str(args.sync_every),
                 "--step-sleep", str(args.step_sleep),
                 "--coordinator", coord,
                 "--store-addr", stores[g].address(),
@@ -242,13 +320,17 @@ def launch(args) -> int:
             from torchft_tpu.coordination import LighthouseClient
 
             lc = LighthouseClient(lighthouse.address())
+            # member steps are per-step commits for ddp, OUTER syncs for
+            # diloco — gate on fewer of the latter (each is sync_every
+            # inner steps of real progress)
+            gate = 2 if args.algo == "diloco" else 3
             deadline = time.monotonic() + 120
             while time.monotonic() < deadline:
                 status = lc.status()
                 members = (status.get("prev_quorum") or {}).get(
                     "participants", []
                 )
-                if members and min(m["step"] for m in members) >= 3:
+                if members and min(m["step"] for m in members) >= gate:
                     break
                 time.sleep(0.25)
             else:

@@ -49,3 +49,22 @@ def test_chaos_kill_group_rejoin_heal_converge():
     assert out.returncode == 0, out.stdout + out.stderr
     assert "after chaos kill+rejoin" in out.stdout, out.stdout
     assert "restarted group healed to step" in out.stdout, out.stdout
+
+
+def test_diloco_across_real_process_groups_with_chaos():
+    """The BASELINE north-star config over real processes: Streaming
+    DiLoCo across replica groups (inner dp-mean per group mesh, outer
+    pseudograd sync every --sync-every inner steps), one whole group
+    SIGKILLed mid-run, restarted, superseded, and healed live — including
+    its DiLoCo outer state (fragment backups + outer optimizer, the
+    per-fragment heal slices local_sgd.py registers).  Bitwise-converged
+    at the final sync boundary."""
+    out = subprocess.run(
+        [sys.executable, "examples/train_multihost.py",
+         "--groups", "2", "--procs-per-group", "2", "--algo", "diloco",
+         "--steps", "6", "--chaos", "--step-sleep", "0.25"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "after chaos kill+rejoin" in out.stdout, out.stdout
+    assert "restarted group healed to step" in out.stdout, out.stdout
