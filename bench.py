@@ -228,6 +228,13 @@ class RecoveryBench:
             deadline = time.monotonic() + 300
             for t in threads:
                 t.join(timeout=max(deadline - time.monotonic(), 0.001))
+            # a still-running worker takes precedence over any error from
+            # its peer: the caller keys its unwedge grace on TimeoutError,
+            # and a live thread is exactly the condition the grace exists
+            # for (it will contend for the single core until its own
+            # deadlines fire)
+            if any(t.is_alive() for t in threads):
+                raise TimeoutError("recovery cycle timed out (worker hung)")
             if errs:
                 raise next(iter(errs.values()))
             if len(out) != len(replicas):
@@ -539,6 +546,124 @@ def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
 FLAGSHIP_PARAMS = int(464.4e6)  # matches the bench_model flagship config
 DILOCO_FRAGMENTS = 8            # Streaming DiLoCo fragment count
 DILOCO_SYNC_EVERY = 20          # inner steps per fragment cycle
+
+
+def bench_diloco_vs_ddp(nonft_ddp_step_ms: float) -> "Dict[str, Any]":
+    """BASELINE.json's own arithmetic, measured: FT Streaming DiLoCo's
+    step cost vs the NON-FT DDP twin (the '<= 5% overhead on the
+    train_diloco config' target).  Same per-step compute as the DDP
+    twins; DiLoCo replaces the per-step 16 MB ring allreduce with one
+    pseudograd sync every ``sync_every`` steps.  A fresh bare-DDP twin
+    runs back-to-back in this same process so the comparison shares one
+    load epoch (still a twin-loop comparison — ±20% noise-bound on the
+    1-core host, docs/benchmarks.md §2 — hence the decomposition into
+    inner median + per-sync cost, which is the robust part).
+    """
+    import torchft_tpu as ft
+
+    nonft_ddp_step_ms = min(nonft_ddp_step_ms, _run_bare_twin(2) * 1e3)
+    # warmup past the FIRST sync: it pays the outer-optimizer jit compile,
+    # which amortizes to nothing over a real run's thousands of syncs
+    world, sync_every, inner_steps, warmup = 2, 20, 100, 25
+    lighthouse = LighthouseServer(
+        min_replicas=world, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    times: "Dict[int, List[float]]" = {}
+
+    def replica(rank: int, barrier: "threading.Barrier") -> None:
+        params = {"w": np.zeros(PARAM_SIZE, dtype=np.float32)}
+        state = {"params": params}
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=30.0),
+            min_replica_size=world,
+            load_state_dict=lambda sd: state.update(params=dict(sd)),
+            state_dict=lambda: dict(state["params"]),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"dl_{rank}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=False,  # DiLoCo requires sync quorum
+            timeout=30.0,
+            quorum_timeout=30.0,
+        )
+        import jax
+        import optax
+
+        try:
+            # pin the outer optimizer's jax ops to the LOCAL CPU backend:
+            # under the driver the default jax device is the tunneled TPU,
+            # and routing 16 MB host pseudograds through a ~10 MB/s tunnel
+            # would measure the tunnel (bench.py module docstring), not
+            # the DCN fault-tolerance layer this bench prices
+            with jax.default_device(jax.devices("cpu")[0]), ft.DiLoCo(
+                manager,
+                [["w"]],
+                lambda: dict(state["params"]),
+                lambda flat: state["params"].update(flat),
+                optax.sgd(0.7, momentum=0.9, nesterov=True),
+                sync_every=sync_every,
+                fragment_sync_delay=1,  # overlap the sync with compute
+            ) as diloco:
+                ts: "List[float]" = []
+                barrier.wait(timeout=30)
+                for step in range(inner_steps):
+                    t0 = time.perf_counter()
+                    grads = _ddp_compute(step, rank)
+                    state["params"]["w"] = state["params"]["w"] - 0.01 * grads
+                    diloco.step()
+                    ts.append(time.perf_counter() - t0)
+                times[rank] = ts[warmup:]
+        finally:
+            manager.shutdown()
+
+    try:
+        barrier = threading.Barrier(world)
+        threads = [
+            threading.Thread(target=replica, args=(r, barrier), daemon=True)
+            for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        lighthouse.shutdown()
+    assert len(times) == world, "diloco twin failed"
+    # split sync-boundary steps (prepare at count%sync_every==sync_every-1,
+    # finish at ==0 with delay=1 -> local indices 18/19 mod 20) from pure
+    # inner steps, so the decomposition is explicit
+    inner: "List[float]" = []
+    boundary: "List[float]" = []
+    for ts in times.values():
+        for i, t in enumerate(ts):
+            step = i + warmup
+            (boundary if step % sync_every >= sync_every - 2 else inner).append(t)
+    inner_ms = statistics.median(inner) * 1e3
+    # 2 boundary steps per sync; subtract their inner-compute share.
+    # Clamped: on a noisy host the inner median can exceed the boundary
+    # mean, which would read as a nonsensical negative sync cost.
+    per_sync_ms = max(
+        0.0,
+        (sum(boundary) / len(boundary) * 2e3 - 2 * inner_ms)
+        if boundary
+        else 0.0,
+    )
+    amortized_ms = inner_ms + per_sync_ms / sync_every
+    overhead_pct = (amortized_ms / nonft_ddp_step_ms - 1.0) * 100.0
+    inner_vs_ddp_pct = (inner_ms / nonft_ddp_step_ms - 1.0) * 100.0
+    log(f"diloco-vs-ddp: FT DiLoCo inner step {inner_ms:.1f} ms "
+        f"({inner_vs_ddp_pct:+.1f}% vs non-FT DDP {nonft_ddp_step_ms:.1f} ms"
+        f" — no per-step allreduce), outer sync {per_sync_ms:.0f} ms every "
+        f"{sync_every} steps -> amortized {amortized_ms:.1f} ms = "
+        f"{overhead_pct:+.1f}% (loopback makes the per-step allreduce "
+        f"DiLoCo avoids nearly free; on real DCN the sign flips)")
+    return {
+        "diloco_inner_step_ms": round(inner_ms, 2),
+        "diloco_inner_vs_nonft_ddp_pct": round(inner_vs_ddp_pct, 1),
+        "diloco_sync_ms": round(per_sync_ms, 1),
+        "diloco_amortized_step_ms": round(amortized_ms, 2),
+        "diloco_vs_nonft_ddp_pct": round(overhead_pct, 1),
+    }
 
 
 def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
@@ -955,6 +1080,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"diloco bench failed: {e!r}")
         diloco = {"error": repr(e)}
+    try:
+        diloco.update(
+            bench_diloco_vs_ddp(overhead.get("nonft_step_ms") or 50.0)
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"diloco-vs-ddp bench failed: {e!r}")
+        diloco["vs_ddp_error"] = repr(e)
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",
