@@ -1,0 +1,55 @@
+"""Guard bench.py's MFU arithmetic: the published model-FLOPs formula and
+peak-TFLOPs lookup are the credibility of the headline MFU number."""
+
+import numpy as np
+
+
+class TestModelFlops:
+    def _cfg(self):
+        from torchft_tpu.models.transformer import TransformerConfig
+
+        return TransformerConfig(
+            vocab_size=32000, d_model=1536, n_heads=6, n_kv_heads=3,
+            d_ff=4096, n_layers=16, max_seq_len=1024,
+        )
+
+    def test_param_count_matches_actual_tree(self):
+        import jax
+
+        from bench import _model_flops_per_step
+        from torchft_tpu.models.transformer import init_params
+
+        cfg = self._cfg()
+        fl = _model_flops_per_step(cfg, batch=8, seq=1024)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        # matmul params = everything except norms and the (gather-only)
+        # embedding; the TIED head reuses embed as a matmul, so add V*E
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        total = 0
+        for path, leaf in leaves:
+            name = str(path)
+            if "norm" in name or "embed" in name:
+                continue
+            total += leaf.size
+        total += cfg.vocab_size * cfg.d_model  # tied head
+        assert fl["params_matmul"] == total, (fl["params_matmul"], total)
+
+    def test_flops_formula_structure(self):
+        from bench import _model_flops_per_step
+
+        cfg = self._cfg()
+        b, t = 8, 1024
+        fl = _model_flops_per_step(cfg, b, t)
+        n = fl["params_matmul"]
+        mm = 6 * n * b * t
+        attn = 3 * (2 * 2 * b * t * t * cfg.d_model) * cfg.n_layers
+        assert fl["flops"] == mm + attn
+        assert fl["tokens"] == b * t
+
+    def test_peak_flops_lookup(self):
+        from bench import _peak_flops
+
+        assert _peak_flops("TPU v5 lite") == 197e12
+        assert _peak_flops("TPU v4") == 275e12
+        assert _peak_flops("TPU v6e") == 918e12
+        assert _peak_flops("Unknown Chip") is None

@@ -270,3 +270,36 @@ class TestEventExporters:
             assert "outer" in msgs and "inner" in msgs
         finally:
             unregister_exporter(exp)
+
+    def test_jsonl_exporter_concurrent_writers(self, tmp_path, monkeypatch):
+        # exports arrive from multiple threads (the pipeline calls sinks
+        # outside its own lock); every line must still parse as one JSON
+        # record with no interleaving
+        import json
+
+        from torchft_tpu.utils.logging import log_event
+
+        events_file = tmp_path / "conc.jsonl"
+        monkeypatch.setenv("TORCHFT_EVENTS_FILE", str(events_file))
+
+        n_threads, per_thread = 4, 50
+
+        def writer(tid):
+            for i in range(per_thread):
+                log_event("commit", f"t{tid}", step=i, replica_id=f"r{tid}")
+
+        threads = [
+            threading.Thread(target=writer, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "writer threads hung"
+        lines = events_file.read_text().strip().splitlines()
+        records = [json.loads(line) for line in lines]  # raises on tearing
+        assert len(records) == n_threads * per_thread
+        for tid in range(n_threads):
+            mine = [r for r in records if r["message"] == f"t{tid}"]
+            assert sorted(r["step"] for r in mine) == list(range(per_thread))
