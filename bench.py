@@ -327,6 +327,7 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
         "value": round(median_latency, 3),
         "recovery_cycles_s": [round(x, 3) for x in latencies],
         "recovery_min_s": round(min(latencies), 3),
+        "recovery_phases": phase_median,  # alias: same dict, ms units
         "recovery_phases_ms": phase_median,
         "steady_step_ms": round(
             statistics.median([r["steady_step_ms"] for r in cycle_results]), 1
