@@ -327,7 +327,10 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
         "value": round(median_latency, 3),
         "recovery_cycles_s": [round(x, 3) for x in latencies],
         "recovery_min_s": round(min(latencies), 3),
-        "recovery_phases": phase_median,  # alias: same dict, ms units
+        # seconds, like every sibling top-level metric in this object
+        "recovery_phases": {
+            k: round(v / 1e3, 4) for k, v in phase_median.items()
+        },
         "recovery_phases_ms": phase_median,
         "steady_step_ms": round(
             statistics.median([r["steady_step_ms"] for r in cycle_results]), 1

@@ -370,3 +370,27 @@ class TestFp8Wire:
             np.testing.assert_allclose(got, 3.0, rtol=0.1)
         for pg in pgs:
             pg.shutdown()
+
+    def test_cross_rank_wire_mismatch_fails_loudly(self, store):  # noqa: F811
+        # two ranks with DIVERGENT wire settings (the partial-rollout
+        # hazard): the allreduce must error on the header check, never
+        # resolve with silently mis-decoded gradients
+        world = 2
+        pgs = make_group(store, world, prefix="wiremix", timeout=5.0)
+        data = [np.ones(64, np.float32) for _ in range(world)]
+
+        def run(rank, _):
+            wd = q.WIRE_FP8 if rank == 0 else q.WIRE_INT8
+            try:
+                out = allreduce_quantized(
+                    [data[rank]], REDUCE_SUM, pgs[rank], wire_dtype=wd
+                ).wait(timeout=10)
+            except Exception as e:  # noqa: BLE001
+                return e
+            return out
+
+        results = run_parallel(world, run)
+        assert all(isinstance(r, Exception) for r in results), results
+        assert any("wire format mismatch" in str(r) for r in results), results
+        for pg in pgs:
+            pg.shutdown()
