@@ -670,10 +670,78 @@ def bench_diloco_vs_ddp(nonft_ddp_step_ms: float) -> "Dict[str, Any]":
     }
 
 
+def _diloco_sync_leg(
+    leg: str, quantize: bool, gbps: "float | None"
+) -> "Dict[str, Any]":
+    """One full flagship-scale outer sync over the TCP ring at a shaped
+    egress bandwidth (None = unshaped loopback).  Returns wall, wire and
+    codec seconds (codec only on the quantized leg)."""
+    from torchft_tpu.ops.collectives import allreduce_quantized
+
+    world = 2
+    frag_elems = FLAGSHIP_PARAMS // DILOCO_FRAGMENTS
+    store = StoreServer()
+    barrier = threading.Barrier(world)
+    walls: "Dict[int, float]" = {}
+    wires: "Dict[int, int]" = {}
+    codecs: "Dict[int, float]" = {}
+
+    def worker(rank: int) -> None:
+        pg = ProcessGroupTCP(timeout=300.0, bandwidth_gbps=gbps)
+        pg.configure(
+            f"{store.address()}/diloco_{leg}_{gbps}", f"dl_{rank}", rank, world
+        )
+        try:
+            rng = np.random.default_rng(rank)
+            frag = rng.standard_normal(frag_elems).astype(np.float32)
+            barrier.wait(timeout=60)
+            t0 = time.perf_counter()
+            wire = 0
+            codec = 0.0
+            for _ in range(DILOCO_FRAGMENTS):
+                if quantize:
+                    w = allreduce_quantized([frag], REDUCE_SUM, pg)
+                    w.wait(timeout=600)
+                    wire += w.wire_bytes
+                    codec += w.codec_s_box[0]
+                else:
+                    pg.allreduce([frag], REDUCE_SUM).wait(timeout=600)
+                    # 2-rank ring: reduce-scatter half + allgather half
+                    # = nbytes sent per rank per allreduce
+                    wire += frag.nbytes
+            walls[rank] = time.perf_counter() - t0
+            wires[rank] = wire
+            codecs[rank] = codec
+        finally:
+            pg.shutdown()
+
+    threads = [
+        threading.Thread(target=worker, args=(r,), daemon=True)
+        for r in range(world)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+    finally:
+        store.shutdown()
+    assert len(walls) == world, f"diloco {leg} leg failed (gbps={gbps})"
+    return {
+        "sync_s": round(max(walls.values()), 2),
+        "wire_gb": round(wires[0] / 1e9, 3),
+        "codec_s": round(max(codecs.values()), 2),
+    }
+
+
 def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
-    """One full outer sync of flagship-scale pseudogradients over the
-    loopback ring, f32 vs int8-quantized — the product's reason to exist
-    on DCN, priced at the scale BASELINE.json describes.
+    """Full outer syncs of flagship-scale pseudogradients over the TCP
+    ring, f32 vs int8-quantized — unshaped loopback PLUS token-bucket
+    shaped legs at 1 / 0.5 / 0.1 GB/s egress (the DCN bandwidths the
+    quantized wire exists for; reference fast path:
+    torchft/collectives.py:297-415).  Loopback bandwidth is effectively
+    infinite, so only the shaped legs measure the codec-vs-wire tradeoff
+    honestly — r4 extrapolated this, r5 measures it.
 
     Streaming-DiLoCo shape: ~464 M params in 8 fragments, each fragment
     allreduced separately (that IS the streaming schedule — and it caps
@@ -686,73 +754,49 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
     prices it against the measured flagship model step.  This is the
     NO-OVERLAP upper bound — the product overlaps fragment syncs with
     inner steps (local_sgd.py fragment_sync_delay), so real overhead is
-    lower.
+    lower.  Both-rank codec work serializes on this 1-core host; on a
+    real deployment (a core per rank) the codec wall halves, moving
+    break-even further in int8's favor.
     """
-    world = 2
-    frag_elems = FLAGSHIP_PARAMS // DILOCO_FRAGMENTS
-    legs: "Dict[str, Dict[str, Any]]" = {}
+    legs: "Dict[str, Any]" = {}
     for leg, quantize in (("f32", False), ("int8", True)):
-        from torchft_tpu.ops.collectives import allreduce_quantized
-
-        store = StoreServer()
-        barrier = threading.Barrier(world)
-        walls: "Dict[int, float]" = {}
-        wires: "Dict[int, int]" = {}
-
-        def worker(rank: int) -> None:
-            pg = ProcessGroupTCP(timeout=300.0)
-            pg.configure(
-                f"{store.address()}/diloco_{leg}", f"dl_{rank}", rank, world
-            )
-            try:
-                rng = np.random.default_rng(rank)
-                frag = rng.standard_normal(frag_elems).astype(np.float32)
-                barrier.wait(timeout=60)
-                t0 = time.perf_counter()
-                wire = 0
-                for _ in range(DILOCO_FRAGMENTS):
-                    if quantize:
-                        w = allreduce_quantized([frag], REDUCE_SUM, pg)
-                        w.wait(timeout=600)
-                        wire += w.wire_bytes
-                    else:
-                        pg.allreduce([frag], REDUCE_SUM).wait(timeout=600)
-                        # 2-rank ring: reduce-scatter half + allgather half
-                        # = nbytes sent per rank per allreduce
-                        wire += frag.nbytes
-                walls[rank] = time.perf_counter() - t0
-                wires[rank] = wire
-            finally:
-                pg.shutdown()
-
-        threads = [
-            threading.Thread(target=worker, args=(r,), daemon=True)
-            for r in range(world)
-        ]
-        try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=900)
-        finally:
-            store.shutdown()
-        assert len(walls) == world, f"diloco {leg} leg failed"
-        sync_s = max(walls.values())
+        r = _diloco_sync_leg(leg, quantize, None)
+        sync_s = r["sync_s"]
         amortized_ms = sync_s * 1e3 / DILOCO_SYNC_EVERY
         legs[leg] = {
-            "sync_s": round(sync_s, 2),
-            "wire_gb": round(wires[0] / 1e9, 3),
+            "sync_s": sync_s,
+            "wire_gb": r["wire_gb"],
+            "codec_s": r["codec_s"],
             "amortized_ms_per_inner_step": round(amortized_ms, 1),
             "overhead_pct_vs_model_step": round(
                 100.0 * amortized_ms / model_step_ms, 1
             ),
         }
         log(f"diloco {leg}: one outer sync of {FLAGSHIP_PARAMS/1e6:.0f}M "
-            f"params in {sync_s:.2f}s ({wires[0]/1e9:.2f} GB wire) -> "
+            f"params in {sync_s:.2f}s ({r['wire_gb']:.2f} GB wire, "
+            f"codec {r['codec_s']:.1f}s) -> "
             f"{amortized_ms:.0f} ms/inner-step amortized at "
             f"sync_every={DILOCO_SYNC_EVERY} = "
             f"{legs[leg]['overhead_pct_vs_model_step']:.1f}% of a "
             f"{model_step_ms:.0f} ms model step (no-overlap upper bound)")
+    # shaped legs: the measured break-even table (VERDICT r4 item 1/2 —
+    # every bandwidth-dependent claim measured, none extrapolated)
+    shaped: "Dict[str, Any]" = {}
+    for gbps in (1.0, 0.5, 0.1):
+        f32 = _diloco_sync_leg("f32s", False, gbps)
+        i8 = _diloco_sync_leg("int8s", True, gbps)
+        shaped[str(gbps)] = {
+            "f32_sync_s": f32["sync_s"],
+            "int8_sync_s": i8["sync_s"],
+            "int8_codec_s": i8["codec_s"],
+            "int8_speedup_x": round(f32["sync_s"] / max(i8["sync_s"], 1e-9), 2),
+            "winner": "int8" if i8["sync_s"] < f32["sync_s"] else "f32",
+        }
+        log(f"diloco shaped @{gbps} GB/s: f32 {f32['sync_s']:.2f}s vs "
+            f"int8 {i8['sync_s']:.2f}s (codec {i8['codec_s']:.1f}s) -> "
+            f"{shaped[str(gbps)]['winner']} wins "
+            f"{shaped[str(gbps)]['int8_speedup_x']:.2f}x")
+    legs["shaped"] = shaped
     legs["wire_reduction_x"] = round(
         legs["f32"]["wire_gb"] / max(legs["int8"]["wire_gb"], 1e-9), 2
     )

@@ -265,7 +265,8 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   }
   // Implicit heartbeat + registration.
   heartbeats_[requester.replica_id] = now;
-  participants_[requester.replica_id] = {requester, now};
+  int64_t my_token = ++next_reg_token_;
+  participants_[requester.replica_id] = {requester, now, my_token};
   // Fast-restart supersession: replica ids carry a ":uuid" incarnation
   // suffix (Manager appends it precisely so a restarted replica is not
   // confused with its dead predecessor). A new incarnation of the same
@@ -333,6 +334,18 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   auto wait_slice = std::chrono::milliseconds(
       std::max<int64_t>(1, std::min<int64_t>(opt_.heartbeat_timeout_ms / 2,
                                              1000)));
+  // A handler that exits WITHOUT a quorum must take its registration with
+  // it (token-guarded: never remove a newer handler's re-registration of
+  // the same id).  Otherwise a dead requester lingers as a ghost
+  // participant for up to one wait slice past its deadline, satisfying
+  // the next formation's barrier with nobody behind it — the peer passes
+  // the barrier alone and the real retry misses the quorum (measured as
+  // a repeating 5 s miss in the restart-storm soak test).
+  auto deregister_if_mine = [&]() {
+    auto it = participants_.find(requester.replica_id);
+    if (it != participants_.end() && it->second.reg_token == my_token)
+      participants_.erase(it);
+  };
   while (true) {
     // Superseded by a newer incarnation after we entered: abort BEFORE
     // re-registering anything (see eviction block above) — this handler
@@ -357,13 +370,18 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       }
       // A quorum formed without us (e.g. we registered right after a tick
       // cleared participants) — re-register and keep waiting.
-      participants_[requester.replica_id] = {requester, now_ms()};
+      my_token = ++next_reg_token_;
+      participants_[requester.replica_id] = {requester, now_ms(), my_token};
     }
-    if (stopping_.load())
+    if (stopping_.load()) {
+      deregister_if_mine();
       throw std::runtime_error("lighthouse shutting down");
+    }
     heartbeats_[requester.replica_id] = now_ms();
-    if (std::chrono::steady_clock::now() >= deadline)
+    if (std::chrono::steady_clock::now() >= deadline) {
+      deregister_if_mine();
       throw TimeoutError("timeout waiting for quorum");
+    }
     quorum_cv_.wait_for(lk, wait_slice);
   }
 }
@@ -440,23 +458,36 @@ std::string LighthouseServer::render_status_json() {
   Json out = Json::object();
   out["quorum_id"] = quorum_id_;
   out["status"] = last_reason_;
+  // live recompute, like the HTML page (reference lighthouse.rs:419)
+  std::string live_reason;
+  quorum_compute(now, &live_reason);
+  out["live_status"] = live_reason;
   Json hbs = Json::array();
   for (const auto& [rid, ts] : heartbeats_) {
     Json h = Json::object();
     h["replica_id"] = rid;
     h["age_ms"] = now - ts;
+    h["stale"] = (now - ts) >= opt_.heartbeat_timeout_ms;
     hbs.push_back(h);
   }
   out["heartbeats"] = hbs;
   if (prev_quorum_.has_value()) {
     Json q = Json::object();
     q["quorum_id"] = prev_quorum_->quorum_id;
+    q["created_ms"] = prev_quorum_->created_ms;
+    q["age_ms"] = wall_ms() - prev_quorum_->created_ms;
+    int64_t max_step = 0;
+    for (const auto& p : prev_quorum_->participants)
+      max_step = std::max(max_step, p.step);
     Json parts = Json::array();
     for (const auto& p : prev_quorum_->participants) {
       Json m = Json::object();
       m["replica_id"] = p.replica_id;
       m["address"] = p.address;
+      m["store_address"] = p.store_address;
       m["step"] = p.step;
+      m["world_size"] = p.world_size;
+      m["recovering"] = p.step < max_step;
       parts.push_back(m);
     }
     q["participants"] = parts;
@@ -466,28 +497,48 @@ std::string LighthouseServer::render_status_json() {
 }
 
 std::string LighthouseServer::render_status_html() {
+  // Parity with the reference's askama status page
+  // (reference templates/status.html:1-52, src/lighthouse.rs:415-452):
+  // live next-quorum status, prev-quorum summary (id, participant count,
+  // age), per-member card fields (step/manager/store/world_size) with a
+  // "recovering" badge when behind max_step, a kill button, and a full
+  // heartbeat list with an "old" marker past the heartbeat timeout.
+  // Auto-refresh via meta refresh instead of htmx (no JS dependency).
   std::lock_guard<std::mutex> g(mu_);
   int64_t now = now_ms();
+  // Recompute the quorum reason LIVE like the reference's get_status
+  // (lighthouse.rs:419) rather than echoing the last tick's.
+  std::string live_reason;
+  quorum_compute(now, &live_reason);
   std::ostringstream os;
   os << "<!doctype html><html><head><title>torchft_tpu lighthouse</title>"
+     << "<meta http-equiv=\"refresh\" content=\"2\">"
      << "<style>body{font-family:monospace;margin:2em}table{border-collapse:"
-        "collapse}td,th{border:1px solid #888;padding:4px 8px}</style>"
+        "collapse}td,th{border:1px solid #888;padding:4px 8px}"
+        "tr.recovering{background:#fff3cd}li.old{color:#b00}</style>"
      << "</head><body><h1>torchft_tpu lighthouse</h1>"
      << "<p>quorum_id: " << quorum_id_ << "</p>"
-     << "<p>status: " << last_reason_ << "</p>";
+     << "<p>next quorum status: " << live_reason << "</p>";
   if (prev_quorum_.has_value()) {
+    int64_t age_ms = wall_ms() - prev_quorum_->created_ms;
     os << "<h2>previous quorum (id " << prev_quorum_->quorum_id << ")</h2>"
-       << "<table><tr><th>replica</th><th>step</th><th>address</th>"
-       << "<th>heartbeat age (ms)</th><th>state</th><th></th></tr>";
+       << "<p>participants: " << prev_quorum_->participants.size()
+       << " &middot; quorum age: " << (age_ms / 1000.0) << "s</p>"
+       << "<table><tr><th>replica</th><th>step</th><th>manager</th>"
+       << "<th>store</th><th>world</th><th>heartbeat age (ms)</th>"
+       << "<th>state</th><th></th></tr>";
     int64_t max_step = 0;
     for (const auto& p : prev_quorum_->participants)
       max_step = std::max(max_step, p.step);
     for (const auto& p : prev_quorum_->participants) {
       auto hb = heartbeats_.find(p.replica_id);
       int64_t age = hb == heartbeats_.end() ? -1 : now - hb->second;
-      os << "<tr><td>" << p.replica_id << "</td><td>" << p.step << "</td><td>"
-         << p.address << "</td><td>" << age << "</td><td>"
-         << (p.step < max_step ? "recovering" : "healthy") << "</td>"
+      bool recovering = p.step < max_step;
+      os << "<tr class=\"" << (recovering ? "recovering" : "healthy")
+         << "\"><td>" << p.replica_id << "</td><td>" << p.step << "</td><td>"
+         << p.address << "</td><td>" << p.store_address << "</td><td>"
+         << p.world_size << "</td><td>" << age << "</td><td>"
+         << (recovering ? "recovering" : "healthy") << "</td>"
          << "<td><form method=post action=\"/replica/" << p.replica_id
          << "/kill\"><button>kill</button></form></td></tr>";
     }
@@ -496,6 +547,14 @@ std::string LighthouseServer::render_status_html() {
   os << "<h2>pending participants (" << participants_.size() << ")</h2><ul>";
   for (const auto& [rid, det] : participants_)
     os << "<li>" << rid << " (step " << det.member.step << ")</li>";
+  os << "</ul><h2>heartbeats (" << heartbeats_.size() << ")</h2><ul>";
+  for (const auto& [rid, ts] : heartbeats_) {
+    int64_t age = now - ts;
+    bool old = age >= opt_.heartbeat_timeout_ms;
+    os << "<li class=\"" << (old ? "old" : "fresh") << "\">" << rid
+       << ": seen " << (age / 1000.0) << "s ago"
+       << (old ? " (stale)" : "") << "</li>";
+  }
   os << "</ul></body></html>";
   return os.str();
 }

@@ -85,6 +85,13 @@ class LighthouseServer : public RpcServer {
   struct ParticipantDetails {
     QuorumMember member;
     int64_t joined_ms = 0;
+    // Monotone registration token: a quorum handler that EXITS without a
+    // quorum (timeout/shutdown) deregisters its own entry, but only if no
+    // newer handler for the same replica_id has re-registered since — a
+    // dead requester must not linger as a "ghost participant" that
+    // satisfies the next formation's barrier without anyone waiting on
+    // the result (see rpc_quorum).
+    int64_t reg_token = 0;
   };
 
   // Pure decision function over current state; returns participants if a
@@ -118,6 +125,7 @@ class LighthouseServer : public RpcServer {
   int64_t quorum_id_ = 0;
   // Broadcast: monotonically increasing sequence of formed quorums.
   int64_t quorum_seq_ = 0;
+  int64_t next_reg_token_ = 0;
   Quorum latest_quorum_;
   std::string last_reason_;
 

@@ -1,0 +1,104 @@
+// Fused int8 row-quantization codec for the DCN host collective path.
+//
+// TPU-first rationale: on-device quantization is the Pallas kernel
+// (torchft_tpu/ops/pallas_quant.py); this file is the HOST side of the
+// wire codec — the analog of the reference's fused Triton quantization
+// kernels (reference: torchft/quantization.py:44-430) re-targeted at the
+// host CPU that feeds the DCN socket.  The numpy codec in
+// torchft_tpu/ops/quantization.py makes ~6 full memory passes (abs temp,
+// row max, broadcast multiply temp, rint, astype copy, pack concat); at
+// GB-scale pseudograd fragments that is the dominant cost of the
+// quantized wire.  These loops fuse each stage into row-blocked passes —
+// a 2048-float row lives in L1, so the absmax pass and the scale+round+
+// narrow pass read main memory once between them.
+//
+// Semantics are bit-identical to the numpy reference codec (asserted in
+// tests/test_pallas_quant.py::test_native_host_codec_*): same absmax
+// threshold for degenerate rows, same f32 reciprocal-scale multiply, same
+// round-half-to-even (nearbyintf under the default FP environment ==
+// np.rint), same int8 narrowing.
+//
+// All functions are GIL-free (called via ctypes, which releases the GIL),
+// so a rank's codec overlaps the shaped wire sleeps of its peers on a
+// shared host.
+
+#include <cfloat>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Threshold below which a row is encoded as exact zeros with scale 1.0
+// (absmax so small that qmax/absmax would overflow f32 — matches the
+// numpy codec's `nonzero = absmax > qmax / finfo(f32).max`).
+inline bool degenerate(float absmax, float qmax) {
+  return !(absmax > qmax / FLT_MAX);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Per-row absmax int8 quantize: in[rows*cols] f32 -> scales[rows] f32 +
+// payload[rows*cols] int8.  Row-blocked: each row is read from RAM once
+// for absmax and is still cache-hot for the quantize pass.
+void tft_quant_int8(const float* in, int64_t rows, int64_t cols,
+                    float* scales, int8_t* payload) {
+  const float qmax = 127.0f;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* row = in + r * cols;
+    int8_t* out = payload + r * cols;
+    float absmax = 0.0f;
+    for (int64_t c = 0; c < cols; ++c) {
+      float a = std::fabs(row[c]);
+      absmax = a > absmax ? a : absmax;
+    }
+    if (degenerate(absmax, qmax)) {
+      scales[r] = 1.0f;
+      // numpy path: payload = rint(x * 1.0) -> 0 for |x| < ~1e-36
+      std::memset(out, 0, static_cast<size_t>(cols));
+      continue;
+    }
+    scales[r] = absmax / qmax;
+    const float inv = qmax / absmax;
+    for (int64_t c = 0; c < cols; ++c) {
+      // nearbyintf == round-half-to-even under the default FP env ==
+      // np.rint; the product is bounded to +-(127 + 1ulp) by absmax
+      // scaling, so the int8 narrowing cannot wrap.
+      out[c] = static_cast<int8_t>(nearbyintf(row[c] * inv));
+    }
+  }
+}
+
+// Dequantize-accumulate: acc[rows*cols] (f32) op= payload * scale.
+// overwrite=1 initializes acc (no zero-fill pass, no separate first add);
+// overwrite=0 accumulates.  One int8 read + one f32 write (+ one f32
+// read when accumulating) — the numpy path widens to a full f32 temp
+// first.
+void tft_dequant_fma(const int8_t* payload, const float* scales,
+                     int64_t rows, int64_t cols, float* acc, int overwrite) {
+  for (int64_t r = 0; r < rows; ++r) {
+    const int8_t* row = payload + r * cols;
+    float* dst = acc + r * cols;
+    const float s = scales[r];
+    if (overwrite) {
+      for (int64_t c = 0; c < cols; ++c) {
+        dst[c] = static_cast<float>(row[c]) * s;
+      }
+    } else {
+      for (int64_t c = 0; c < cols; ++c) {
+        dst[c] += static_cast<float>(row[c]) * s;
+      }
+    }
+  }
+}
+
+// Uniform in-place divide (the fused AVG step after accumulation).
+// A true divide, not multiply-by-reciprocal: bit-identical to the numpy
+// fallback's `acc /= average_by`.
+void tft_div_f32(float* acc, int64_t n, float div) {
+  for (int64_t i = 0; i < n; ++i) acc[i] /= div;
+}
+
+}  // extern "C"

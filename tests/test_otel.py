@@ -1,0 +1,192 @@
+"""OTLP/HTTP exporter behind the events seam, tested against an
+in-process fake collector (no egress; reference: torchft/otel.py:42-86
+ships Tee(Console + OTLP-HTTP) with batching + resource attrs)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from torchft_tpu.utils.logging import log_event, unregister_exporter
+from torchft_tpu.utils.otel import (
+    OTLPHTTPExporter,
+    load_resource_attributes,
+    maybe_install_from_env,
+)
+
+
+class _FakeCollector:
+    """Minimal OTLP/HTTP logs collector: records every POST /v1/logs."""
+
+    def __init__(self, status: int = 200):
+        self.requests = []
+        self.status = status
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                outer.requests.append(
+                    {"path": self.path, "body": json.loads(body)}
+                )
+                self.send_response(outer.status)
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture
+def collector():
+    c = _FakeCollector()
+    yield c
+    c.close()
+
+
+class TestOTLPExporter:
+    def test_exports_otlp_log_shape(self, collector):
+        exp = OTLPHTTPExporter(
+            collector.endpoint,
+            resource_attributes={"deployment": "test-pod"},
+            flush_interval_s=0.1,
+        )
+        try:
+            exp.export(
+                {"ts": 1234.5, "kind": "quorum", "message": "joined",
+                 "quorum_id": 7, "replica_id": "r0"}
+            )
+            assert exp.flush(timeout=5.0)
+        finally:
+            exp.close()
+        assert len(collector.requests) == 1
+        req = collector.requests[0]
+        assert req["path"] == "/v1/logs"
+        rl = req["body"]["resourceLogs"][0]
+        res_attrs = {
+            a["key"]: a["value"] for a in rl["resource"]["attributes"]
+        }
+        assert res_attrs["service.name"] == {"stringValue": "torchft_tpu"}
+        assert res_attrs["deployment"] == {"stringValue": "test-pod"}
+        rec = rl["scopeLogs"][0]["logRecords"][0]
+        assert rec["timeUnixNano"] == str(int(1234.5 * 1e9))
+        assert rec["severityText"] == "INFO"
+        assert rec["body"] == {"stringValue": "joined"}
+        attrs = {a["key"]: a["value"] for a in rec["attributes"]}
+        assert attrs["event.kind"] == {"stringValue": "quorum"}
+        assert attrs["quorum_id"] == {"intValue": "7"}
+        assert attrs["replica_id"] == {"stringValue": "r0"}
+        assert exp.exported == 1 and exp.dropped == 0
+
+    def test_error_severity_and_batching(self, collector):
+        exp = OTLPHTTPExporter(
+            collector.endpoint, max_batch=2, flush_interval_s=30.0
+        )
+        try:
+            # max_batch=2 triggers a flush without waiting the interval
+            exp.export({"ts": 1.0, "kind": "error", "message": "boom"})
+            exp.export({"ts": 2.0, "kind": "commit", "message": "ok"})
+            assert exp.flush(timeout=5.0)
+        finally:
+            exp.close()
+        recs = collector.requests[0]["body"]["resourceLogs"][0]["scopeLogs"][0][
+            "logRecords"
+        ]
+        assert len(recs) == 2  # one batch, two records
+        assert recs[0]["severityText"] == "ERROR"
+        assert recs[0]["severityNumber"] == 17
+        assert recs[1]["severityText"] == "INFO"
+
+    def test_collector_down_never_raises(self):
+        # nothing listens on this port: every batch drops, export/close
+        # stay silent (a sink must never take down training)
+        exp = OTLPHTTPExporter(
+            "http://127.0.0.1:9",  # discard port, connection refused
+            flush_interval_s=0.05,
+            timeout_s=0.5,
+        )
+        try:
+            exp.export({"ts": 1.0, "kind": "abort", "message": "x"})
+            deadline = time.monotonic() + 5.0
+            while exp.dropped == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            exp.close()
+        assert exp.dropped == 1 and exp.exported == 0
+
+    def test_collector_http_error_counts_dropped(self):
+        c = _FakeCollector(status=503)
+        exp = OTLPHTTPExporter(c.endpoint, flush_interval_s=0.05)
+        try:
+            exp.export({"ts": 1.0, "kind": "quorum", "message": "x"})
+            deadline = time.monotonic() + 5.0
+            while exp.dropped == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            exp.close()
+            c.close()
+        assert exp.dropped == 1
+
+    def test_wired_through_event_pipeline(self, collector):
+        exp = OTLPHTTPExporter(collector.endpoint, flush_interval_s=0.1)
+        from torchft_tpu.utils.logging import register_exporter
+
+        register_exporter(exp)
+        try:
+            log_event("quorum", "pipeline-test", quorum_id=42)
+            assert exp.flush(timeout=5.0)
+        finally:
+            unregister_exporter(exp)
+        bodies = [
+            r["body"]["stringValue"]
+            for req in collector.requests
+            for sl in req["body"]["resourceLogs"][0]["scopeLogs"]
+            for r in sl["logRecords"]
+        ]
+        assert "pipeline-test" in bodies
+
+    def test_resource_attributes_file(self, tmp_path, monkeypatch):
+        path = tmp_path / "attrs.json"
+        path.write_text(
+            json.dumps({"torchft_tpu": {"cluster": "c1"}, "other": {"x": 1}})
+        )
+        monkeypatch.setenv(
+            "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON", str(path)
+        )
+        assert load_resource_attributes("torchft_tpu") == {"cluster": "c1"}
+        assert load_resource_attributes("missing") == {}
+        monkeypatch.setenv(
+            "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON", str(tmp_path / "no.json")
+        )
+        assert load_resource_attributes() == {}
+
+    def test_env_gate(self, collector, monkeypatch):
+        monkeypatch.delenv("TORCHFT_USE_OTEL", raising=False)
+        assert maybe_install_from_env() is None
+        monkeypatch.setenv("TORCHFT_USE_OTEL", "true")
+        monkeypatch.setenv(
+            "OTEL_EXPORTER_OTLP_LOGS_ENDPOINT", collector.endpoint
+        )
+        exp = maybe_install_from_env()
+        assert exp is not None
+        try:
+            log_event("commit", "gated", step=1)
+            assert exp.flush(timeout=5.0)
+        finally:
+            unregister_exporter(exp)
+        assert exp.exported >= 1

@@ -103,3 +103,85 @@ class TestFusedReduce:
         # two quantization stages; error bounded by ~2 steps of the mean's range
         step = np.abs(exact).max() / 127.0
         assert np.abs(out - exact).max() <= 4 * step
+
+
+class TestNativeHostCodec:
+    """The C fused codec (native/quant.cc) must be bit-identical to the
+    numpy reference codec — same wire bytes, same decode, same reduce."""
+
+    def _toggle(self, monkeypatch, native: bool):
+        if native:
+            monkeypatch.delenv("TORCHFT_NO_NATIVE_QUANT", raising=False)
+        else:
+            monkeypatch.setenv("TORCHFT_NO_NATIVE_QUANT", "1")
+
+    def test_native_available(self):
+        # the target environment always has g++/make; the fallback exists
+        # for exotic deploys, but HERE the fast path must actually engage
+        assert host_q._native_lib() is not None
+
+    @pytest.mark.parametrize("shape", [(1, 1), (3, 7), (64, 2048), (5, 1)])
+    def test_quantize_bitwise(self, shape, monkeypatch):
+        a = _rand(shape, seed=3)
+        self._toggle(monkeypatch, native=False)
+        s_np, p_np = host_q.quantize(a)
+        self._toggle(monkeypatch, native=True)
+        s_c, p_c = host_q.quantize(a)
+        np.testing.assert_array_equal(s_np, s_c)
+        np.testing.assert_array_equal(p_np, p_c)
+
+    def test_quantize_degenerate_rows_bitwise(self, monkeypatch):
+        a = np.zeros((4, 16), dtype=np.float32)
+        a[1] = 1e-38  # below the absmax threshold -> zeros, scale 1.0
+        a[2] = np.linspace(-1, 1, 16, dtype=np.float32)
+        self._toggle(monkeypatch, native=False)
+        s_np, p_np = host_q.quantize(a)
+        self._toggle(monkeypatch, native=True)
+        s_c, p_c = host_q.quantize(a)
+        np.testing.assert_array_equal(s_np, s_c)
+        np.testing.assert_array_equal(p_np, p_c)
+
+    def test_quantize_packed_bitwise(self, monkeypatch):
+        a = _rand((9, 131), seed=4)
+        self._toggle(monkeypatch, native=False)
+        buf_np = host_q.quantize_packed(a)
+        self._toggle(monkeypatch, native=True)
+        buf_c = host_q.quantize_packed(a)
+        np.testing.assert_array_equal(buf_np, buf_c)
+
+    @pytest.mark.parametrize("average_by", [0, 3])
+    def test_reduce_bitwise(self, average_by, monkeypatch):
+        rows, cols = 6, 97
+        shards = [_rand((rows, cols), seed=20 + i) for i in range(3)]
+        bufs = [host_q.pack(*host_q.quantize(s)) for s in shards]
+        raw = _rand((rows, cols), seed=30)
+        self._toggle(monkeypatch, native=False)
+        out_np = host_q.reduce_quantized(
+            bufs, rows, cols, average_by=average_by, raw=raw
+        )
+        self._toggle(monkeypatch, native=True)
+        out_c = host_q.reduce_quantized(
+            bufs, rows, cols, average_by=average_by, raw=raw
+        )
+        np.testing.assert_array_equal(out_np, out_c)
+
+    def test_reduce_raw_none_requantize_false_bitwise(self, monkeypatch):
+        rows, cols = 4, 33
+        bufs = [
+            host_q.pack(*host_q.quantize(_rand((rows, cols), seed=40 + i)))
+            for i in range(2)
+        ]
+        self._toggle(monkeypatch, native=False)
+        out_np = host_q.reduce_quantized(bufs, rows, cols, requantize=False)
+        self._toggle(monkeypatch, native=True)
+        out_c = host_q.reduce_quantized(bufs, rows, cols, requantize=False)
+        np.testing.assert_array_equal(out_np, out_c)
+
+    def test_dequantize_bitwise(self, monkeypatch):
+        a = _rand((7, 55), seed=5)
+        s, p = host_q.quantize(a)
+        self._toggle(monkeypatch, native=False)
+        out_np = host_q.dequantize(s, p, a.shape, np.float32)
+        self._toggle(monkeypatch, native=True)
+        out_c = host_q.dequantize(s, p, a.shape, np.float32)
+        np.testing.assert_array_equal(out_np, out_c)

@@ -7,6 +7,7 @@ smaller world, and succeed.
 """
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -507,3 +508,62 @@ class TestFlightRecorder:
         finally:
             for pg in pgs:
                 pg.shutdown()
+
+
+class TestBandwidthShaper:
+    """Egress token-bucket shaping (the measured-DCN bench harness and
+    the TORCHFT_WIRE_GBPS knob)."""
+
+    def test_token_bucket_rate(self):
+        from torchft_tpu.parallel.process_group import _TokenBucket
+
+        bucket = _TokenBucket(100e6, burst=1 << 20)  # 100 MB/s, 1 MB burst
+        t0 = time.monotonic()
+        total = 0
+        while total < 20 << 20:  # 20 MB
+            bucket.consume(1 << 20)
+            total += 1 << 20
+        elapsed = time.monotonic() - t0
+        # fluid-model time for 20 MB minus the 1 MB burst at 100 MB/s is
+        # ~0.199 s; allow generous slop above (slow CI) but the floor
+        # proves the shaper actually paces
+        assert 0.15 <= elapsed <= 1.0, elapsed
+
+    def test_shaped_allreduce_measures_rate(self, store):
+        world = 2
+        pgs = [ProcessGroupTCP(timeout=60.0) for _ in range(world)]
+
+        def configure(rank, _):
+            pgs[rank].configure(
+                f"{store.address()}/shaped", f"rank{rank}", rank, world
+            )
+
+        run_parallel(world, configure)
+        for pg in pgs:
+            pg.set_bandwidth(0.05)  # 50 MB/s
+        # ring allreduce of 8 MB at w=2 sends ~8 MB per rank -> >= ~0.14 s
+        # after the 4 MB default burst; unshaped loopback does it in < 30 ms
+        data = np.ones(2 << 20, dtype=np.float32)
+
+        def run(rank, _):
+            t0 = time.monotonic()
+            pgs[rank].allreduce([data.copy()], REDUCE_SUM).wait(timeout=60)
+            return time.monotonic() - t0
+
+        elapsed = max(run_parallel(world, run))
+        assert elapsed >= 0.06, elapsed
+        for pg in pgs:
+            pg.set_bandwidth(None)
+        elapsed_unshaped = max(run_parallel(world, run))
+        assert elapsed_unshaped < elapsed
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_env_knob(self, store, monkeypatch):
+        monkeypatch.setenv("TORCHFT_WIRE_GBPS", "0.25")
+        pg = ProcessGroupTCP(timeout=5.0)
+        assert pg._bucket is not None
+        assert pg._bucket.rate == 0.25e9
+        monkeypatch.delenv("TORCHFT_WIRE_GBPS")
+        pg2 = ProcessGroupTCP(timeout=5.0)
+        assert pg2._bucket is None

@@ -149,9 +149,30 @@ class TestQuantizedCollectives:
 
         dev_results = run_parallel(world, run_device)
         host_results = run_parallel(world, run_host)
+        # The two paths share the wire format but intentionally diverge on
+        # a rank's OWN slice: the host path feeds it into the reduce as
+        # raw f32 (zero codec error on own data), while the device path
+        # quantizes the full matrix in one Pallas launch before the
+        # device->host copy.  So: every rank agrees bitwise WITHIN a path
+        # (each slice is reduced by exactly one owner, then allgathered),
+        # and across paths the results agree to quantization error.
+        for arrs in zip(*(r[0] for r in dev_results)):
+            for other in arrs[1:]:
+                np.testing.assert_array_equal(np.asarray(arrs[0]), np.asarray(other))
+        for arrs in zip(*host_results):
+            for other in arrs[1:]:
+                np.testing.assert_array_equal(arrs[0], other)
+        true_sums = [sum(d[i] for d in data) for i in range(2)]
         for (dev_out, wire, unq), host_out in zip(dev_results, host_results):
-            for d_arr, h_arr in zip(dev_out, host_out):
-                np.testing.assert_array_equal(np.asarray(d_arr), h_arr)
+            for d_arr, h_arr, want in zip(dev_out, host_out, true_sums):
+                scale = np.abs(want).max() + 1e-9
+                rel_d = np.abs(np.asarray(d_arr) - want).max() / scale
+                rel_h = np.abs(h_arr - want).max() / scale
+                assert rel_d < 0.05 and rel_h < 0.05, (rel_d, rel_h)
+                # the raw-own-slice host path must not be LESS accurate
+                # than the all-quantized device path (small tolerance:
+                # rounding interplay can tip individual elements)
+                assert rel_h <= rel_d * 1.05 + 1e-6, (rel_h, rel_d)
             # measured wire-byte reduction: int8 payload + f32 row scales
             # vs f32 — must be close to 4x for these sizes
             assert wire < unq / 3.5, (wire, unq)

@@ -31,6 +31,13 @@ from torchft_tpu.parallel.process_group import (
 # Reference name: torchft.Optimizer (torchft/optim.py re-exported at root).
 Optimizer = OptimizerWrapper
 
+# OTLP log export, gated on TORCHFT_USE_OTEL (reference wires its OTEL
+# pipeline at import, torchft/__init__.py:20-22 + otel.py:42-86).
+from torchft_tpu.utils.otel import maybe_install_from_env as _otel_install
+
+_otel_install()
+del _otel_install
+
 __all__ = [
     "DiLoCo",
     "DistributedDataParallel",

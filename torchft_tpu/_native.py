@@ -99,6 +99,22 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_compute_quorum_results.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
         ]
+
+        # Fused host codec (native/quant.cc) — GIL-free memory-bandwidth
+        # kernels for the int8 DCN wire; bit-identical to the numpy codec
+        # in ops/quantization.py (which stays as the fallback + fp8 path).
+        _f32p = ctypes.POINTER(ctypes.c_float)
+        _i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.tft_quant_int8.restype = None
+        lib.tft_quant_int8.argtypes = [
+            _f32p, ctypes.c_int64, ctypes.c_int64, _f32p, _i8p,
+        ]
+        lib.tft_dequant_fma.restype = None
+        lib.tft_dequant_fma.argtypes = [
+            _i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p, ctypes.c_int,
+        ]
+        lib.tft_div_f32.restype = None
+        lib.tft_div_f32.argtypes = [_f32p, ctypes.c_int64, ctypes.c_float]
         _lib = lib
         return _lib
 

@@ -38,6 +38,7 @@ from torchft_tpu.parallel.process_group import (
     REDUCE_SUM,
 )
 from torchft_tpu.parallel.work import Work, completed_work
+from torchft_tpu.utils.bufpool import POOL as _POOL
 
 
 def _slice_rows(rows: int, world: int) -> "List[tuple[int, int]]":
@@ -151,38 +152,120 @@ def allreduce_quantized(
     rows = -(-rows // world) * world
     bounds = _slice_rows(rows, world)
 
+    import time as _time
+
+    codec_s = [0.0]  # wall spent in quantize/dequant (observability)
+    my_rank = pg.rank()
+    raw_self: "Optional[np.ndarray]" = None  # own slice, codec-free f32
+
     if device_quantize:
         send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
     else:
+        t0 = _time.perf_counter()
         np_arrays = [np.asarray(a) for a in arrays]
-        flat = np.concatenate([a.astype(np.float32).ravel() for a in np_arrays])
-        mat = np.zeros((rows, cols), dtype=np.float32)
-        mat.ravel()[: flat.size] = flat
-        # quantize each destination rank's row-slice separately
+        # Zero-copy flatten: a single contiguous f32 input (THE hot case —
+        # a DiLoCo pseudograd fragment) is viewed, not copied; multi-array
+        # inputs concatenate once.  Row-slices then quantize straight off
+        # the source; only the slice that spans the padded tail pays a
+        # small zeroed copy.
+        if (
+            len(np_arrays) == 1
+            and np_arrays[0].dtype == np.float32
+            and np_arrays[0].flags.c_contiguous
+        ):
+            src = np_arrays[0].ravel()
+        else:
+            src = np.concatenate(
+                [a.astype(np.float32, copy=False).ravel() for a in np_arrays]
+            )
+        full_rows = src.size // cols
+        pooled_blocks: "List[np.ndarray]" = []
+
+        def _slice_block(start: int, end: int) -> np.ndarray:
+            if end <= full_rows:
+                return src[start * cols : end * cols].reshape(end - start, cols)
+            block = _POOL.take((end - start, cols), np.float32)
+            pooled_blocks.append(block)
+            avail = src.size - start * cols
+            flat = block.ravel()
+            if avail > 0:
+                flat[:avail] = src[start * cols :]
+                flat[avail:] = 0.0
+            else:
+                flat[:] = 0.0
+            return block
+
+        # Quantize each destination rank's row-slice separately — EXCEPT
+        # our own: alltoall self-delivers locally (the slot never hits the
+        # wire), so the own slice skips the codec entirely and enters the
+        # reduce as raw f32 (zero codec time + zero quantization error on
+        # a rank's own contribution; the reference quantizes all slices,
+        # torchft/collectives.py:345-376).
         send_bufs = []
-        for start, end in bounds:
-            scales, payload = q.quantize(mat[start:end], wire_dtype)
-            send_bufs.append(q.pack(scales, payload, wire_dtype))
+        for r, (start, end) in enumerate(bounds):
+            if r == my_rank:
+                raw_self = _slice_block(start, end)
+                send_bufs.append(np.empty(0, dtype=np.uint8))
+            else:
+                block = _slice_block(start, end)
+                send_bufs.append(
+                    q.quantize_packed(block, wire_dtype, pool=_POOL)
+                )
+                # a padded PEER block is consumed by the quantize above;
+                # the own block (raw_self) lives until the reduce
+                if pooled_blocks and pooled_blocks[-1] is block:
+                    _POOL.give(pooled_blocks.pop())
+        codec_s[0] += _time.perf_counter() - t0
+
+    reduced_box: "List[Optional[np.ndarray]]" = [None]
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
-        my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
-        reduced = q.reduce_quantized(
-            received, my_rows, cols, average_by=divisor, wire_dtype=wire_dtype
-        )
+        # the alltoall completed: packed send buffers are drained to the
+        # sockets — recycle them (and any pooled padded blocks)
+        for r, b in enumerate(send_bufs):
+            if r != my_rank:
+                _POOL.give(b)
+        my_rows = bounds[my_rank][1] - bounds[my_rank][0]
+        t0 = _time.perf_counter()
+        if raw_self is not None:
+            bufs = [b for r, b in enumerate(received) if r != my_rank]
+            reduced = q.reduce_quantized(
+                bufs, my_rows, cols, average_by=divisor,
+                wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
+            )
+            while pooled_blocks:
+                _POOL.give(pooled_blocks.pop())
+        else:
+            reduced = q.reduce_quantized(
+                received, my_rows, cols, average_by=divisor,
+                wire_dtype=wire_dtype, pool=_POOL,
+            )
+        codec_s[0] += _time.perf_counter() - t0
+        reduced_box[0] = reduced
         return pg.allgather(reduced)
 
     def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
-        pieces = []
+        t0 = _time.perf_counter()
+        # dequantize each rank's reduced piece straight into its offset of
+        # the full matrix — no per-piece alloc, no concat pass
+        full_mat = np.empty((rows, cols), dtype=np.float32)
         for r, buf in enumerate(gathered):
-            n_rows = bounds[r][1] - bounds[r][0]
-            scales, payload = q.unpack(buf, n_rows, cols, wire_dtype)
-            pieces.append(q.dequantize(scales, payload, (n_rows, cols), np.float32))
-        full = np.concatenate(pieces).ravel()[:total]
+            start, end = bounds[r]
+            scales, payload = q.unpack(buf, end - start, cols, wire_dtype)
+            q.dequantize_into(scales, payload, full_mat[start:end])
+        _POOL.give(reduced_box[0])  # own reduced piece: wire + decode done
+        reduced_box[0] = None
+        full = full_mat.ravel()[:total]
         out = []
         offset = 0
         for shape, size, dtype in zip(shapes, sizes, out_dtypes):
-            out.append(full[offset : offset + size].reshape(shape).astype(dtype))
+            # asarray: zero-copy view when dtype is already f32 (disjoint
+            # slices of `full`, which the concatenate just materialized)
+            out.append(
+                np.asarray(full[offset : offset + size].reshape(shape), dtype=dtype)
+            )
             offset += size
+        codec_s[0] += _time.perf_counter() - t0
         return out
 
     # Chain: alltoall -> local fused reduce -> allgather -> dequantize.
@@ -217,11 +300,19 @@ def allreduce_quantized(
     work.get_future().add_done_callback(_stage2)
     out_work = Work(out_fut)
     # Observability: measured wire bytes vs the unquantized f32 equivalent
-    # (the ~4x reduction the codec exists for).
-    out_work.wire_bytes = sum(b.nbytes for b in send_bufs)
+    # (the ~4x reduction the codec exists for).  alltoall leg: only slots
+    # bound for peers hit the wire (self-delivery is a local copy); the
+    # allgather ring then sends (w-1) reduced pieces per rank.
+    my_rows_n = bounds[my_rank][1] - bounds[my_rank][0]
+    piece_bytes = 4 + my_rows_n * 4 + my_rows_n * cols
+    out_work.wire_bytes = (
+        sum(b.nbytes for r, b in enumerate(send_bufs) if r != my_rank)
+        + (world - 1) * piece_bytes
+    )
     out_work.unquantized_wire_bytes = 4 * total
     out_work.device_quantized = bool(device_quantize)
     out_work.wire_dtype = wire_dtype
+    out_work.codec_s_box = codec_s  # filled as stages run; read after wait
     return out_work
 
 

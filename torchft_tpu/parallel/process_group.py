@@ -31,6 +31,7 @@ JAX mesh composition lives in torchft_tpu/parallel/device_mesh.py).
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import queue
 import socket
@@ -286,6 +287,38 @@ class _PeerConn:
             pass
 
 
+class _TokenBucket:
+    """Egress token bucket shared by a PG's sender threads.
+
+    ``consume(n)`` debits ``n`` bytes and sleeps off any debt, so the
+    long-run egress rate converges to ``rate`` bytes/s while short bursts
+    up to ``burst`` pass unthrottled (one socket-buffer's worth — shaping
+    below that granularity would only measure syscall overhead).  The
+    sleep happens OUTSIDE the lock: concurrent senders each serve their
+    own debt, and because debits are serialized under the lock the debt
+    each sender sleeps for is its own marginal contribution.
+    """
+
+    def __init__(self, rate_bytes_per_s: float, burst: int = 4 << 20) -> None:
+        self.rate = float(rate_bytes_per_s)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def consume(self, nbytes: int) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate
+            )
+            self._t = now
+            self._tokens -= nbytes
+            debt = -self._tokens
+        if debt > 0:
+            time.sleep(debt / self.rate)
+
+
 class _PGAborted(RuntimeError):
     pass
 
@@ -312,7 +345,11 @@ class ProcessGroupTCP(ProcessGroup):
     stay in sync (the standard collective contract).
     """
 
-    def __init__(self, timeout: float = 60.0) -> None:
+    def __init__(
+        self,
+        timeout: float = 60.0,
+        bandwidth_gbps: "Optional[float]" = None,
+    ) -> None:
         super().__init__(timeout)
         self._rank = -1
         self._world = 0
@@ -321,6 +358,18 @@ class ProcessGroupTCP(ProcessGroup):
         self._errored: Optional[Exception] = None
         self._aborted = False
         self._generation = 0
+        # Egress bandwidth shaping (token bucket across all sender
+        # threads).  Two uses: benchmarking the quantized wire under a
+        # *measured* DCN bandwidth instead of loopback's effectively
+        # infinite one, and capping a training job's DCN footprint on
+        # shared links.  None = unshaped; TORCHFT_WIRE_GBPS supplies a
+        # default (decimal GB/s, e.g. "0.5").
+        if bandwidth_gbps is None:
+            env = os.environ.get("TORCHFT_WIRE_GBPS")
+            bandwidth_gbps = float(env) if env else None
+        self._bucket: "Optional[_TokenBucket]" = (
+            _TokenBucket(bandwidth_gbps * 1e9) if bandwidth_gbps else None
+        )
         # In-flight op record for the abort flight recorder.  Guarded by
         # _flight_lock: written by the worker + sender threads, dumped by
         # abort() from any thread (an unguarded dict copy can raise
@@ -334,6 +383,12 @@ class ProcessGroupTCP(ProcessGroup):
         self._queue: "queue.Queue[Optional[Tuple[int, Callable[[], Any], Future]]]" = (
             queue.Queue()
         )
+
+    def set_bandwidth(self, gbps: "Optional[float]") -> None:
+        """(Re)shape egress to ``gbps`` decimal GB/s; None removes the cap.
+        Takes effect from the next send — in-flight chunks finish at the
+        old rate."""
+        self._bucket = _TokenBucket(gbps * 1e9) if gbps else None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -620,6 +675,9 @@ class ProcessGroupTCP(ProcessGroup):
             send_peer=dst, send_tag=tag, send_bytes=array.nbytes,
             deadline_mono=deadline,
         )
+        bucket = self._bucket
+        if bucket is not None:
+            bucket.consume(8 + len(header))
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
         peer.sock.sendall(struct.pack(">II", len(header), array.nbytes) + header)
         if array.nbytes:
@@ -627,7 +685,22 @@ class ProcessGroupTCP(ProcessGroup):
             # (bfloat16/fp8 — the TPU training dtypes) have no
             # buffer-protocol format char and raise in cast(). The payload
             # still goes to the kernel straight from the array's buffer.
-            peer.sock.sendall(memoryview(array.reshape(-1).view(np.uint8)))
+            view = memoryview(array.reshape(-1).view(np.uint8))
+            if bucket is None:
+                peer.sock.sendall(view)
+            else:
+                # shaped path: pace in 1 MB chunks so the bucket's sleeps
+                # interleave with the peer's compute at sub-fragment
+                # granularity (a single consume() of a GB payload would
+                # model a link with GB-deep switch buffers)
+                chunk_len = 1 << 20
+                for off in range(0, len(view), chunk_len):
+                    chunk = view[off : off + chunk_len]
+                    bucket.consume(len(chunk))
+                    peer.sock.settimeout(
+                        max(deadline - time.monotonic(), 0.001)
+                    )
+                    peer.sock.sendall(chunk)
 
     def _recv_msg(
         self,
@@ -814,12 +887,17 @@ class ProcessGroupTCP(ProcessGroup):
         # single private buffer; chunks are views of it, so ring steps
         # receive in place and reduce in place — the only full-size copies
         # are the pad-in and (if dtype widened) the cast back out
+        from torchft_tpu.utils.bufpool import POOL as _pool
+
+        # buf escapes to the caller as the result view — not poolable;
+        # scratch is private to this call and its size repeats every ring
+        # (page-fault amortization, utils/bufpool.py)
         buf = np.empty(chunk * w, dtype=acc_dtype)
         buf[:n] = array.ravel()
         if chunk * w > n:
             buf[n:] = 0
         chunks = [buf[i * chunk : (i + 1) * chunk] for i in range(w)]
-        scratch = np.empty(chunk, dtype=acc_dtype)
+        scratch = _pool.take(chunk, acc_dtype)
 
         nxt, prv = (r + 1) % w, (r - 1) % w
         # ring reduce-scatter: after w-1 steps, chunk (r+1)%w is fully reduced
@@ -839,6 +917,7 @@ class ProcessGroupTCP(ProcessGroup):
                 nxt, 200 + step, chunks[send_idx], prv, 200 + step, deadline,
                 recv_out=chunks[recv_idx],
             )
+        _pool.give(scratch)
         result = buf[:n]
         if op == REDUCE_AVG:
             if np.issubdtype(acc_dtype, np.floating):
@@ -865,7 +944,11 @@ class ProcessGroupTCP(ProcessGroup):
                 pieces[recv_idx] = self._exchange(
                     nxt, 300 + step, pieces[send_idx], prv, 300 + step, deadline
                 )
-            return [p.copy() for p in pieces]  # type: ignore[union-attr]
+            # received pieces are already private allocations from
+            # _recv_msg; only the own piece aliases the caller's array and
+            # needs a defensive copy
+            pieces[r] = pieces[r].copy()  # type: ignore[union-attr]
+            return pieces  # type: ignore[return-value]
 
         return self._submit(run, op="allgather")
 
