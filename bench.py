@@ -346,16 +346,23 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
 # ---------------------------------------------------------------------------
 
 
-def _ddp_compute(step: int, rank: int) -> np.ndarray:
-    """The shared per-step 'gradient computation' of both twins."""
-    return np.full(PARAM_SIZE, float(step + 1), dtype=np.float32) * (
+def _ddp_compute(step: int, rank: int, reps: int = 1) -> np.ndarray:
+    """The shared per-step 'gradient computation' of both twins.  ``reps``
+    scales the compute (the cross-check mode lengthens steps so the
+    twin-ratio estimator's scheduling noise — fixed in ms — shrinks as a
+    fraction of the step)."""
+    g = np.full(PARAM_SIZE, float(step + 1), dtype=np.float32) * (
         1.0 + 0.5 * rank
     )
+    for _ in range(reps - 1):
+        g = 0.5 * (g + np.sqrt(np.abs(g) + 1.0))
+    return g
 
 
 def _bare_replica(
     rank: int, world: int, store_addr: str, barrier: "threading.Barrier",
-    out: "Dict[int, List[float]]",
+    out: "Dict[int, List[float]]", steps: int = OVERHEAD_STEPS,
+    warmup: int = OVERHEAD_WARMUP, reps: int = 1,
 ) -> None:
     """Non-FT twin: ProcessGroupTCP configured once, no Manager, no quorum,
     no commit vote — plain DDP over the identical ring."""
@@ -365,14 +372,19 @@ def _bare_replica(
         params = np.zeros(PARAM_SIZE, dtype=np.float32)
         times: "List[float]" = []
         barrier.wait(timeout=30)
-        for step in range(OVERHEAD_WARMUP + OVERHEAD_STEPS):
+        cpu0 = time.process_time()
+        for step in range(warmup + steps):
             t0 = time.perf_counter()
-            grads = _ddp_compute(step, rank)
+            grads = _ddp_compute(step, rank, reps)
             (summed,) = pg.allreduce([grads], REDUCE_SUM).wait(timeout=30)
             summed /= world
             params -= 0.1 * summed
             times.append(time.perf_counter() - t0)
-        out[rank] = times[OVERHEAD_WARMUP:]
+        # process-wide CPU per step over the stepping window (both ranks
+        # read the same counter; rank 0's delta is the window's total)
+        if rank == 0:
+            out[-1] = [(time.process_time() - cpu0) / (warmup + steps)]
+        out[rank] = times[warmup:]
     finally:
         pg.shutdown()
 
@@ -380,6 +392,8 @@ def _bare_replica(
 def _ft_replica(
     rank: int, lighthouse_addr: str, barrier: "threading.Barrier",
     out: "Dict[int, List[float]]", phases: "Dict[int, Dict[str, float]]",
+    steps: int = OVERHEAD_STEPS, warmup: int = OVERHEAD_WARMUP,
+    reps: int = 1,
 ) -> None:
     """FT twin: same compute, same ring, driven through the full Manager
     per-step protocol (async quorum + allreduce + commit vote)."""
@@ -402,33 +416,42 @@ def _ft_replica(
         times: "List[float]" = []
         acc: "Dict[str, float]" = {}
         barrier.wait(timeout=30)
+        cpu0 = time.process_time()
         step = 0
         attempts = 0
-        while step < OVERHEAD_WARMUP + OVERHEAD_STEPS:
+        while step < warmup + steps:
             attempts += 1
-            if attempts > 3 * (OVERHEAD_WARMUP + OVERHEAD_STEPS):
+            if attempts > 3 * (warmup + steps):
                 raise RuntimeError(
                     f"FT twin stuck: {step} committed after {attempts} attempts"
                 )
             t0 = time.perf_counter()
             manager.start_quorum()
-            grads = _ddp_compute(step, rank)
+            grads = _ddp_compute(step, rank, reps)
             avg = manager.allreduce({"g": grads}).wait(timeout=30)
             if manager.should_commit():
                 state["params"] -= 0.1 * avg["g"]
                 times.append(time.perf_counter() - t0)
                 phase = manager.pop_phase_times()
-                if step >= OVERHEAD_WARMUP:
+                if step >= warmup:
                     for k, v in phase.items():
                         acc[k] = acc.get(k, 0.0) + v
                 step += 1
-        out[rank] = times[OVERHEAD_WARMUP:]
+        if rank == 0:
+            # process-wide CPU/step: includes the async quorum thread and
+            # manager server threads — the background work the caller-side
+            # phase sum deliberately excludes
+            out[-1] = [(time.process_time() - cpu0) / (warmup + steps)]
+        out[rank] = times[warmup:]
         phases[rank] = acc
     finally:
         manager.shutdown()
 
 
-def _run_bare_twin(world: int) -> float:
+def _run_bare_twin(
+    world: int, steps: int = OVERHEAD_STEPS, warmup: int = OVERHEAD_WARMUP,
+    reps: int = 1, cpu_out: "Optional[List[float]]" = None,
+) -> float:
     store = StoreServer()
     times: "Dict[int, List[float]]" = {}
     try:
@@ -436,7 +459,8 @@ def _run_bare_twin(world: int) -> float:
         threads = [
             threading.Thread(
                 target=_bare_replica,
-                args=(r, world, store.address(), barrier, times),
+                args=(r, world, store.address(), barrier, times, steps,
+                      warmup, reps),
                 daemon=True,
             )
             for r in range(world)
@@ -444,14 +468,21 @@ def _run_bare_twin(world: int) -> float:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=120)
+            t.join(timeout=240)
     finally:
         store.shutdown()
+    cpu = times.pop(-1, None)
+    if cpu_out is not None and cpu:
+        cpu_out.append(cpu[0])
     assert len(times) == world, "bare twin failed"
     return statistics.median([t for ts in times.values() for t in ts])
 
 
-def _run_ft_twin(world: int, phase_out: "Dict[str, float]") -> float:
+def _run_ft_twin(
+    world: int, phase_out: "Dict[str, float]",
+    steps: int = OVERHEAD_STEPS, warmup: int = OVERHEAD_WARMUP,
+    reps: int = 1, cpu_out: "Optional[List[float]]" = None,
+) -> float:
     """Runs the FT twin; merges this run's mean phase ms/step into
     ``phase_out`` (caller divides by number of runs)."""
     lighthouse = LighthouseServer(
@@ -464,7 +495,8 @@ def _run_ft_twin(world: int, phase_out: "Dict[str, float]") -> float:
         threads = [
             threading.Thread(
                 target=_ft_replica,
-                args=(r, lighthouse.address(), barrier, times, phases),
+                args=(r, lighthouse.address(), barrier, times, phases, steps,
+                      warmup, reps),
                 daemon=True,
             )
             for r in range(world)
@@ -472,13 +504,16 @@ def _run_ft_twin(world: int, phase_out: "Dict[str, float]") -> float:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=120)
+            t.join(timeout=240)
     finally:
         lighthouse.shutdown()
+    cpu = times.pop(-1, None)
+    if cpu_out is not None and cpu:
+        cpu_out.append(cpu[0])
     assert len(times) == world, "FT twin failed"
     for acc in phases.values():
         for k, v in acc.items():
-            phase_out[k] = phase_out.get(k, 0.0) + v * 1e3 / OVERHEAD_STEPS / len(phases)
+            phase_out[k] = phase_out.get(k, 0.0) + v * 1e3 / steps / len(phases)
     return statistics.median([t for ts in times.values() for t in ts])
 
 
@@ -543,6 +578,114 @@ def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
     }
 
 
+def bench_overhead_crosscheck(rounds: int = 4) -> "Dict[str, Any]":
+    """Two-estimator convergence check (VERDICT r4 item 7): the headline
+    <= 5% claim rests on the phase-sum estimator; this mode de-noises the
+    twin-ratio estimator until the two can be compared on a 1-core host.
+
+    De-contenting levers:
+    - LONG steps (compute reps stretch ~50 ms steps to ~200+ ms): the
+      twin-ratio's scheduling noise is fixed in ms, so its share of the
+      ratio shrinks ~4x;
+    - alternating windows (bare/FT/bare/FT...) with per-window pairing
+      and a median-of-ratios: host drift (page cache, cron, thermal)
+      lands on both twins of a pair instead of one side of a long run.
+
+    Convergence = |twin_ratio - overhead_pct| within ~2 points.  If the
+    gap stays larger, the residual is the ASYNC QUORUM THREAD's CPU
+    steal: on 1 core the Manager's background quorum thread (RPC encode/
+    decode, store I/O) preempts compute, which the caller-thread phase
+    sum deliberately excludes because on a deployment host (>= 1 core per
+    replica + servers) it runs on spare cores.  The JSON carries both
+    estimators + the gap so the claim is auditable either way.
+    """
+    world = 2
+    # ~4x longer steps; fewer steps/rounds to keep the wall bounded
+    reps, steps, warmup = 6, 12, 3
+    ratios: "List[float]" = []
+    cpu_ratios: "List[float]" = []
+    null_ratios: "List[float]" = []
+    protocol_ms_runs: "List[float]" = []
+    bare_ms_runs: "List[float]" = []
+    for _ in range(rounds):
+        bare_cpu: "List[float]" = []
+        ft_cpu: "List[float]" = []
+        # NULL experiment: bare vs bare — identical twins.  Whatever ratio
+        # spread the null shows is the estimator's noise floor; an FT-vs-
+        # bare difference smaller than that floor is unmeasurable by ANY
+        # twin comparison on this host, de-contended or not.
+        b_null = _run_bare_twin(world, steps=steps, warmup=warmup, reps=reps)
+        b = _run_bare_twin(
+            world, steps=steps, warmup=warmup, reps=reps, cpu_out=bare_cpu
+        )
+        null_ratios.append(b / b_null)
+        phases: "Dict[str, float]" = {}
+        f = _run_ft_twin(
+            world, phases, steps=steps, warmup=warmup, reps=reps,
+            cpu_out=ft_cpu,
+        )
+        ratios.append(f / b)
+        if bare_cpu and ft_cpu:
+            cpu_ratios.append(ft_cpu[0] / bare_cpu[0])
+        bare_ms_runs.append(b * 1e3)
+        protocol_ms_runs.append(
+            phases.get("quorum_wait", 0.0)
+            + phases.get("commit", 0.0)
+            + phases.get("host_sync", 0.0)
+        )
+    bare_ms = min(bare_ms_runs)
+    protocol_ms = min(protocol_ms_runs)
+    overhead_pct = protocol_ms / bare_ms * 100.0
+    twin_ratio_pct = (statistics.median(ratios) - 1.0) * 100.0
+    # CPU-time ratio: the de-contended estimator.  process_time over the
+    # stepping window counts every thread's ACTUAL work (incl. the async
+    # quorum/background threads) and excludes idle scheduling gaps — the
+    # component of the wall-ratio that made r4's 8.28% unusable.
+    cpu_ratio_pct = (
+        (statistics.median(cpu_ratios) - 1.0) * 100.0 if cpu_ratios else None
+    )
+    gap = (cpu_ratio_pct - overhead_pct) if cpu_ratio_pct is not None else None
+    # noise floor: half the null twins' ratio spread, in points
+    null_spread_pts = (
+        (max(null_ratios) - min(null_ratios)) / 2.0 * 100.0
+        if null_ratios else None
+    )
+    converged = gap is not None and abs(gap) <= 2.0
+    # falsified = the estimators did NOT converge, but the null experiment
+    # shows the twin estimator cannot resolve effects this small here:
+    # the FT-vs-bare gap is within the bare-vs-bare noise floor.
+    falsified = (
+        not converged
+        and gap is not None
+        and null_spread_pts is not None
+        and abs(gap) <= null_spread_pts + 2.0
+    )
+    log(
+        f"overhead cross-check (long {bare_ms:.0f} ms steps, alternating "
+        f"windows): phase-sum {overhead_pct:+.2f}% vs cpu-ratio "
+        f"{cpu_ratio_pct:+.2f}% (gap {gap:+.2f} pts) vs wall twin-ratio "
+        f"{twin_ratio_pct:+.2f}%; NULL bare-vs-bare ratios "
+        f"{[round(r, 4) for r in null_ratios]} -> noise floor "
+        f"+-{null_spread_pts:.1f} pts "
+        f"({'converged' if converged else 'estimator noise-floor-bound' if falsified else 'UNEXPLAINED'})"
+    )
+    return {
+        "long_step_ms": round(bare_ms, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "cpu_ratio_pct": round(cpu_ratio_pct, 2) if cpu_ratio_pct is not None else None,
+        "twin_ratio_pct": round(twin_ratio_pct, 2),
+        "gap_pts": round(gap, 2) if gap is not None else None,
+        "converged_2pts": converged,
+        "null_ratio_spread_pts": (
+            round(null_spread_pts, 2) if null_spread_pts is not None else None
+        ),
+        "noise_floor_bound": falsified,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "cpu_pair_ratios": [round(r, 4) for r in cpu_ratios],
+        "null_pair_ratios": [round(r, 4) for r in null_ratios],
+    }
+
+
 # ---------------------------------------------------------------------------
 # 3. DiLoCo outer sync at flagship scale (the BASELINE.json north star)
 # ---------------------------------------------------------------------------
@@ -552,7 +695,9 @@ DILOCO_FRAGMENTS = 8            # Streaming DiLoCo fragment count
 DILOCO_SYNC_EVERY = 20          # inner steps per fragment cycle
 
 
-def bench_diloco_vs_ddp(nonft_ddp_step_ms: float) -> "Dict[str, Any]":
+def bench_diloco_vs_ddp(
+    nonft_ddp_step_ms: float, gbps: "Optional[float]" = None
+) -> "Dict[str, Any]":
     """BASELINE.json's own arithmetic, measured: FT Streaming DiLoCo's
     step cost vs the NON-FT DDP twin (the '<= 5% overhead on the
     train_diloco config' target).  Same per-step compute as the DDP
@@ -562,10 +707,39 @@ def bench_diloco_vs_ddp(nonft_ddp_step_ms: float) -> "Dict[str, Any]":
     load epoch (still a twin-loop comparison — ±20% noise-bound on the
     1-core host, docs/benchmarks.md §2 — hence the decomposition into
     inner median + per-sync cost, which is the robust part).
+
+    ``gbps``: run BOTH twins under the token-bucket egress shaper (via
+    ``TORCHFT_WIRE_GBPS``, which every ProcessGroupTCP in this process
+    reads at construction) — the measured version of r4's extrapolated
+    "on real DCN the sign flips": DDP pays the shaped wire every step,
+    DiLoCo only at the outer sync.
     """
+    import os as _os
+
     import torchft_tpu as ft
 
-    nonft_ddp_step_ms = min(nonft_ddp_step_ms, _run_bare_twin(2) * 1e3)
+    prior = _os.environ.get("TORCHFT_WIRE_GBPS")
+    if prior is not None and gbps is None:
+        # a pre-set user knob would silently shape the "unshaped" leg
+        log(f"note: TORCHFT_WIRE_GBPS={prior} is set — the nominally "
+            "unshaped diloco-vs-ddp leg runs SHAPED at that rate")
+    if gbps is not None:
+        _os.environ["TORCHFT_WIRE_GBPS"] = str(gbps)
+    try:
+        return _bench_diloco_vs_ddp_body(nonft_ddp_step_ms, gbps, ft)
+    finally:
+        if gbps is not None:
+            if prior is None:
+                _os.environ.pop("TORCHFT_WIRE_GBPS", None)
+            else:
+                _os.environ["TORCHFT_WIRE_GBPS"] = prior
+
+
+def _bench_diloco_vs_ddp_body(
+    nonft_ddp_step_ms: float, gbps: "Optional[float]", ft
+) -> "Dict[str, Any]":
+    bare = _run_bare_twin(2) * 1e3
+    nonft_ddp_step_ms = bare if gbps is not None else min(nonft_ddp_step_ms, bare)
     # warmup past the FIRST sync: it pays the outer-optimizer jit compile,
     # which amortizes to nothing over a real run's thousands of syncs
     world, sync_every, inner_steps, warmup = 2, 20, 100, 25
@@ -655,18 +829,24 @@ def bench_diloco_vs_ddp(nonft_ddp_step_ms: float) -> "Dict[str, Any]":
     amortized_ms = inner_ms + per_sync_ms / sync_every
     overhead_pct = (amortized_ms / nonft_ddp_step_ms - 1.0) * 100.0
     inner_vs_ddp_pct = (inner_ms / nonft_ddp_step_ms - 1.0) * 100.0
-    log(f"diloco-vs-ddp: FT DiLoCo inner step {inner_ms:.1f} ms "
+    wire_note = (
+        f"both twins shaped to {gbps} GB/s egress"
+        if gbps is not None
+        else "loopback makes the per-step allreduce DiLoCo avoids nearly free"
+    )
+    log(f"diloco-vs-ddp{f' @{gbps} GB/s' if gbps else ''}: FT DiLoCo inner "
+        f"step {inner_ms:.1f} ms "
         f"({inner_vs_ddp_pct:+.1f}% vs non-FT DDP {nonft_ddp_step_ms:.1f} ms"
         f" — no per-step allreduce), outer sync {per_sync_ms:.0f} ms every "
         f"{sync_every} steps -> amortized {amortized_ms:.1f} ms = "
-        f"{overhead_pct:+.1f}% (loopback makes the per-step allreduce "
-        f"DiLoCo avoids nearly free; on real DCN the sign flips)")
+        f"{overhead_pct:+.1f}% ({wire_note})")
     return {
         "diloco_inner_step_ms": round(inner_ms, 2),
         "diloco_inner_vs_nonft_ddp_pct": round(inner_vs_ddp_pct, 1),
         "diloco_sync_ms": round(per_sync_ms, 1),
         "diloco_amortized_step_ms": round(amortized_ms, 2),
         "diloco_vs_nonft_ddp_pct": round(overhead_pct, 1),
+        "nonft_ddp_step_ms": round(nonft_ddp_step_ms, 2),
     }
 
 
@@ -1119,6 +1299,11 @@ def main() -> None:
         log(f"overhead bench failed: {e!r}")
         overhead = {"overhead_error": repr(e)}
     try:
+        overhead["crosscheck"] = bench_overhead_crosscheck()
+    except Exception as e:  # noqa: BLE001
+        log(f"overhead cross-check failed: {e!r}")
+        overhead["crosscheck"] = {"error": repr(e)}
+    try:
         model: "Dict[str, Any]" = bench_model()
     except Exception as e:  # noqa: BLE001
         log(f"model bench failed: {e!r}")
@@ -1135,6 +1320,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"diloco-vs-ddp bench failed: {e!r}")
         diloco["vs_ddp_error"] = repr(e)
+    try:
+        # the measured version of "on real DCN the sign flips": both twins
+        # under the 0.5 GB/s egress shaper — DDP pays the wire every step
+        diloco["vs_ddp_shaped_0p5gbps"] = bench_diloco_vs_ddp(
+            1e9, gbps=0.5
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"shaped diloco-vs-ddp bench failed: {e!r}")
+        diloco["vs_ddp_shaped_0p5gbps"] = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",

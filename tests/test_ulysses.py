@@ -186,3 +186,61 @@ def test_transformer_ulysses_matches_dense():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=5e-5, rtol=1e-4,
     )
+
+
+def test_gqa_kv_replication_when_not_divisible():
+    """hkv % cp != 0 no longer asserts: kv heads replicate minimally
+    (lcm path) and the result still matches the expanded dense reference."""
+    q, k, v = _gqa_qkv(h=8, hkv=2)  # hkv=2 not divisible by cp=4
+    mesh = _cp_mesh(4)
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    rep = q.shape[2] // k.shape[2]
+    ref = dense_attention(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_gqa_kv_replication_lcm_always_divides():
+    """The minimal replication target is lcm(hkv, cp): given h % hkv == 0
+    and h % cp == 0, h is divisible by both and hence by their lcm, so no
+    further fallback exists.  Full-MHA expansion is the lcm itself when
+    lcm == h — drive that end to end (h=12, hkv=4, cp=3 -> lcm 12 = h)."""
+    from torchft_tpu.ops.ulysses import _replicated_kv_heads
+
+    assert _replicated_kv_heads(8, 2, 4) == 4    # partial replication
+    assert _replicated_kv_heads(12, 4, 3) == 12  # lcm == h: full MHA
+    q, k, v = _gqa_qkv(h=12, hkv=4, t=12)
+    mesh = _cp_mesh(3)  # hkv=4 not divisible by 3 -> replication engages
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    rep = q.shape[2] // k.shape[2]
+    ref = dense_attention(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_flash_tile_local_attention():
+    """Lane-aligned global T engages the fused Pallas flash kernel inside
+    the all-to-all layout (interpret mode off-TPU); numerics must match
+    the dense path (the ring composition has the same flash-tile check)."""
+    q, k, v = _qkv(b=1, t=256, h=4, d=8)
+    mesh = _cp_mesh(2)  # t_full = 256 -> flash path
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-6)
+
+
+def test_flash_tile_grad_flows():
+    q, k, v = _qkv(b=1, t=128, h=2, d=8)
+    mesh = _cp_mesh(2)
+
+    def loss(q_):
+        return jnp.sum(ulysses_attention(q_, k, v, mesh, causal=True) ** 2)
+
+    def loss_dense(q_):
+        return jnp.sum(dense_attention(q_, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss)(q)
+    g_ref = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=5e-5)

@@ -367,8 +367,11 @@ def _make_block(
                     use_flash=False,
                 )
             if impl == "ulysses":
+                # same partial-auto shard_map constraint as ring above:
+                # no pallas lowering inside the pipeline's blocks
                 return ulysses_attention_local(
-                    q, k, v, axis_name=cfg.cp_axis, causal=True
+                    q, k, v, axis_name=cfg.cp_axis, causal=True,
+                    use_flash=False,
                 )
             raise ValueError(
                 "manual-cp blocks support ring or ulysses attention only"

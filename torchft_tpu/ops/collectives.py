@@ -220,10 +220,19 @@ def allreduce_quantized(
     reduced_box: "List[Optional[np.ndarray]]" = [None]
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
-        # the alltoall completed: packed send buffers are drained to the
-        # sockets — recycle them (and any pooled padded blocks)
+        if len(received) != world:
+            raise RuntimeError(
+                f"alltoall returned {len(received)} buffers for world "
+                f"{world} (degraded result from an error-swallowing PG?)"
+            )
+        # The alltoall completed: packed send buffers are drained to the
+        # sockets — recycle them (and any pooled padded blocks).  Identity
+        # check against `received`: a degraded PG (ErrorSwallowing
+        # fallback) can resolve the work with the INPUT arrays themselves,
+        # and giving those to the pool while the reduce below still reads
+        # them would be a use-after-free against concurrent takers.
         for r, b in enumerate(send_bufs):
-            if r != my_rank:
+            if r != my_rank and not any(b is rcv for rcv in received):
                 _POOL.give(b)
         my_rows = bounds[my_rank][1] - bounds[my_rank][0]
         t0 = _time.perf_counter()
@@ -245,6 +254,15 @@ def allreduce_quantized(
         return pg.allgather(reduced)
 
     def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
+        if len(gathered) != world:
+            # the old concat-based reassembly raised a shape error on a
+            # short result (error-swallowing PG fallback); the into-place
+            # version must be equally loud — a partial fill would return
+            # uninitialized rows as gradients
+            raise RuntimeError(
+                f"allgather returned {len(gathered)} pieces for world "
+                f"{world} (degraded result from an error-swallowing PG?)"
+            )
         t0 = _time.perf_counter()
         # dequantize each rank's reduced piece straight into its offset of
         # the full matrix — no per-piece alloc, no concat pass

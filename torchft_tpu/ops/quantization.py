@@ -213,8 +213,11 @@ def dequantize(
     ):
         rows2 = _as_rows(payload)
         out = np.empty(rows2.shape, dtype=np.float32)
+        # guard above requires contiguous scales — pass it directly (an
+        # ascontiguousarray temporary would be unreferenced by the time
+        # ctypes extracts the address if the guard were ever relaxed)
         _native_lib().tft_dequant_fma(
-            _i8_ptr(rows2), _f32_ptr(np.ascontiguousarray(scales)),
+            _i8_ptr(rows2), _f32_ptr(scales),
             rows2.shape[0], rows2.shape[1], _f32_ptr(out), 1,
         )
         return out.reshape(shape)

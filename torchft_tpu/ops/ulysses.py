@@ -20,12 +20,27 @@ mesh/axis contract, so models can switch per config
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from torchft_tpu.ops.ring_attention import dense_attention, sharded_attention
+
+
+def _replicated_kv_heads(h: int, hkv: int, size: int) -> int:
+    """Smallest kv head count ``hkv' >= hkv`` that is a multiple of both
+    ``hkv`` and ``size`` while still dividing ``h`` (so the contiguous
+    ``jnp.repeat`` GQA mapping is preserved block-for-block across the
+    head-split all-to-all): ``lcm(hkv, size)``.  Given the caller's
+    preconditions — ``h % hkv == 0`` and ``h % size == 0`` — ``h`` is
+    divisible by both, hence by their lcm, so the lcm always works (a
+    number divisible by a and b is divisible by lcm(a, b))."""
+    cand = math.lcm(hkv, size)
+    assert h % cand == 0, (h, hkv, size)  # guaranteed by preconditions
+    return cand
 
 
 def ulysses_attention_local(
@@ -34,24 +49,50 @@ def ulysses_attention_local(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    use_flash: "Optional[bool]" = None,
 ) -> jax.Array:
     """Per-shard Ulysses body. Must run inside shard_map over ``axis_name``;
     q/k/v are local sequence chunks ``[B, T_local, H, D]`` (rotary-embedded
     with *global* positions by the caller, same contract as ring attention).
 
-    GQA: K/V may carry fewer heads; they cross the all-to-all *unexpanded*
-    (H/H_kv fewer bytes) and are broadcast up inside the local attention.
+    GQA: K/V may carry fewer heads; when ``H_kv`` divides evenly across the
+    axis they cross the all-to-all *unexpanded* (H/H_kv fewer bytes) and
+    are broadcast up inside the local attention.  When ``H_kv`` is NOT
+    divisible by the axis size, K/V heads are minimally REPLICATED first
+    (to ``lcm(H_kv, size)`` heads, which always divides H given the
+    query-head constraints) —
+    more all-to-all bytes on the replicated heads, but every GQA/axis
+    combination runs instead of asserting.  Query heads must divide the
+    axis size (queries cannot be replicated without duplicating output
+    rows).
 
-    Requires both head counts divisible by ``axis_size``.
+    Local attention on the gathered full sequence uses the fused Pallas
+    flash kernel when the global sequence is lane-aligned
+    (``T_local*size % 128 == 0``) — O(T) memory instead of the dense
+    [T, T] score matrix, same flash x sequence-parallel composition the
+    ring path has (``ring_flash_local``).  ``use_flash=False`` opts out
+    (required inside partial-auto shard_map contexts, e.g. the pipeline,
+    where pallas_call's missing vma annotation is rejected).
+
     Returns ``[B, T_local, H, D]``.
     """
     size = jax.lax.axis_size(axis_name)
     h, hkv = q.shape[2], k.shape[2]
-    if h % size != 0 or hkv % size != 0:
+    if h % size != 0:
         raise ValueError(
-            f"ulysses attention needs query heads ({h}) and kv heads "
-            f"({hkv}) divisible by the sequence-parallel axis size ({size})"
+            f"ulysses attention needs query heads ({h}) divisible by the "
+            f"sequence-parallel axis size ({size})"
         )
+    if h % hkv != 0:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    if hkv % size != 0:
+        # replication path: contiguous repeat preserves the GQA block
+        # mapping across the head-split all-to-all (see _replicated_kv_heads)
+        target = _replicated_kv_heads(h, hkv, size)
+        rep = target // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        hkv = target
 
     def seq_gather(x: jax.Array) -> jax.Array:
         # [B, T_local, H, D] -> [B, T_local*size, H/size, D]
@@ -68,8 +109,16 @@ def ulysses_attention_local(
         )
 
     qf, kf, vf = seq_gather(q), seq_gather(k), seq_gather(v)
-    # dense_attention broadcasts GQA kv heads up locally (post-transfer)
-    out = dense_attention(qf, kf, vf, causal=causal)
+    t_full = qf.shape[1]
+    if use_flash is None:
+        use_flash = t_full % 128 == 0
+    if use_flash:
+        from torchft_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qf, kf, vf, causal=causal)
+    else:
+        # dense_attention broadcasts GQA kv heads up locally (post-transfer)
+        out = dense_attention(qf, kf, vf, causal=causal)
     return seq_scatter(out)
 
 
@@ -86,9 +135,13 @@ def ulysses_attention(
     """shard_map'd Ulysses attention over ``mesh`` axis ``axis_name``
     (same contract as :func:`ring_attention`; see
     :func:`torchft_tpu.ops.ring_attention.sharded_attention`)."""
+    # flash engages when the GLOBAL sequence is lane-aligned (the local
+    # body attends over the gathered full sequence, unlike ring's
+    # T_local-tile check)
     return sharded_attention(
         ulysses_attention_local, q, k, v, mesh, axis_name, causal,
         batch_axes, head_axis,
+        may_use_pallas=q.shape[1] % 128 == 0,
     )
 
 

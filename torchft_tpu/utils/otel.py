@@ -239,7 +239,9 @@ def maybe_install_from_env() -> "Optional[OTLPHTTPExporter]":
     Endpoint: ``OTEL_EXPORTER_OTLP_LOGS_ENDPOINT``, else
     ``OTEL_EXPORTER_OTLP_ENDPOINT``, else the OTLP default
     ``http://localhost:4318``."""
-    if os.environ.get("TORCHFT_USE_OTEL", "false").lower() in ("false", "0", ""):
+    # explicit truthy whitelist: "off"/"no"/typos must NOT install an
+    # exporter that spams connection-refused warnings all run
+    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
         return None
     endpoint = (
         os.environ.get("OTEL_EXPORTER_OTLP_LOGS_ENDPOINT")
