@@ -542,6 +542,38 @@ class TestFastRestartSupersession:
             ), results
             assert time.monotonic() - start < 15.0
 
+    def test_timed_out_requester_leaves_no_ghost_participant(self):
+        """A quorum handler that exits on timeout must take its
+        registration with it: a later peer's request must NOT pair with
+        the dead requester's leftover entry (that 'ghost' satisfied the
+        formation barrier with nobody behind it — the repeating 5 s miss
+        the storm soak exposed).  After lone replica 'a' times out, a
+        lone request from 'b' must also time out (no quorum can form
+        with just one live requester at min_replicas=2), not receive a
+        quorum containing the departed 'a'."""
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=60000
+        ) as server:
+            res_a = _concurrent_quorums(
+                server.address(), [{"replica_id": "a"}], timeout=1.0
+            )
+            assert isinstance(res_a["a"], Exception), res_a
+            # b arrives AFTER a's deadline: a's registration must be gone
+            time.sleep(0.2)
+            res_b = _concurrent_quorums(
+                server.address(), [{"replica_id": "b"}], timeout=1.5
+            )
+            assert isinstance(res_b["b"], Exception), (
+                "ghost participant: a timed-out requester's registration "
+                f"formed a quorum for a lone later peer: {res_b}"
+            )
+            # both live -> quorum forms normally
+            res = _concurrent_quorums(
+                server.address(),
+                [{"replica_id": "a"}, {"replica_id": "b"}],
+            )
+            assert isinstance(res["a"], Quorum) and isinstance(res["b"], Quorum)
+
     def test_evicted_incarnation_cannot_evict_successor(self):
         # Supersession is one-directional: once evicted, the old incarnation
         # can never re-register — a zombie's quorum retry is rejected with
