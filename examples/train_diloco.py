@@ -45,6 +45,14 @@ def parse_args(argv=None):
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--local-replicas", type=int, default=0)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--wire-gbps", type=float, default=None,
+                   help="shape the DCN egress to this rate (decimal GB/s, "
+                        "token bucket) — demo/validate DiLoCo under a real "
+                        "bandwidth constraint; also settable via "
+                        "TORCHFT_WIRE_GBPS")
+    p.add_argument("--quantize", action="store_true",
+                   help="int8-quantize the outer pseudogradient sync "
+                        "(TORCHFT_QUANT_WIRE selects int8/fp8_e4m3)")
     return p.parse_args(argv)
 
 
@@ -61,7 +69,10 @@ def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
     state = {"params": params}
 
     manager = ft.Manager(
-        pg=ft.ProcessGroupTCP(timeout=30.0),
+        # --wire-gbps: token-bucket egress shaping (None = unshaped or the
+        # TORCHFT_WIRE_GBPS env default) — lets this demo show DiLoCo's
+        # sync-every-N advantage under a real DCN bandwidth constraint
+        pg=ft.ProcessGroupTCP(timeout=30.0, bandwidth_gbps=args.wire_gbps),
         min_replica_size=args.min_replicas,
         replica_id=replica_id,
         lighthouse_addr=lighthouse_addr,
@@ -106,6 +117,7 @@ def train(replica_id: str, lighthouse_addr: str, args, log=print) -> dict:
             outer_opt,
             sync_every=args.sync_every,
             fragment_sync_delay=args.fragment_sync_delay,
+            should_quantize=args.quantize,
         ) as diloco:
             for i in range(args.steps):
                 x = jnp.asarray(
