@@ -250,6 +250,14 @@ def allreduce_quantized(
                 wire_dtype=wire_dtype, pool=_POOL,
             )
         codec_s[0] += _time.perf_counter() - t0
+        # received wire buffers are fully consumed by the reduce (unpack
+        # returns views, dequant-fma reads them) — recycle.  A buffer that
+        # IS one of our send_bufs (degraded error-swallowing result) was
+        # skipped by the send-side give above, so this gives it exactly
+        # once; either way it is dead after the reduce.
+        for r, b in enumerate(received):
+            if r != my_rank:
+                _POOL.give(b)
         reduced_box[0] = reduced
         return pg.allgather(reduced)
 
@@ -271,8 +279,18 @@ def allreduce_quantized(
             start, end = bounds[r]
             scales, payload = q.unpack(buf, end - start, cols, wire_dtype)
             q.dequantize_into(scales, payload, full_mat[start:end])
-        _POOL.give(reduced_box[0])  # own reduced piece: wire + decode done
+        reduced = reduced_box[0]
+        _POOL.give(reduced)  # own reduced piece: wire + decode done
         reduced_box[0] = None
+        # gathered pieces are decoded into full_mat above — recycle them.
+        # Skip anything identical to `reduced` (already given): the TCP
+        # backend's allgather defensively copies the own piece, but the
+        # invariant must hold for ANY ProcessGroup, so enforce it locally.
+        given = set()
+        for b in gathered:
+            if b is not reduced and id(b) not in given and b.nbytes:
+                given.add(id(b))
+                _POOL.give(b)
         full = full_mat.ravel()[:total]
         out = []
         offset = 0
