@@ -33,14 +33,23 @@ class TestFlashForward:
         )
 
     def test_multiple_block_sizes(self):
-        # 128 / 256 / 512 block selection paths
-        for t in (128, 384, 512):
+        # 128 / 256 / 512 / 1024 block selection paths (1024 engages at
+        # head_dim <= 256 when it divides T — the flagship tile)
+        for t in (128, 384, 512, 1024):
             q, k, v = _qkv(t=t, seed=t)
             ref = dense_attention(q, k, v)
             out = flash_attention(q, k, v)
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
             )
+
+    def test_block_ladder_head_dim_gate(self):
+        from torchft_tpu.ops.flash_attention import _block_size
+
+        assert _block_size(1024, 256) == 1024
+        assert _block_size(1024, 512) == 512  # wide heads keep 512 tiles
+        assert _block_size(512, 256) == 512
+        assert _block_size(384, 64) == 128
 
     def test_fully_masked_rows_yield_zero_not_mean_of_v(self):
         # A chunk whose queries all PRECEDE every key (causal ring chunk

