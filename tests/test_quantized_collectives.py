@@ -1,6 +1,8 @@
 """Quantized collective correctness vs eager (reference:
 torchft/quantization_test.py + collectives_test.py)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -413,5 +415,44 @@ class TestFp8Wire:
         results = run_parallel(world, run)
         assert all(isinstance(r, Exception) for r in results), results
         assert any("wire format mismatch" in str(r) for r in results), results
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_contribution_snapshotted_at_call_time(self, store):  # noqa: F811
+        """Mutating the input array AFTER submitting the collective must
+        not change any rank's contribution: peer slices quantize
+        synchronously and the own slice is snapshotted at call time (it
+        enters the reduce as raw f32 later, asynchronously)."""
+        world = 2
+        pgs = make_group(store, world, prefix="qsnap")
+        data = [np.full(4096, 1.0 + r, dtype=np.float32) for r in range(world)]
+        expected = np.full(4096, 3.0, dtype=np.float32)
+        barrier = threading.Barrier(world)
+
+        def run(rank, _):
+            w = allreduce_quantized([data[rank]], REDUCE_SUM, pgs[rank])
+            data[rank][:] = -999.0  # caller reuses its buffer immediately
+            barrier.wait(timeout=10)
+            return w.wait(timeout=30)
+
+        for result in run_parallel(world, run):
+            rel = np.abs(result[0] - expected).max() / 3.0
+            assert rel < 0.05, f"mutated input leaked into the reduction: {rel}"
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter_contribution_snapshotted(self, store):  # noqa: F811
+        world = 2
+        pgs = make_group(store, world, prefix="qsnaprs")
+        data = [np.full((8, 512), 1.0 + r, dtype=np.float32) for r in range(world)]
+
+        def run(rank, _):
+            w = reduce_scatter_quantized(data[rank], REDUCE_SUM, pgs[rank])
+            data[rank][:] = -999.0
+            return w.wait(timeout=30)
+
+        for rank, got in enumerate(run_parallel(world, run)):
+            rel = np.abs(got - 3.0).max() / 3.0
+            assert rel < 0.05, f"mutated input leaked into the reduction: {rel}"
         for pg in pgs:
             pg.shutdown()

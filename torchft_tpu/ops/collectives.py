@@ -41,6 +41,36 @@ from torchft_tpu.parallel.work import Work, completed_work
 from torchft_tpu.utils.bufpool import POOL as _POOL
 
 
+def _check_world(received: "List[np.ndarray]", world: int, op: str) -> None:
+    if len(received) != world:
+        raise RuntimeError(
+            f"{op} returned {len(received)} buffers for world {world} "
+            "(degraded result from an error-swallowing PG?)"
+        )
+
+
+def _recycle_wire_bufs(
+    send_bufs: "List[np.ndarray]", received: "List[np.ndarray]", my_rank: int
+) -> None:
+    """Return dead wire buffers to the pool after a reduce consumed them.
+
+    Send side: a packed buffer is drained to the sockets once the
+    alltoall resolves — but a degraded (error-swallowing) PG can resolve
+    with the INPUT arrays themselves, so anything aliased into
+    ``received`` is skipped here and given exactly once below.  Receive
+    side: id-deduped (any PG may alias slots); 0-byte own slots no-op in
+    ``give``.
+    """
+    for r, b in enumerate(send_bufs):
+        if r != my_rank and not any(b is rcv for rcv in received):
+            _POOL.give(b)
+    seen_ids = set()
+    for b in received:
+        if id(b) not in seen_ids:
+            seen_ids.add(id(b))
+            _POOL.give(b)
+
+
 def _slice_rows(rows: int, world: int) -> "List[tuple[int, int]]":
     """Contiguous row ranges per rank (last rank takes the remainder)."""
     base = rows // world
@@ -204,7 +234,18 @@ def allreduce_quantized(
         send_bufs = []
         for r, (start, end) in enumerate(bounds):
             if r == my_rank:
-                raw_self = _slice_block(start, end)
+                block = _slice_block(start, end)
+                if pooled_blocks and pooled_blocks[-1] is block:
+                    # padded block: already a private snapshot
+                    raw_self = block
+                else:
+                    # view of the caller's array: SNAPSHOT it now (peer
+                    # slices are quantized synchronously, so the whole
+                    # contribution must be captured at call time — the
+                    # caller may mutate its array before the reduce runs)
+                    raw_self = _POOL.take(block.shape, np.float32)
+                    np.copyto(raw_self, block)
+                    pooled_blocks.append(raw_self)
                 send_bufs.append(np.empty(0, dtype=np.uint8))
             else:
                 block = _slice_block(start, end)
@@ -220,20 +261,7 @@ def allreduce_quantized(
     reduced_box: "List[Optional[np.ndarray]]" = [None]
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
-        if len(received) != world:
-            raise RuntimeError(
-                f"alltoall returned {len(received)} buffers for world "
-                f"{world} (degraded result from an error-swallowing PG?)"
-            )
-        # The alltoall completed: packed send buffers are drained to the
-        # sockets — recycle them (and any pooled padded blocks).  Identity
-        # check against `received`: a degraded PG (ErrorSwallowing
-        # fallback) can resolve the work with the INPUT arrays themselves,
-        # and giving those to the pool while the reduce below still reads
-        # them would be a use-after-free against concurrent takers.
-        for r, b in enumerate(send_bufs):
-            if r != my_rank and not any(b is rcv for rcv in received):
-                _POOL.give(b)
+        _check_world(received, world, "alltoall")
         my_rows = bounds[my_rank][1] - bounds[my_rank][0]
         t0 = _time.perf_counter()
         if raw_self is not None:
@@ -250,32 +278,15 @@ def allreduce_quantized(
                 wire_dtype=wire_dtype, pool=_POOL,
             )
         codec_s[0] += _time.perf_counter() - t0
-        # received wire buffers are fully consumed by the reduce (unpack
-        # returns views, dequant-fma reads them) — recycle.  A buffer that
-        # IS one of our send_bufs (degraded error-swallowing result) was
-        # skipped by the send-side give above, so this gives it exactly
-        # once; either way it is dead after the reduce.  id()-dedup for
-        # the same reason as the allgather loop (any PG may alias slots);
-        # the own slot is included — 0 bytes on the host path (give
-        # no-ops) but a real consumed copy on the device-quantize path.
-        seen_ids = set()
-        for b in received:
-            if id(b) not in seen_ids:
-                seen_ids.add(id(b))
-                _POOL.give(b)
+        # send buffers drained + received buffers consumed by the reduce
+        _recycle_wire_bufs(send_bufs, received, my_rank)
         reduced_box[0] = reduced
         return pg.allgather(reduced)
 
     def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
-        if len(gathered) != world:
-            # the old concat-based reassembly raised a shape error on a
-            # short result (error-swallowing PG fallback); the into-place
-            # version must be equally loud — a partial fill would return
-            # uninitialized rows as gradients
-            raise RuntimeError(
-                f"allgather returned {len(gathered)} pieces for world "
-                f"{world} (degraded result from an error-swallowing PG?)"
-            )
+        # loud on short results: a partial fill of the into-place
+        # reassembly below would return uninitialized rows as gradients
+        _check_world(gathered, world, "allgather")
         t0 = _time.perf_counter()
         # dequantize each rank's reduced piece straight into its offset of
         # the full matrix — no per-piece alloc, no concat pass
@@ -382,23 +393,44 @@ def reduce_scatter_quantized(
 
     rows_total = np_array.shape[0]
     cols = int(np.prod(np_array.shape[1:], dtype=np.int64)) or 1
-    mat = np_array.reshape(rows_total, cols).astype(np.float32)
+    mat = np.ascontiguousarray(
+        np_array.reshape(rows_total, cols), dtype=np.float32
+    )
     bounds = _slice_rows(rows_total, world)
-    send_bufs = []
-    for start, end in bounds:
-        scales, payload = q.quantize(mat[start:end], wire_dtype)
-        send_bufs.append(q.pack(scales, payload, wire_dtype))
+    my_rank = pg.rank()
+    # Same fast paths as the allreduce: the own slot self-delivers (never
+    # hits the wire), so it skips the codec and enters the reduce as raw
+    # f32; peer slices quantize straight into pooled wire buffers.  The
+    # own slice is SNAPSHOTTED at call time (peer slices are quantized
+    # synchronously — the whole contribution must be captured before the
+    # caller can mutate its array).
+    own = mat[bounds[my_rank][0] : bounds[my_rank][1]]
+    raw_self = _POOL.take(own.shape, np.float32)
+    np.copyto(raw_self, own)
+    send_bufs = [
+        np.empty(0, dtype=np.uint8)
+        if r == my_rank
+        else q.quantize_packed(mat[start:end], wire_dtype, pool=_POOL)
+        for r, (start, end) in enumerate(bounds)
+    ]
 
-    my_rows = bounds[pg.rank()][1] - bounds[pg.rank()][0]
+    my_rows = bounds[my_rank][1] - bounds[my_rank][0]
     out_shape = (my_rows,) + np_array.shape[1:]
 
     def _finish(received: "List[np.ndarray]") -> np.ndarray:
+        _check_world(received, world, "alltoall")
+        bufs = [b for r, b in enumerate(received) if r != my_rank]
         # raw f32 result: the reduced slice stays local, so requantizing
-        # (needed in allreduce for the allgather hop) would only add error
+        # (needed in allreduce for the allgather hop) would only add error.
+        # pool only feeds the accumulator's pages here (requantize=False
+        # hands acc to the caller, so the pool never gets it back — a
+        # warm-page win on take, replenished by the wire-buffer gives)
         acc = q.reduce_quantized(
-            received, my_rows, cols, average_by=divisor, requantize=False,
-            wire_dtype=wire_dtype,
+            bufs, my_rows, cols, average_by=divisor, requantize=False,
+            wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
         )
+        _POOL.give(raw_self)  # call-time snapshot, consumed by the reduce
+        _recycle_wire_bufs(send_bufs, received, my_rank)
         return acc.reshape(out_shape)
 
     return pg.alltoall(send_bufs).then(_finish)

@@ -47,7 +47,7 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block_size(t: int, d: int = 256) -> int:
+def _block_size(t: int, d: int) -> int:
     """Largest tile that divides ``t`` — bigger tiles amortize the
     per-block softmax bookkeeping.  1024 engages only at head_dim <= 256
     (measured +3% whole-step at the d256 flagship; beyond d256 the
