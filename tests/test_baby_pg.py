@@ -316,3 +316,46 @@ class TestShmDataPath:
         finally:
             for pg in pgs:
                 pg.shutdown()
+
+
+class TestBabyQuantizedCollective:
+    def test_quantized_allreduce_over_baby(self, store):
+        """The int8 quantized allreduce composes with the subprocess-
+        isolated backend: packed wire buffers cross the parent<->worker
+        boundary (pipe or shm), and the pool-recycling in the collective
+        must only ever recycle parent-side allocations it owns."""
+        from torchft_tpu.ops.collectives import allreduce_quantized
+        from torchft_tpu.parallel.process_group import REDUCE_SUM
+
+        pgs = _configure_pair(store, "qbaby")
+        try:
+            data = [
+                np.full(60_000, 1.0 + r, dtype=np.float32) for r in range(2)
+            ]
+            expected = np.full(60_000, 3.0, dtype=np.float32)
+
+            def run(rank):
+                return allreduce_quantized(
+                    [data[rank]], REDUCE_SUM, pgs[rank]
+                ).wait(timeout=60)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                results = [
+                    f.result(timeout=90)
+                    for f in [ex.submit(run, r) for r in range(2)]
+                ]
+            for (got,) in results:
+                rel = np.abs(got - expected).max() / 3.0
+                assert rel < 0.05, rel
+            np.testing.assert_array_equal(results[0][0], results[1][0])
+            # run a second round so any wrongly-recycled buffer from round
+            # one would corrupt round two
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                results2 = [
+                    f.result(timeout=90)
+                    for f in [ex.submit(run, r) for r in range(2)]
+                ]
+            np.testing.assert_array_equal(results2[0][0], results2[1][0])
+        finally:
+            for pg in pgs:
+                pg.shutdown()

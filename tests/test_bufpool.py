@@ -1,0 +1,91 @@
+"""BufferPool: the host-collective staging allocator (utils/bufpool.py).
+
+The pool's contract is safety-critical for the quantized collectives:
+give() must only ever accept memory the caller exclusively owns, because
+a pooled buffer is handed out again to arbitrary concurrent takers."""
+
+import threading
+
+import numpy as np
+
+from torchft_tpu.utils.bufpool import BufferPool
+
+
+class TestBufferPool:
+    def test_take_give_reuse(self):
+        pool = BufferPool(max_bytes=1 << 20)
+        a = pool.take((16, 32), np.float32)
+        assert a.shape == (16, 32) and a.dtype == np.float32
+        addr = a.ctypes.data
+        pool.give(a)
+        b = pool.take((16, 32), np.float32)
+        assert b.ctypes.data == addr  # same allocation came back
+        c = pool.take((16, 32), np.float32)
+        assert c.ctypes.data != addr  # pool was empty again -> fresh
+
+    def test_reshape_views_normalize_to_base(self):
+        pool = BufferPool(max_bytes=1 << 20)
+        a = pool.take(512, np.uint8)
+        pool.give(a)
+        # take() reshapes the pooled base; giving the view back must
+        # re-pool the WHOLE allocation
+        v = pool.take((2, 256), np.uint8)
+        assert v.base is not None
+        pool.give(v)
+        w = pool.take(512, np.uint8)
+        assert w.ctypes.data == a.ctypes.data
+
+    def test_rejects_foreign_memory_views(self):
+        # arrays over memory numpy does not own (frombuffer, shm-style)
+        # must never enter the pool: pooling them would pin their owner's
+        # finalizer and alias foreign memory to future takers
+        pool = BufferPool(max_bytes=1 << 20)
+        raw = bytearray(1024)
+        foreign = np.frombuffer(raw, dtype=np.uint8)
+        pool.give(foreign)
+        assert pool.take(1024, np.uint8).ctypes.data != foreign.ctypes.data
+
+    def test_rejects_slices_and_noncontiguous(self):
+        pool = BufferPool(max_bytes=1 << 20)
+        owner = np.empty(1024, np.uint8)
+        pool.give(owner[100:200])  # partial view: base nbytes differ
+        assert pool._held == 0
+        mat = np.empty((8, 8), np.float32)
+        pool.give(mat[:, ::2])  # non-contiguous
+        assert pool._held == 0
+
+    def test_cap_drops_excess(self):
+        pool = BufferPool(max_bytes=1000)
+        a = np.empty(600, np.uint8)
+        b = np.empty(600, np.uint8)
+        pool.give(a)
+        pool.give(b)  # would exceed the cap -> dropped
+        assert pool._held == 600
+
+    def test_zero_byte_noop(self):
+        pool = BufferPool(max_bytes=1 << 20)
+        pool.give(np.empty(0, np.uint8))
+        assert pool._held == 0
+
+    def test_concurrent_take_give(self):
+        pool = BufferPool(max_bytes=8 << 20)
+        errs = []
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(200):
+                a = pool.take(int(rng.integers(1, 4)) * 1024, np.uint8)
+                a[:] = seed  # exclusive ownership: nobody else writes it
+                if not np.all(a == seed):
+                    errs.append("shared buffer observed")
+                    return
+                pool.give(a)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs

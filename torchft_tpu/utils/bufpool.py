@@ -61,6 +61,14 @@ class BufferPool:
         base = arr
         while isinstance(base.base, np.ndarray) and base.base.nbytes == arr.nbytes:
             base = base.base
+        # Only pool arrays that OWN their memory (malloc'd by numpy).  A
+        # view over foreign memory — e.g. the Baby PG's zero-copy
+        # /dev/shm-backed receive buffers, whose close/unlink finalizer
+        # would be pinned for as long as the pool holds the view — must
+        # fall to the GC instead.  This is enforced here, at the seam,
+        # so no recycle call site has to know which PG produced a buffer.
+        if base.base is not None:
+            return
         key = (base.size, base.dtype.str)
         with self._lock:
             if self._held + base.nbytes > self.max_bytes:
