@@ -889,11 +889,19 @@ def _bench_diloco_vs_ddp_body(
 
 
 def _diloco_sync_leg(
-    leg: str, quantize: bool, gbps: "float | None"
+    leg: str, quantize: bool, gbps: "float | None", repeats: int = 2
 ) -> "Dict[str, Any]":
-    """One full flagship-scale outer sync over the TCP ring at a shaped
-    egress bandwidth (None = unshaped loopback).  Returns wall, wire and
-    codec seconds (codec only on the quantized leg)."""
+    """Flagship-scale outer sync over the TCP ring at a shaped egress
+    bandwidth (None = unshaped loopback), best of ``repeats`` runs (the
+    shared host shows 2-3x wall spikes from neighbor interference — a
+    single sample can turn a 5 s sync into a 15 s headline).  Returns
+    wall, wire and codec seconds (codec only on the quantized leg)."""
+    if repeats > 1:
+        runs = [
+            _diloco_sync_leg(f"{leg}_r{i}", quantize, gbps, repeats=1)
+            for i in range(repeats)
+        ]
+        return min(runs, key=lambda r: r["sync_s"])
     from torchft_tpu.ops.collectives import allreduce_quantized
 
     world = 2
