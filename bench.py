@@ -1337,6 +1337,23 @@ def bench_model() -> "Dict[str, Any]":
 
 def main() -> None:
     recovery = bench_recovery()
+    # Insurance against an external wall-cap killing the process mid-run:
+    # emit a parseable JSON line with the PRIMARY metric as soon as it
+    # exists.  A completed run prints the full line at the end (later on
+    # stdout, so a tail-parser picks it up); a killed run still leaves
+    # this one.
+    print(
+        json.dumps(
+            {
+                "metric": "recovery_to_healthy_step_latency",
+                "unit": "s",
+                "vs_baseline": round(recovery["value"] / 1.0, 3),
+                **recovery,
+                "preliminary": True,
+            }
+        ),
+        flush=True,
+    )
     # The secondary benches must never cost the driver the primary metric:
     # degrade to an "error" field instead of dying without the JSON line.
     try:
