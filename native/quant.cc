@@ -50,10 +50,18 @@ void tft_quant_int8(const float* in, int64_t rows, int64_t cols,
     const float* row = in + r * cols;
     int8_t* out = payload + r * cols;
     float absmax = 0.0f;
+    int has_nan = 0;
     for (int64_t c = 0; c < cols; ++c) {
       float a = std::fabs(row[c]);
       absmax = a > absmax ? a : absmax;
+      has_nan |= (a != a);
     }
+    // NaN propagation (matches numpy's abs().max()): a NaN element sends
+    // the row down the degenerate branch (scale 1.0) exactly like the
+    // numpy codec — instead of silently encoding the NaN row against a
+    // finite absmax.  (Payload bytes of such garbage rows still differ
+    // from numpy's astype-of-NaN; row-LEVEL semantics are what agree.)
+    if (has_nan) absmax = std::nanf("");
     if (degenerate(absmax, qmax)) {
       scales[r] = 1.0f;
       // numpy path: payload = rint(x * 1.0) -> 0 for |x| < ~1e-36
@@ -93,6 +101,94 @@ void tft_dequant_fma(const int8_t* payload, const float* scales,
     }
   }
 }
+
+// ---------------------------------------------------------------------------
+// fp8_e4m3fn wire format (the reference's fp8e4nv analog)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// f32 -> float8_e4m3fn with round-to-nearest-even, for FINITE inputs
+// bounded to [-448 - 1ulp, 448 + 1ulp] (guaranteed by absmax scaling).
+// Bit-exact against ml_dtypes' astype on this domain (asserted in
+// tests/test_pallas_quant.py::TestNativeFp8Codec).
+inline uint8_t f32_to_e4m3(float f) {
+  uint32_t b;
+  std::memcpy(&b, &f, 4);
+  const uint8_t sign = static_cast<uint8_t>((b >> 24) & 0x80u);
+  const uint32_t abs = b & 0x7fffffffu;
+  if (abs < 0x3c800000u) {
+    // |x| < 2^-6 (min normal): subnormal grid k * 2^-9, k in [0, 8] —
+    // k == 8 lands exactly on the min normal's code (the encoding is
+    // continuous), so one nearbyint covers the sub/normal boundary.
+    float a;
+    std::memcpy(&a, &abs, 4);
+    return sign | static_cast<uint8_t>(nearbyintf(a * 512.0f));
+  }
+  // normal: RNE on the top 3 mantissa bits, re-bias 127 -> 7.  Mantissa
+  // carry flows into the exponent field naturally (continuous encoding).
+  const uint32_t rounded = abs + 0x7ffffu + ((abs >> 20) & 1u);
+  uint32_t e4 = (rounded >> 20) - ((127u - 7u) << 3);
+  if (e4 > 0x7eu) e4 = 0x7eu;  // 1-ulp excursion above 448 -> max finite
+  return sign | static_cast<uint8_t>(e4);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Per-row absmax fp8_e4m3fn quantize (qmax 448): in[rows*cols] f32 ->
+// scales[rows] f32 + payload[rows*cols] fp8 bytes.  Same degenerate-row
+// rule as int8 (scale 1.0, zero payload).
+void tft_quant_fp8(const float* in, int64_t rows, int64_t cols,
+                   float* scales, uint8_t* payload) {
+  const float qmax = 448.0f;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* row = in + r * cols;
+    uint8_t* out = payload + r * cols;
+    float absmax = 0.0f;
+    int has_nan = 0;
+    for (int64_t c = 0; c < cols; ++c) {
+      float a = std::fabs(row[c]);
+      absmax = a > absmax ? a : absmax;
+      has_nan |= (a != a);
+    }
+    // NaN-propagating max — see tft_quant_int8
+    if (has_nan) absmax = std::nanf("");
+    if (degenerate(absmax, qmax)) {
+      scales[r] = 1.0f;
+      // numpy path: (x * 1.0).astype(fp8) -> +/-0 for |x| < ~1e-36;
+      // e4m3 of such tiny values is 0x00 or 0x80 (signed zero) — match
+      // the element-wise conversion rather than memset so -0.0 inputs
+      // keep their sign bit exactly like ml_dtypes does.
+      for (int64_t c = 0; c < cols; ++c) out[c] = f32_to_e4m3(row[c]);
+      continue;
+    }
+    scales[r] = absmax / qmax;
+    const float inv = qmax / absmax;
+    for (int64_t c = 0; c < cols; ++c) out[c] = f32_to_e4m3(row[c] * inv);
+  }
+}
+
+// Dequantize-accumulate for fp8 payloads via a caller-supplied 256-entry
+// f32 LUT (built in Python FROM ml_dtypes, so decode is bit-exact by
+// construction).  acc op= lut[payload] * scale.
+void tft_dequant_fp8_fma(const uint8_t* payload, const float* scales,
+                         const float* lut256, int64_t rows, int64_t cols,
+                         float* acc, int overwrite) {
+  for (int64_t r = 0; r < rows; ++r) {
+    const uint8_t* row = payload + r * cols;
+    float* dst = acc + r * cols;
+    const float s = scales[r];
+    if (overwrite) {
+      for (int64_t c = 0; c < cols; ++c) dst[c] = lut256[row[c]] * s;
+    } else {
+      for (int64_t c = 0; c < cols; ++c) dst[c] += lut256[row[c]] * s;
+    }
+  }
+}
+
+}  // extern "C"
 
 // Uniform in-place divide (the fused AVG step after accumulation).
 // A true divide, not multiply-by-reciprocal: bit-identical to the numpy

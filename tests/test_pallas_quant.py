@@ -185,3 +185,90 @@ class TestNativeHostCodec:
         self._toggle(monkeypatch, native=True)
         out_c = host_q.dequantize(s, p, a.shape, np.float32)
         np.testing.assert_array_equal(out_np, out_c)
+
+
+class TestNativeFp8Codec:
+    """The C fp8_e4m3fn codec must match the numpy/ml_dtypes reference
+    bit-for-bit on finite inputs (the codec's contract); decode goes
+    through a LUT built FROM ml_dtypes so it is exact by construction."""
+
+    def _toggle(self, monkeypatch, native: bool):
+        if native:
+            monkeypatch.delenv("TORCHFT_NO_NATIVE_QUANT", raising=False)
+        else:
+            monkeypatch.setenv("TORCHFT_NO_NATIVE_QUANT", "1")
+
+    @pytest.mark.parametrize("shape", [(1, 1), (3, 7), (64, 2048), (5, 1)])
+    def test_quantize_bitwise(self, shape, monkeypatch):
+        a = _rand(shape, seed=13)
+        self._toggle(monkeypatch, native=False)
+        s_np, p_np = host_q.quantize(a, "fp8_e4m3")
+        self._toggle(monkeypatch, native=True)
+        s_c, p_c = host_q.quantize(a, "fp8_e4m3")
+        np.testing.assert_array_equal(s_np, s_c)
+        np.testing.assert_array_equal(
+            p_np.view(np.uint8), p_c.view(np.uint8)
+        )
+
+    def test_quantize_edge_values_bitwise(self, monkeypatch):
+        # rows hitting subnormal grid points, RNE midpoints, +-max, and
+        # the degenerate-row rule
+        import ml_dtypes
+
+        vals = (
+            np.arange(256, dtype=np.uint8)
+            .view(ml_dtypes.float8_e4m3fn)
+            .astype(np.float32)
+        )
+        vals = vals[np.isfinite(vals)]
+        mids = ((np.sort(vals)[:-1] + np.sort(vals)[1:]) / 2.0).astype(
+            np.float32
+        )
+        row = np.concatenate([vals, mids, [448.0, -448.0, 0.0, -0.0]])
+        a = np.stack([row, row * 1e-3, np.full_like(row, 1e-38)])
+        self._toggle(monkeypatch, native=False)
+        s_np, p_np = host_q.quantize(a, "fp8_e4m3")
+        self._toggle(monkeypatch, native=True)
+        s_c, p_c = host_q.quantize(a, "fp8_e4m3")
+        np.testing.assert_array_equal(s_np, s_c)
+        np.testing.assert_array_equal(
+            p_np.view(np.uint8), p_c.view(np.uint8)
+        )
+
+    @pytest.mark.parametrize("average_by", [0, 3])
+    def test_reduce_bitwise(self, average_by, monkeypatch):
+        rows, cols = 6, 97
+        shards = [_rand((rows, cols), seed=50 + i) for i in range(3)]
+        bufs = [
+            host_q.pack(*host_q.quantize(s, "fp8_e4m3"), "fp8_e4m3")
+            for s in shards
+        ]
+        raw = _rand((rows, cols), seed=60)
+        self._toggle(monkeypatch, native=False)
+        out_np = host_q.reduce_quantized(
+            bufs, rows, cols, average_by=average_by, wire_dtype="fp8_e4m3",
+            raw=raw,
+        )
+        self._toggle(monkeypatch, native=True)
+        out_c = host_q.reduce_quantized(
+            bufs, rows, cols, average_by=average_by, wire_dtype="fp8_e4m3",
+            raw=raw,
+        )
+        np.testing.assert_array_equal(out_np, out_c)
+
+    def test_dequantize_bitwise(self, monkeypatch):
+        a = _rand((7, 55), seed=15)
+        s, p = host_q.quantize(a, "fp8_e4m3")
+        self._toggle(monkeypatch, native=False)
+        out_np = host_q.dequantize(s, p, a.shape, np.float32)
+        self._toggle(monkeypatch, native=True)
+        out_c = host_q.dequantize(s, p, a.shape, np.float32)
+        np.testing.assert_array_equal(out_np, out_c)
+
+    def test_roundtrip_error_bound_fp8(self):
+        a = _rand((16, 256), seed=16)
+        s, p = host_q.quantize(a, "fp8_e4m3")
+        out = host_q.dequantize(s, p, a.shape, np.float32)
+        # e4m3: 3 mantissa bits -> relative error <= 2^-4 per element
+        # (plus the row scale); generous bound
+        assert np.abs(out - a).max() <= np.abs(a).max() * 0.08

@@ -33,6 +33,19 @@ def _build() -> None:
         )
 
 
+def _stale(lib_path: str) -> bool:
+    """True when any native source/Makefile is newer than the built .so."""
+    try:
+        built = os.path.getmtime(lib_path)
+        for name in os.listdir(_NATIVE_DIR):
+            if name.endswith((".cc", ".h")) or name == "Makefile":
+                if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > built:
+                    return True
+    except OSError:
+        return True  # unreadable state: let make decide
+    return False
+
+
 def _find_lib() -> str:
     """Locate (or build) the shared library.  Search order:
 
@@ -52,7 +65,12 @@ def _find_lib() -> str:
         return env
     if os.path.isdir(_NATIVE_DIR):
         repo = os.path.join(_NATIVE_DIR, _LIB_NAME)
-        if not os.path.exists(repo):
+        # rebuild when STALE, not just missing: a pulled source change
+        # with a previously built (gitignored) .so would otherwise load a
+        # library missing newly bound symbols — ctypes raises
+        # AttributeError inside get_lib() and every coordination server
+        # hard-fails on functionality unrelated to the new symbols
+        if not os.path.exists(repo) or _stale(repo):
             _build()
         return repo
     packaged = os.path.join(_PKG_DIR, _LIB_NAME)
@@ -101,8 +119,9 @@ def get_lib() -> ctypes.CDLL:
         ]
 
         # Fused host codec (native/quant.cc) — GIL-free memory-bandwidth
-        # kernels for the int8 DCN wire; bit-identical to the numpy codec
-        # in ops/quantization.py (which stays as the fallback + fp8 path).
+        # kernels for BOTH DCN wire formats (int8 + fp8_e4m3); bit-exact
+        # on finite inputs against the numpy codec in ops/quantization.py
+        # (which stays as the reference semantics / fallback).
         _f32p = ctypes.POINTER(ctypes.c_float)
         _i8p = ctypes.POINTER(ctypes.c_int8)
         lib.tft_quant_int8.restype = None
@@ -112,6 +131,16 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_dequant_fma.restype = None
         lib.tft_dequant_fma.argtypes = [
             _i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p, ctypes.c_int,
+        ]
+        _u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tft_quant_fp8.restype = None
+        lib.tft_quant_fp8.argtypes = [
+            _f32p, ctypes.c_int64, ctypes.c_int64, _f32p, _u8p,
+        ]
+        lib.tft_dequant_fp8_fma.restype = None
+        lib.tft_dequant_fp8_fma.argtypes = [
+            _u8p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p,
+            ctypes.c_int,
         ]
         lib.tft_div_f32.restype = None
         lib.tft_div_f32.argtypes = [_f32p, ctypes.c_int64, ctypes.c_float]

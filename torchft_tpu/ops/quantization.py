@@ -12,7 +12,8 @@ int8 fallback (:30-41); both wire formats exist here:
 - ``wire_dtype="fp8_e4m3"``: ml_dtypes ``float8_e4m3fn`` payloads (the
   reference's fp8e4nv analog) — same 1 byte/element wire size, non-uniform
   grid with better relative precision for small-magnitude entries.  Host
-  codec only: like the reference gates fp8 on SM90 hardware, the device
+  codec only (native C fast path like int8; bit-twiddled RNE encode +
+  LUT decode): like the reference gates fp8 on SM90 hardware, the device
   kernel path stays int8 (no fp8 quantize kernel on current TPU Mosaic).
 
 ``TORCHFT_QUANT_WIRE`` selects the collective layer's default.
@@ -72,10 +73,13 @@ def resolve_wire(wire_dtype: "str | None") -> str:
 # native fused codec (native/quant.cc via ctypes)
 # ---------------------------------------------------------------------------
 #
-# The numpy codec below is the reference semantics and the fp8 path; the
-# native codec is the int8 fast path (~8x: row-blocked fused passes, no
-# temporaries, GIL released during the call).  Bit-identical output is
-# asserted in tests/test_pallas_quant.py.  ``TORCHFT_NO_NATIVE_QUANT=1``
+# The numpy codec below is the reference semantics; the native codec is
+# the fast path for BOTH wire formats (~6-8x: row-blocked fused passes,
+# no temporaries, GIL released during the call — int8 via fused
+# absmax/round/narrow loops, fp8_e4m3 via a bit-twiddled RNE encoder and
+# a 256-entry decode LUT built from ml_dtypes).  Bit-identical output on
+# finite inputs is asserted in tests/test_pallas_quant.py
+# (TestNativeHostCodec + TestNativeFp8Codec).  ``TORCHFT_NO_NATIVE_QUANT=1``
 # forces the numpy path (tests exercise both).
 
 _native_checked = False
@@ -109,9 +113,63 @@ def _i8_ptr(a: np.ndarray, byte_off: int = 0):
     return ctypes.cast(a.ctypes.data + byte_off, _I8P)
 
 
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _u8_ptr(a: np.ndarray, byte_off: int = 0):
+    return ctypes.cast(a.ctypes.data + byte_off, _U8P)
+
+
+_fp8_lut: "Optional[np.ndarray]" = None
+
+
+def _fp8_decode_lut() -> np.ndarray:
+    """256-entry f32 decode table for float8_e4m3fn, built FROM ml_dtypes
+    so the native decode is bit-exact by construction (NaN codes stay NaN,
+    matching the numpy widen of garbage payloads)."""
+    global _fp8_lut
+    if _fp8_lut is None:
+        import ml_dtypes
+
+        _fp8_lut = (
+            np.arange(256, dtype=np.uint8)
+            .view(ml_dtypes.float8_e4m3fn)
+            .astype(np.float32)
+        )
+    return _fp8_lut
+
+
+def _native_dequant_fma(
+    lib, rows2: np.ndarray, scales: np.ndarray, acc: np.ndarray, overwrite: int
+) -> bool:
+    """Dispatch the wire format's native dequant-accumulate kernel into
+    ``acc``; False when no kernel fits this payload dtype (fallback to
+    numpy).  Preconditions (checked by callers): C-contiguous payload,
+    f32 contiguous scales, f32 acc sized (rows, cols)."""
+    if rows2.dtype == np.int8:
+        lib.tft_dequant_fma(
+            _i8_ptr(rows2), _f32_ptr(scales),
+            rows2.shape[0], rows2.shape[1], _f32_ptr(acc), overwrite,
+        )
+        return True
+    if rows2.dtype.itemsize == 1 and rows2.dtype.name == "float8_e4m3fn":
+        lut = _fp8_decode_lut()
+        lib.tft_dequant_fp8_fma(
+            _u8_ptr(rows2), _f32_ptr(scales), _f32_ptr(lut),
+            rows2.shape[0], rows2.shape[1], _f32_ptr(acc), overwrite,
+        )
+        return True
+    return False
+
+
 def _native_eligible(rows: np.ndarray, wire_dtype: str) -> bool:
+    # Both wire formats have native kernels.  Bit-exactness vs numpy is
+    # guaranteed for FINITE inputs; rows containing NaN take the same
+    # degenerate branch on both paths (NaN-propagating absmax), but the
+    # garbage PAYLOAD BYTES of such rows may differ (C element conversion
+    # vs numpy astype-of-NaN) — row-level semantics, not byte identity.
     return (
-        wire_dtype == WIRE_INT8
+        wire_dtype in (WIRE_INT8, WIRE_FP8)
         and _native_lib() is not None
         and rows.dtype == np.float32
         and rows.flags.c_contiguous
@@ -142,11 +200,17 @@ def quantize(
     rows = _as_rows(np.asarray(a, dtype=np.float32))
     if _native_eligible(rows, wire_dtype):
         scales = np.empty(rows.shape[0], dtype=np.float32)
-        payload = np.empty(rows.shape, dtype=np.int8)
-        _native_lib().tft_quant_int8(
-            _f32_ptr(rows), rows.shape[0], rows.shape[1],
-            _f32_ptr(scales), _i8_ptr(payload),
-        )
+        payload = np.empty(rows.shape, dtype=dt)
+        if wire_dtype == WIRE_INT8:
+            _native_lib().tft_quant_int8(
+                _f32_ptr(rows), rows.shape[0], rows.shape[1],
+                _f32_ptr(scales), _i8_ptr(payload),
+            )
+        else:
+            _native_lib().tft_quant_fp8(
+                _f32_ptr(rows), rows.shape[0], rows.shape[1],
+                _f32_ptr(scales), _u8_ptr(payload),
+            )
         return scales, payload
     absmax = np.abs(rows).max(axis=1)
     # Rows with absmax below qmax/f32max would overflow the reciprocal to
@@ -189,11 +253,18 @@ def quantize_packed(
     buf[2] = buf[3] = 0
     # scales live at byte offset 4 — 4-byte aligned (numpy bases are
     # 16-aligned), which is all f32 stores need
-    _native_lib().tft_quant_int8(
-        _f32_ptr(rows), n_rows, cols,
-        _f32_ptr(buf, _HEADER_BYTES),
-        _i8_ptr(buf, _HEADER_BYTES + n_rows * 4),
-    )
+    if wire_dtype == WIRE_INT8:
+        _native_lib().tft_quant_int8(
+            _f32_ptr(rows), n_rows, cols,
+            _f32_ptr(buf, _HEADER_BYTES),
+            _i8_ptr(buf, _HEADER_BYTES + n_rows * 4),
+        )
+    else:
+        _native_lib().tft_quant_fp8(
+            _f32_ptr(rows), n_rows, cols,
+            _f32_ptr(buf, _HEADER_BYTES),
+            _u8_ptr(buf, _HEADER_BYTES + n_rows * 4),
+        )
     return buf
 
 
@@ -203,24 +274,21 @@ def dequantize(
     shape: "Tuple[int, ...]",
     dtype: np.dtype,
 ) -> np.ndarray:
+    lib = _native_lib()
     if (
-        payload.dtype == np.int8
+        lib is not None
         and dtype == np.float32
         and scales.dtype == np.float32
         and payload.flags.c_contiguous
         and scales.flags.c_contiguous
-        and _native_lib() is not None
     ):
         rows2 = _as_rows(payload)
         out = np.empty(rows2.shape, dtype=np.float32)
         # guard above requires contiguous scales — pass it directly (an
         # ascontiguousarray temporary would be unreferenced by the time
         # ctypes extracts the address if the guard were ever relaxed)
-        _native_lib().tft_dequant_fma(
-            _i8_ptr(rows2), _f32_ptr(scales),
-            rows2.shape[0], rows2.shape[1], _f32_ptr(out), 1,
-        )
-        return out.reshape(shape)
+        if _native_dequant_fma(lib, rows2, scales, out, 1):
+            return out.reshape(shape)
     # one fused payload x f32 -> f32 pass; asarray avoids the astype copy
     # when dtype is already float32 (the common DCN case).  ml_dtypes fp8
     # payloads lack a numpy multiply loop against f32 — widen first (still
@@ -296,16 +364,12 @@ def dequantize_into(
     lib = _native_lib()
     if (
         lib is not None
-        and payload.dtype == np.int8
         and scales.dtype == np.float32
         and rows2.flags.c_contiguous
     ):
         sc = np.ascontiguousarray(scales)
-        lib.tft_dequant_fma(
-            _i8_ptr(rows2), _f32_ptr(sc), rows2.shape[0], rows2.shape[1],
-            _f32_ptr(out), 1,
-        )
-        return
+        if _native_dequant_fma(lib, rows2, sc, out, 1):
+            return
     pay = rows2 if rows2.dtype == np.int8 else rows2.astype(np.float32)
     np.multiply(pay, scales[:, None], dtype=np.float32, out=out.reshape(rows2.shape))
 
@@ -333,7 +397,7 @@ def reduce_quantized(
     for the accumulator and (when requantizing) the output wire buffer;
     the accumulator is returned to the pool before a requantized return.
     """
-    lib = _native_lib() if wire_dtype == WIRE_INT8 else None
+    lib = _native_lib() if wire_dtype in (WIRE_INT8, WIRE_FP8) else None
 
     def _fresh_acc() -> np.ndarray:
         if pool is not None:
@@ -347,24 +411,22 @@ def reduce_quantized(
         np.copyto(acc, raw)
     for buf in bufs:
         scales, payload = unpack(buf, rows, cols, wire_dtype)
-        if (
-            lib is not None
-            and payload.dtype == np.int8
-            and payload.flags.c_contiguous
-        ):
-            if acc is None:
+        if lib is not None and payload.flags.c_contiguous:
+            first = acc is None
+            if first:
                 acc = _fresh_acc()
-                overwrite = 1
-            else:
-                overwrite = 0
             # scales is an unaligned 4-byte-offset view into the wire
             # buffer — fine for f32 loads, but take a contiguous copy so
             # the pointer math below is plain
             sc = np.ascontiguousarray(scales)
-            lib.tft_dequant_fma(
-                _i8_ptr(payload), _f32_ptr(sc), rows, cols,
-                _f32_ptr(acc), overwrite,
+            # unpack() derived the payload dtype from wire_dtype and lib
+            # is gated on the same wire_dtype, so the dispatch always has
+            # a kernel here (the bool return exists for dequantize's
+            # caller-supplied payloads)
+            dispatched = _native_dequant_fma(
+                lib, payload, sc, acc, 1 if first else 0
             )
+            assert dispatched, payload.dtype
             continue
         # numpy reference path: fused payload x f32 -> f32 product in one
         # pass; first buffer becomes the accumulator directly
