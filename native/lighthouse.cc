@@ -458,6 +458,7 @@ std::string LighthouseServer::render_status_json() {
   Json out = Json::object();
   out["quorum_id"] = quorum_id_;
   out["status"] = last_reason_;
+  out["num_participants"] = static_cast<int64_t>(participants_.size());
   // live recompute, like the HTML page (reference lighthouse.rs:419)
   std::string live_reason;
   quorum_compute(now, &live_reason);

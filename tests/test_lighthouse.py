@@ -558,8 +558,23 @@ class TestFastRestartSupersession:
                 server.address(), [{"replica_id": "a"}], timeout=1.0
             )
             assert isinstance(res_a["a"], Exception), res_a
-            # b arrives AFTER a's deadline: a's registration must be gone
-            time.sleep(0.2)
+            # b arrives AFTER a's server-side handler exits: the handler
+            # deregisters at its deadline check, which under load can wake
+            # up to a wait slice late — poll the dashboard until the
+            # registration is actually gone instead of sleeping a guess
+            status_client = LighthouseClient(server.address())
+
+            def wait_deregistered():
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if status_client.status()["num_participants"] == 0:
+                        return
+                    time.sleep(0.05)
+                raise AssertionError(
+                    "timed-out requester's registration never cleared"
+                )
+
+            wait_deregistered()
             res_b = _concurrent_quorums(
                 server.address(), [{"replica_id": "b"}], timeout=1.5
             )
@@ -567,6 +582,11 @@ class TestFastRestartSupersession:
                 "ghost participant: a timed-out requester's registration "
                 f"formed a quorum for a lone later peer: {res_b}"
             )
+            # b's own lone request leaves a server-side handler alive to
+            # ITS deadline too — wait for that deregistration as well, or
+            # the final round races b's ghost the same way
+            wait_deregistered()
+            status_client.close()
             # both live -> quorum forms normally
             res = _concurrent_quorums(
                 server.address(),
