@@ -889,16 +889,22 @@ def _bench_diloco_vs_ddp_body(
 
 
 def _diloco_sync_leg(
-    leg: str, quantize: bool, gbps: "float | None", repeats: int = 2
+    leg: str, quantize: bool, gbps: "float | None", repeats: int = 2,
+    wire_dtype: "Optional[str]" = None,
 ) -> "Dict[str, Any]":
     """Flagship-scale outer sync over the TCP ring at a shaped egress
     bandwidth (None = unshaped loopback), best of ``repeats`` runs (the
     shared host shows 2-3x wall spikes from neighbor interference — a
     single sample can turn a 5 s sync into a 15 s headline).  Returns
-    wall, wire and codec seconds (codec only on the quantized leg)."""
+    wall, wire and codec seconds (codec only on the quantized leg).
+    ``wire_dtype``: payload format for the quantized leg (None = the
+    collective's default, int8)."""
     if repeats > 1:
         runs = [
-            _diloco_sync_leg(f"{leg}_r{i}", quantize, gbps, repeats=1)
+            _diloco_sync_leg(
+                f"{leg}_r{i}", quantize, gbps, repeats=1,
+                wire_dtype=wire_dtype,
+            )
             for i in range(repeats)
         ]
         return min(runs, key=lambda r: r["sync_s"])
@@ -926,7 +932,9 @@ def _diloco_sync_leg(
             codec = 0.0
             for _ in range(DILOCO_FRAGMENTS):
                 if quantize:
-                    w = allreduce_quantized([frag], REDUCE_SUM, pg)
+                    w = allreduce_quantized(
+                        [frag], REDUCE_SUM, pg, wire_dtype=wire_dtype
+                    )
                     w.wait(timeout=600)
                     wire += w.wire_bytes
                     codec += w.codec_s_box[0]
@@ -987,6 +995,17 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
     legs: "Dict[str, Any]" = {}
     for leg, quantize in (("f32", False), ("int8", True)):
         r = _diloco_sync_leg(leg, quantize, None)
+        if leg == "int8":
+            # the second wire format, priced once unshaped: since the r5
+            # native fp8 codec, both 8-bit formats cost the same (the
+            # wire bytes are identical; only the grid differs)
+            fp8 = _diloco_sync_leg(
+                "fp8", True, None, repeats=1, wire_dtype="fp8_e4m3"
+            )
+            legs["fp8_e4m3"] = fp8
+            log(f"diloco fp8_e4m3: one outer sync in {fp8['sync_s']:.2f}s "
+                f"(codec {fp8['codec_s']:.1f}s — native RNE encoder; same "
+                f"wire bytes as int8)")
         sync_s = r["sync_s"]
         amortized_ms = sync_s * 1e3 / DILOCO_SYNC_EVERY
         legs[leg] = {
