@@ -897,8 +897,9 @@ def _diloco_sync_leg(
     shared host shows 2-3x wall spikes from neighbor interference — a
     single sample can turn a 5 s sync into a 15 s headline).  Returns
     wall, wire and codec seconds (codec only on the quantized leg).
-    ``wire_dtype``: payload format for the quantized leg (None = the
-    collective's default, int8)."""
+    ``wire_dtype``: payload format for the quantized leg (None resolves
+    through the collective's default chain: TORCHFT_QUANT_WIRE env, else
+    int8 — format-comparison legs pin it explicitly)."""
     if repeats > 1:
         runs = [
             _diloco_sync_leg(
@@ -993,19 +994,15 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
     break-even further in int8's favor.
     """
     legs: "Dict[str, Any]" = {}
-    for leg, quantize in (("f32", False), ("int8", True)):
-        r = _diloco_sync_leg(leg, quantize, None)
-        if leg == "int8":
-            # the second wire format, priced once unshaped: since the r5
-            # native fp8 codec, both 8-bit formats cost the same (the
-            # wire bytes are identical; only the grid differs)
-            fp8 = _diloco_sync_leg(
-                "fp8", True, None, repeats=1, wire_dtype="fp8_e4m3"
-            )
-            legs["fp8_e4m3"] = fp8
-            log(f"diloco fp8_e4m3: one outer sync in {fp8['sync_s']:.2f}s "
-                f"(codec {fp8['codec_s']:.1f}s — native RNE encoder; same "
-                f"wire bytes as int8)")
+    # wire_dtype pinned EXPLICITLY on every quantized leg: this bench
+    # compares formats by name, so a TORCHFT_QUANT_WIRE env default must
+    # not silently swap what the "int8" label measures
+    for leg, quantize, wire in (
+        ("f32", False, None),
+        ("int8", True, "int8"),
+        ("fp8_e4m3", True, "fp8_e4m3"),
+    ):
+        r = _diloco_sync_leg(leg, quantize, None, wire_dtype=wire)
         sync_s = r["sync_s"]
         amortized_ms = sync_s * 1e3 / DILOCO_SYNC_EVERY
         legs[leg] = {
@@ -1029,7 +1026,7 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
     shaped: "Dict[str, Any]" = {}
     for gbps in (1.0, 0.5, 0.1):
         f32 = _diloco_sync_leg("f32s", False, gbps)
-        i8 = _diloco_sync_leg("int8s", True, gbps)
+        i8 = _diloco_sync_leg("int8s", True, gbps, wire_dtype="int8")
         shaped[str(gbps)] = {
             "f32_sync_s": f32["sync_s"],
             "int8_sync_s": i8["sync_s"],

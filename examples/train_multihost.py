@@ -62,6 +62,9 @@ def parse_args(argv=None):
                         "real processes)")
     p.add_argument("--sync-every", type=int, default=4,
                    help="diloco: inner steps per outer sync")
+    p.add_argument("--quantize", action="store_true",
+                   help="int8-quantize the DiLoCo outer pseudograd sync "
+                        "across groups (TORCHFT_QUANT_WIRE for fp8)")
     p.add_argument("--chaos", action="store_true",
                    help="kill one whole group's processes mid-run, restart "
                         "them, and require bitwise convergence after the "
@@ -233,6 +236,7 @@ def _diloco_loop(args, manager, state, grad_step, make_batch, note_commit):
         outer_opt,
         sync_every=args.sync_every,
         fragment_sync_delay=0,
+        should_quantize=args.quantize,
     ) as diloco:
         while manager.current_step() < args.steps:
             if args.step_sleep:
@@ -301,6 +305,8 @@ def launch(args) -> int:
                 "--store-addr", stores[g].address(),
                 "--lighthouse", lighthouse.address(),
             ]
+            if args.quantize:
+                cmd.append("--quantize")
             group_procs.append(subprocess.Popen(
                 cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True,

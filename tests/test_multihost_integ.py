@@ -68,3 +68,20 @@ def test_diloco_across_real_process_groups_with_chaos():
     assert out.returncode == 0, out.stdout + out.stderr
     assert "after chaos kill+rejoin" in out.stdout, out.stdout
     assert "restarted group healed to step" in out.stdout, out.stdout
+
+def test_diloco_quantized_wire_across_real_process_groups():
+    """The int8 quantized outer sync over REAL process boundaries (the
+    reference exercises its quantized allreduce over NCCL ranks;
+    threads/Baby cover the in-process cases): 2 groups x 2 processes,
+    every outer pseudograd sync rides the int8+rowscale wire through the
+    native codec, and all four processes end bitwise identical — the
+    quantized allreduce's allgather hop guarantees every rank decodes
+    the same requantized slices."""
+    out = subprocess.run(
+        [sys.executable, "examples/train_multihost.py",
+         "--groups", "2", "--procs-per-group", "2", "--algo", "diloco",
+         "--steps", "4", "--quantize"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "params converged bitwise across 4 processes" in out.stdout, out.stdout
