@@ -627,29 +627,44 @@ def bench_overhead_crosscheck(rounds: int = 4) -> "Dict[str, Any]":
     protocol_ms_runs: "List[float]" = []
     bare_ms_runs: "List[float]" = []
     null_cpu_ratios: "List[float]" = []
-    for _ in range(rounds):
+    for rnd in range(rounds):
         bare_cpu: "List[float]" = []
         ft_cpu: "List[float]" = []
         null_cpu: "List[float]" = []
+        phases: "Dict[str, float]" = {}
+
+        def run_bare(cpu_out):
+            return _run_bare_twin(
+                world, steps=steps, warmup=warmup, reps=reps, cpu_out=cpu_out
+            )
+
+        def run_ft():
+            return _run_ft_twin(
+                world, phases, steps=steps, warmup=warmup, reps=reps,
+                cpu_out=ft_cpu,
+            )
+
         # NULL experiment: bare vs bare — identical twins.  Whatever ratio
         # spread the null shows is the estimator's noise floor; an FT-vs-
         # bare difference smaller than that floor is unmeasurable by ANY
         # twin comparison on this host, de-contended or not.  The floor is
         # computed on the SAME estimator as the gap (CPU ratios).
-        b_null = _run_bare_twin(
-            world, steps=steps, warmup=warmup, reps=reps, cpu_out=null_cpu
-        )
-        b = _run_bare_twin(
-            world, steps=steps, warmup=warmup, reps=reps, cpu_out=bare_cpu
-        )
+        #
+        # Window order ALTERNATES per round (bare-then-ft / ft-then-bare):
+        # later windows in a round run warmer (page cache, pool, branch
+        # predictors), and a fixed order turns that warming into a
+        # systematic negative "overhead" — alternation cancels it in the
+        # across-rounds median.
+        b_null = run_bare(null_cpu)
+        if rnd % 2 == 0:
+            b = run_bare(bare_cpu)
+            f = run_ft()
+        else:
+            f = run_ft()
+            b = run_bare(bare_cpu)
         null_ratios.append(b / b_null)
         if bare_cpu and null_cpu:
             null_cpu_ratios.append(bare_cpu[0] / null_cpu[0])
-        phases: "Dict[str, float]" = {}
-        f = _run_ft_twin(
-            world, phases, steps=steps, warmup=warmup, reps=reps,
-            cpu_out=ft_cpu,
-        )
         ratios.append(f / b)
         if bare_cpu and ft_cpu:
             cpu_ratios.append(ft_cpu[0] / bare_cpu[0])
