@@ -359,3 +359,49 @@ class TestBabyQuantizedCollective:
         finally:
             for pg in pgs:
                 pg.shutdown()
+
+
+def test_wire_gbps_env_reaches_baby_worker(store, monkeypatch):
+    """TORCHFT_WIRE_GBPS must shape the SUBPROCESS worker's sends too:
+    the Baby worker builds its inner ProcessGroupTCP in the spawned
+    process, which inherits the env — an 8 MB allreduce at 50 MB/s
+    must take >= ~80 ms where unshaped loopback takes < 40 ms."""
+    import time as _time
+
+    monkeypatch.setenv("TORCHFT_WIRE_GBPS", "0.05")
+    pgs = _configure_pair(store, "shapedbaby", timeout=60.0)
+    try:
+        data = np.ones(2 << 20, dtype=np.float32)  # 8 MB
+
+        def run(rank):
+            t0 = _time.monotonic()
+            pgs[rank].allreduce([data.copy()], "sum").wait(timeout=60)
+            return _time.monotonic() - t0
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            walls = [f.result(timeout=90) for f in [ex.submit(run, r) for r in range(2)]]
+        assert max(walls) >= 0.06, walls
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+    # unshaped control: without the env the same transfer must be faster
+    # (guards against the shaped assertion passing vacuously on a slow
+    # host where even unshaped baby allreduces exceed the floor)
+    monkeypatch.delenv("TORCHFT_WIRE_GBPS")
+    pgs2 = _configure_pair(store, "unshapedbaby", timeout=60.0)
+    try:
+        data = np.ones(2 << 20, dtype=np.float32)
+
+        def run2(rank):
+            t0 = _time.monotonic()
+            pgs2[rank].allreduce([data.copy()], "sum").wait(timeout=60)
+            return _time.monotonic() - t0
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            walls2 = [
+                f.result(timeout=90) for f in [ex.submit(run2, r) for r in range(2)]
+            ]
+        assert max(walls2) < max(walls), (walls2, walls)
+    finally:
+        for pg in pgs2:
+            pg.shutdown()

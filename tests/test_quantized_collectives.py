@@ -456,3 +456,21 @@ class TestFp8Wire:
             assert rel < 0.05, f"mutated input leaked into the reduction: {rel}"
         for pg in pgs:
             pg.shutdown()
+
+    def test_reduce_scatter_wire_accounting(self, store):  # noqa: F811
+        world = 2
+        pgs = make_group(store, world, prefix="qrsw")
+        data = [np.ones((8, 512), dtype=np.float32) for _ in range(world)]
+
+        def run(rank, _):
+            w = reduce_scatter_quantized(data[rank], REDUCE_SUM, pgs[rank])
+            w.wait(timeout=30)
+            return w.wire_bytes, w.unquantized_wire_bytes, w.wire_dtype
+
+        for wire, unq, dt in run_parallel(world, run):
+            assert dt == "int8"
+            # half the rows cross the wire, quantized ~4x smaller
+            assert unq == 4 * 4 * 512  # f32 bytes of the peer's slice
+            assert 0 < wire < unq / 3.5, (wire, unq)
+        for pg in pgs:
+            pg.shutdown()

@@ -384,7 +384,11 @@ def reduce_scatter_quantized(
         raise ValueError("quantized reduce_scatter requires floating point arrays")
     world = pg.size()
     if world <= 1:
-        return completed_work(np_array.astype(np.float32))
+        solo = completed_work(np_array.astype(np.float32))
+        solo.wire_bytes = 0  # nothing crosses the wire at world 1
+        solo.unquantized_wire_bytes = 0
+        solo.wire_dtype = wire_dtype
+        return solo
     if np_array.shape[0] % world != 0:
         raise ValueError(
             f"reduce_scatter dim0 {np_array.shape[0]} not divisible by {world}"
@@ -433,4 +437,14 @@ def reduce_scatter_quantized(
         _recycle_wire_bufs(send_bufs, received, my_rank)
         return acc.reshape(out_shape)
 
-    return pg.alltoall(send_bufs).then(_finish)
+    out_work = pg.alltoall(send_bufs).then(_finish)
+    # same wire observability the allreduce carries (no allgather hop
+    # here: only the alltoall's peer slots cross the wire)
+    out_work.wire_bytes = sum(
+        b.nbytes for r, b in enumerate(send_bufs) if r != my_rank
+    )
+    out_work.unquantized_wire_bytes = (
+        4 * (rows_total - my_rows) * cols
+    )
+    out_work.wire_dtype = wire_dtype
+    return out_work
