@@ -24,6 +24,8 @@ collectives.py:336-344).
 
 from __future__ import annotations
 
+import time
+from concurrent.futures import Future
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -50,7 +52,10 @@ def _check_world(received: "List[np.ndarray]", world: int, op: str) -> None:
 
 
 def _recycle_wire_bufs(
-    send_bufs: "List[np.ndarray]", received: "List[np.ndarray]", my_rank: int
+    send_bufs: "List[np.ndarray]",
+    received: "List[np.ndarray]",
+    my_rank: int,
+    exclude: "Optional[np.ndarray]" = None,
 ) -> None:
     """Return dead wire buffers to the pool after a reduce consumed them.
 
@@ -59,14 +64,16 @@ def _recycle_wire_bufs(
     with the INPUT arrays themselves, so anything aliased into
     ``received`` is skipped here and given exactly once below.  Receive
     side: id-deduped (any PG may alias slots); 0-byte own slots no-op in
-    ``give``.
+    ``give``.  ``exclude``: a buffer already given elsewhere (the
+    allgather path's own reduced piece) that must not be double-given
+    even if a PG aliases it into the result.
     """
     for r, b in enumerate(send_bufs):
         if r != my_rank and not any(b is rcv for rcv in received):
             _POOL.give(b)
     seen_ids = set()
     for b in received:
-        if id(b) not in seen_ids:
+        if b is not exclude and id(b) not in seen_ids:
             seen_ids.add(id(b))
             _POOL.give(b)
 
@@ -182,16 +189,15 @@ def allreduce_quantized(
     rows = -(-rows // world) * world
     bounds = _slice_rows(rows, world)
 
-    import time as _time
-
     codec_s = [0.0]  # wall spent in quantize/dequant (observability)
     my_rank = pg.rank()
     raw_self: "Optional[np.ndarray]" = None  # own slice, codec-free f32
+    pooled_blocks: "List[np.ndarray]" = []  # host-path staging to give back
 
     if device_quantize:
         send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
     else:
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         np_arrays = [np.asarray(a) for a in arrays]
         # Zero-copy flatten: a single contiguous f32 input (THE hot case —
         # a DiLoCo pseudograd fragment) is viewed, not copied; multi-array
@@ -209,13 +215,16 @@ def allreduce_quantized(
                 [a.astype(np.float32, copy=False).ravel() for a in np_arrays]
             )
         full_rows = src.size // cols
-        pooled_blocks: "List[np.ndarray]" = []
 
-        def _slice_block(start: int, end: int) -> np.ndarray:
+        def _slice_block(start: int, end: int) -> "Tuple[np.ndarray, bool]":
+            """(block, owned): owned blocks came from the pool (the slice
+            spans the padded tail, zero-filled past the source)."""
             if end <= full_rows:
-                return src[start * cols : end * cols].reshape(end - start, cols)
+                return (
+                    src[start * cols : end * cols].reshape(end - start, cols),
+                    False,
+                )
             block = _POOL.take((end - start, cols), np.float32)
-            pooled_blocks.append(block)
             avail = src.size - start * cols
             flat = block.ravel()
             if avail > 0:
@@ -223,7 +232,7 @@ def allreduce_quantized(
                 flat[avail:] = 0.0
             else:
                 flat[:] = 0.0
-            return block
+            return block, True
 
         # Quantize each destination rank's row-slice separately — EXCEPT
         # our own: alltoall self-delivers locally (the slot never hits the
@@ -233,51 +242,48 @@ def allreduce_quantized(
         # torchft/collectives.py:345-376).
         send_bufs = []
         for r, (start, end) in enumerate(bounds):
+            block, owned = _slice_block(start, end)
             if r == my_rank:
-                block = _slice_block(start, end)
-                if pooled_blocks and pooled_blocks[-1] is block:
-                    # padded block: already a private snapshot
-                    raw_self = block
-                else:
+                if not owned:
                     # view of the caller's array: SNAPSHOT it now (peer
                     # slices are quantized synchronously, so the whole
                     # contribution must be captured at call time — the
                     # caller may mutate its array before the reduce runs)
-                    raw_self = _POOL.take(block.shape, np.float32)
-                    np.copyto(raw_self, block)
-                    pooled_blocks.append(raw_self)
+                    snap = _POOL.take(block.shape, np.float32)
+                    np.copyto(snap, block)
+                    block = snap
+                raw_self = block  # pool-owned either way; given post-reduce
+                pooled_blocks.append(block)
                 send_bufs.append(np.empty(0, dtype=np.uint8))
             else:
-                block = _slice_block(start, end)
                 send_bufs.append(
                     q.quantize_packed(block, wire_dtype, pool=_POOL)
                 )
-                # a padded PEER block is consumed by the quantize above;
-                # the own block (raw_self) lives until the reduce
-                if pooled_blocks and pooled_blocks[-1] is block:
-                    _POOL.give(pooled_blocks.pop())
-        codec_s[0] += _time.perf_counter() - t0
+                if owned:
+                    # a padded PEER block is consumed by the quantize above
+                    _POOL.give(block)
+        codec_s[0] += time.perf_counter() - t0
 
     reduced_box: "List[Optional[np.ndarray]]" = [None]
 
     def _finish_alltoall(received: "List[np.ndarray]") -> Work:
         _check_world(received, world, "alltoall")
         my_rows = bounds[my_rank][1] - bounds[my_rank][0]
-        t0 = _time.perf_counter()
-        if raw_self is not None:
-            bufs = [b for r, b in enumerate(received) if r != my_rank]
-            reduced = q.reduce_quantized(
-                bufs, my_rows, cols, average_by=divisor,
-                wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
-            )
-            while pooled_blocks:
-                _POOL.give(pooled_blocks.pop())
-        else:
-            reduced = q.reduce_quantized(
-                received, my_rows, cols, average_by=divisor,
-                wire_dtype=wire_dtype, pool=_POOL,
-            )
-        codec_s[0] += _time.perf_counter() - t0
+        t0 = time.perf_counter()
+        # host path: own slot is the raw_self snapshot, not a wire buffer;
+        # device path (raw_self None) reduces every received slot
+        bufs = (
+            [b for r, b in enumerate(received) if r != my_rank]
+            if raw_self is not None
+            else received
+        )
+        reduced = q.reduce_quantized(
+            bufs, my_rows, cols, average_by=divisor,
+            wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
+        )
+        while pooled_blocks:
+            _POOL.give(pooled_blocks.pop())
+        codec_s[0] += time.perf_counter() - t0
         # send buffers drained + received buffers consumed by the reduce
         _recycle_wire_bufs(send_bufs, received, my_rank)
         reduced_box[0] = reduced
@@ -287,7 +293,7 @@ def allreduce_quantized(
         # loud on short results: a partial fill of the into-place
         # reassembly below would return uninitialized rows as gradients
         _check_world(gathered, world, "allgather")
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         # dequantize each rank's reduced piece straight into its offset of
         # the full matrix — no per-piece alloc, no concat pass
         full_mat = np.empty((rows, cols), dtype=np.float32)
@@ -298,15 +304,9 @@ def allreduce_quantized(
         reduced = reduced_box[0]
         _POOL.give(reduced)  # own reduced piece: wire + decode done
         reduced_box[0] = None
-        # gathered pieces are decoded into full_mat above — recycle them.
-        # Skip anything identical to `reduced` (already given): the TCP
-        # backend's allgather defensively copies the own piece, but the
-        # invariant must hold for ANY ProcessGroup, so enforce it locally.
-        given = set()
-        for b in gathered:
-            if b is not reduced and id(b) not in given and b.nbytes:
-                given.add(id(b))
-                _POOL.give(b)
+        # gathered pieces are decoded into full_mat above — recycle them
+        # (no send buffers at this hop; `reduced` was already given)
+        _recycle_wire_bufs([], gathered, my_rank, exclude=reduced)
         full = full_mat.ravel()[:total]
         out = []
         offset = 0
@@ -317,13 +317,11 @@ def allreduce_quantized(
                 np.asarray(full[offset : offset + size].reshape(shape), dtype=dtype)
             )
             offset += size
-        codec_s[0] += _time.perf_counter() - t0
+        codec_s[0] += time.perf_counter() - t0
         return out
 
     # Chain: alltoall -> local fused reduce -> allgather -> dequantize.
     work = pg.alltoall(send_bufs)
-
-    from concurrent.futures import Future
 
     out_fut: Future = Future()
 

@@ -61,8 +61,6 @@ def resolve_wire(wire_dtype: "str | None") -> str:
     """Resolve a wire format: explicit value, else the
     ``TORCHFT_QUANT_WIRE`` env default, else int8 — validated either way.
     The one entry point every collective uses for the env knob."""
-    import os
-
     if wire_dtype is None:
         wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", WIRE_INT8)
     _wire(wire_dtype)
