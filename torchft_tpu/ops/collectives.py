@@ -192,7 +192,6 @@ def allreduce_quantized(
     codec_s = [0.0]  # wall spent in quantize/dequant (observability)
     my_rank = pg.rank()
     raw_self: "Optional[np.ndarray]" = None  # own slice, codec-free f32
-    pooled_blocks: "List[np.ndarray]" = []  # host-path staging to give back
 
     if device_quantize:
         send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
@@ -253,7 +252,6 @@ def allreduce_quantized(
                     np.copyto(snap, block)
                     block = snap
                 raw_self = block  # pool-owned either way; given post-reduce
-                pooled_blocks.append(block)
                 send_bufs.append(np.empty(0, dtype=np.uint8))
             else:
                 send_bufs.append(
@@ -281,8 +279,8 @@ def allreduce_quantized(
             bufs, my_rows, cols, average_by=divisor,
             wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
         )
-        while pooled_blocks:
-            _POOL.give(pooled_blocks.pop())
+        if raw_self is not None:
+            _POOL.give(raw_self)  # call-time snapshot, consumed by the reduce
         codec_s[0] += time.perf_counter() - t0
         # send buffers drained + received buffers consumed by the reduce
         _recycle_wire_bufs(send_bufs, received, my_rank)
