@@ -1388,6 +1388,13 @@ def bench_model() -> "Dict[str, Any]":
 
 
 def main() -> None:
+    # Opt-in live scrape surface for long runs: TORCHFT_METRICS_PORT serves
+    # the telemetry registry (phase histograms, abort/heal counters) this
+    # bench's Managers populate — watchable mid-run without touching the
+    # destructive pop_phase_times() accumulator the estimators drain.
+    from torchft_tpu.utils import metrics as _metrics
+
+    _metrics.maybe_serve_from_env()
     recovery = bench_recovery()
     # Insurance against an external wall-cap killing the process mid-run:
     # emit a parseable JSON line with the PRIMARY metric as soon as it

@@ -142,6 +142,22 @@ int tft_server_shutdown(int64_t h) {
   return 0;
 }
 
+// Install (or clear, with NULL) the Prometheus /metrics supplement on a
+// lighthouse: the provider writes extra exposition text (the embedding
+// process's metric registry) appended to the native metrics.  See
+// LighthouseServer::MetricsProvider for the buffer contract.
+int tft_lighthouse_set_metrics_provider(int64_t h,
+                                        int (*provider)(char*, int)) {
+  tft::RpcServer* s = find_server(h);
+  auto* lighthouse = dynamic_cast<tft::LighthouseServer*>(s);
+  if (lighthouse == nullptr) {
+    g_last_error = "bad lighthouse handle";
+    return -1;
+  }
+  lighthouse->set_metrics_provider(provider);
+  return 0;
+}
+
 // Pure quorum-result math, exposed for unit tests: input/output JSON.
 char* tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
                                  const char* quorum_json, int init_sync) {

@@ -216,6 +216,7 @@ void LighthouseServer::tick_locked(int64_t now) {
   participants_.clear();
   latest_quorum_ = q;
   quorum_seq_ += 1;
+  quorums_formed_total_ += 1;
   quorum_cv_.notify_all();
 }
 
@@ -249,6 +250,7 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
 
   std::unique_lock<std::mutex> lk(mu_);
   int64_t now = now_ms();
+  quorum_requests_total_ += 1;
   // Supersession is one-directional: an incarnation that has been evicted
   // (a newer incarnation of the same logical replica joined after it) can
   // never re-register or evict its successor, even if the old process is
@@ -389,6 +391,7 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
 Json LighthouseServer::rpc_heartbeat(const Json& params) {
   std::lock_guard<std::mutex> g(mu_);
   const std::string rid = params.get("replica_id").as_string();
+  heartbeats_total_ += 1;
   Json out = Json::object();
   // A superseded incarnation's background heartbeat thread must not
   // resurrect its heartbeats_ entry — that would make the zombie "healthy
@@ -449,7 +452,73 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
     http_reply(fd, 200, "application/json", render_status_json());
     return;
   }
+  if (method == "GET" && path == "/metrics") {
+    http_reply(fd, 200, "text/plain; version=0.0.4", render_metrics());
+    return;
+  }
   http_reply(fd, 404, "text/plain", "not found\n");
+}
+
+void LighthouseServer::set_metrics_provider(MetricsProvider provider) {
+  std::lock_guard<std::mutex> g(provider_mu_);
+  metrics_provider_ = provider;
+}
+
+std::string LighthouseServer::render_metrics() {
+  // Prometheus text exposition 0.0.4: native lighthouse counters/gauges,
+  // then whatever the embedding process's registry supplies (the Python
+  // side registers a provider that renders torchft_tpu.utils.metrics).
+  std::ostringstream os;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t now = now_ms();
+    int64_t fresh = 0;
+    for (const auto& [rid, ts] : heartbeats_)
+      if (now - ts < opt_.heartbeat_timeout_ms) fresh += 1;
+    os << "# HELP torchft_lighthouse_quorums_formed_total Quorums formed "
+          "since lighthouse start\n"
+       << "# TYPE torchft_lighthouse_quorums_formed_total counter\n"
+       << "torchft_lighthouse_quorums_formed_total " << quorums_formed_total_
+       << "\n"
+       << "# HELP torchft_lighthouse_quorum_requests_total Quorum RPC "
+          "requests received\n"
+       << "# TYPE torchft_lighthouse_quorum_requests_total counter\n"
+       << "torchft_lighthouse_quorum_requests_total "
+       << quorum_requests_total_ << "\n"
+       << "# HELP torchft_lighthouse_heartbeats_total Heartbeat RPCs "
+          "received\n"
+       << "# TYPE torchft_lighthouse_heartbeats_total counter\n"
+       << "torchft_lighthouse_heartbeats_total " << heartbeats_total_ << "\n"
+       << "# HELP torchft_lighthouse_quorum_id Current quorum id\n"
+       << "# TYPE torchft_lighthouse_quorum_id gauge\n"
+       << "torchft_lighthouse_quorum_id " << quorum_id_ << "\n"
+       << "# HELP torchft_lighthouse_participants Participants waiting in "
+          "the next quorum\n"
+       << "# TYPE torchft_lighthouse_participants gauge\n"
+       << "torchft_lighthouse_participants "
+       << static_cast<int64_t>(participants_.size()) << "\n"
+       << "# HELP torchft_lighthouse_heartbeats_live Replicas with a fresh "
+          "heartbeat\n"
+       << "# TYPE torchft_lighthouse_heartbeats_live gauge\n"
+       << "torchft_lighthouse_heartbeats_live " << fresh << "\n";
+  }
+  {
+    std::lock_guard<std::mutex> g(provider_mu_);
+    if (metrics_provider_ != nullptr) {
+      std::vector<char> buf(1 << 16);
+      int n = metrics_provider_(buf.data(), static_cast<int>(buf.size()));
+      // Retry with growing headroom: the registry can gain label children
+      // between the probe and the re-render, so sizing exactly to the
+      // first -needed can come up short again.
+      for (int attempt = 0; n < 0 && attempt < 4; ++attempt) {
+        buf.resize(static_cast<size_t>(-n) + (buf.size() >> 1) + 4096);
+        n = metrics_provider_(buf.data(), static_cast<int>(buf.size()));
+      }
+      if (n > 0)
+        os.write(buf.data(), std::min<int>(n, static_cast<int>(buf.size())));
+    }
+  }
+  return os.str();
 }
 
 std::string LighthouseServer::render_status_json() {

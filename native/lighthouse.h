@@ -75,6 +75,16 @@ class LighthouseServer : public RpcServer {
   // Returns the quorum participants if a quorum formed (state updated).
   bool tick_for_test();
 
+  // Prometheus /metrics supplement: a callback that writes additional
+  // exposition text (the embedding process's metric registry) into the
+  // caller's buffer.  Contract: returns bytes written, or the negated
+  // required size when the buffer is too small (caller retries bigger).
+  // NULL clears.  Called from HTTP handler threads — for the Python
+  // (ctypes) provider that implies a GIL acquisition per scrape, which is
+  // fine at scrape rates.
+  using MetricsProvider = int (*)(char* buf, int cap);
+  void set_metrics_provider(MetricsProvider provider);
+
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
@@ -106,6 +116,7 @@ class LighthouseServer : public RpcServer {
   Json rpc_heartbeat(const Json& params);
   std::string render_status_html();
   std::string render_status_json();
+  std::string render_metrics();
 
   LighthouseOpt opt_;
 
@@ -128,6 +139,14 @@ class LighthouseServer : public RpcServer {
   int64_t next_reg_token_ = 0;
   Quorum latest_quorum_;
   std::string last_reason_;
+
+  // Native telemetry counters (served on GET /metrics, guarded by mu_).
+  int64_t quorums_formed_total_ = 0;
+  int64_t quorum_requests_total_ = 0;
+  int64_t heartbeats_total_ = 0;
+
+  std::mutex provider_mu_;
+  MetricsProvider metrics_provider_ = nullptr;
 
   std::thread tick_thread_;
 };

@@ -207,6 +207,49 @@ class TestLighthouse:
             assert "torchft_tpu lighthouse" in html
             assert "web" in html
 
+    def test_metrics_endpoint(self):
+        """GET /metrics on the dashboard port returns valid Prometheus text
+        exposition: the native lighthouse counters plus this process's
+        telemetry registry (the provider-callback seam)."""
+        from torchft_tpu.utils.metrics import parse_text_exposition
+
+        with LighthouseServer(min_replicas=1, join_timeout_ms=100) as server:
+            _concurrent_quorums(server.address(), [{"replica_id": "m"}])
+            body = (
+                urllib.request.urlopen(
+                    f"http://{server.address()}/metrics", timeout=5
+                )
+                .read()
+                .decode()
+            )
+        fams = parse_text_exposition(body)  # strict: raises on bad lines
+        # native lighthouse counters reflect the quorum that just formed
+        assert fams["torchft_lighthouse_quorums_formed_total"]["type"] == "counter"
+        assert (
+            fams["torchft_lighthouse_quorums_formed_total"]["samples"][
+                ("torchft_lighthouse_quorums_formed_total", ())
+            ]
+            >= 1
+        )
+        assert (
+            fams["torchft_lighthouse_quorum_id"]["samples"][
+                ("torchft_lighthouse_quorum_id", ())
+            ]
+            == 1
+        )
+        # the Python registry rides the same scrape (acceptance criteria):
+        # histogram buckets + the pg abort counter are present even before
+        # any manager has run in this process
+        assert fams["torchft_quorum_duration_seconds"]["type"] == "histogram"
+        assert any(
+            name == "torchft_quorum_duration_seconds_bucket"
+            and dict(labels).get("le") == "+Inf"
+            for name, labels in fams["torchft_quorum_duration_seconds"]["samples"]
+        )
+        assert ("torchft_pg_aborts_total", ()) in fams[
+            "torchft_pg_aborts_total"
+        ]["samples"]
+
     def test_status_rpc(self):
         with LighthouseServer(min_replicas=1, join_timeout_ms=100) as server:
             _concurrent_quorums(server.address(), [{"replica_id": "s"}])

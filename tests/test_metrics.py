@@ -1,0 +1,388 @@
+"""Telemetry-layer unit tests: registry thread-safety, histogram bucket
+math, Prometheus text-format round-trip, the scrape server, and the OTLP
+metrics/traces JSON encodings against an in-process fake collector (the
+same no-egress pattern as tests/test_otel.py)."""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from torchft_tpu.utils.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsHTTPServer,
+    OTLPMetricsExporter,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    parse_text_exposition,
+)
+from torchft_tpu.utils.tracing import (
+    OTLPHTTPSpanExporter,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
+
+
+class _FakeCollector:
+    """Records every POST body by path (OTLP metrics + traces)."""
+
+    def __init__(self, status: int = 200):
+        self.requests = []
+        self.status = status
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                outer.requests.append(
+                    {"path": self.path, "body": json.loads(body)}
+                )
+                self.send_response(outer.status)
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture
+def collector():
+    c = _FakeCollector()
+    yield c
+    c.close()
+
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        reg = Registry()
+        c = Counter("c_total", "a counter", registry=reg)
+        g = Gauge("g", "a gauge", registry=reg)
+        c.inc()
+        c.inc(2.5)
+        g.set(7)
+        g.dec(3)
+        assert c.get() == 3.5
+        assert g.get() == 4
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_labeled_children_and_aggregate(self):
+        reg = Registry()
+        c = Counter("jobs_total", "jobs", ("queue",), registry=reg)
+        c.labels(queue="a").inc()
+        c.labels(queue="a").inc()
+        c.labels(queue="b").inc(3)
+        # unlabeled family series aggregates across children
+        assert c.get() == 5
+        assert c.labels(queue="a").get() == 2
+        with pytest.raises(ValueError):
+            c.labels(wrong="x")
+
+    def test_name_collision_and_get_or_create(self):
+        reg = Registry()
+        a = counter("dup_total", "h", registry=reg)
+        assert counter("dup_total", "h", registry=reg) is a
+        with pytest.raises(ValueError):
+            gauge("dup_total", "h", registry=reg)
+        with pytest.raises(ValueError):
+            counter("dup_total", "h", ("lbl",), registry=reg)
+        with pytest.raises(ValueError):
+            Counter("bad name", "h", registry=reg)
+        with pytest.raises(ValueError):
+            Counter("ok_total", "h", ("le",), registry=reg)
+
+    def test_thread_safety_concurrent_increments(self):
+        reg = Registry()
+        c = Counter("race_total", "r", ("worker",), registry=reg)
+        h = Histogram("race_seconds", "r", registry=reg)
+        n, threads = 2000, 8
+
+        def worker(i):
+            child = c.labels(worker=str(i % 2))
+            for _ in range(n):
+                child.inc()
+                h.observe(0.01)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.get() == n * threads
+        assert c.labels(worker="0").get() == n * threads / 2
+        assert h.get()["count"] == n * threads
+
+    def test_histogram_bucket_math(self):
+        reg = Registry()
+        h = Histogram(
+            "lat_seconds", "l", buckets=(0.1, 1.0, 10.0), registry=reg
+        )
+        for v in (0.05, 0.1, 0.5, 5.0, 100.0):
+            h.observe(v)
+        snap = h.get()
+        # le is inclusive: 0.1 lands in the 0.1 bucket
+        assert snap["buckets"] == [2, 3, 4, 5]  # cumulative, +Inf last
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(105.65)
+
+    def test_default_buckets_exponential(self):
+        assert DEFAULT_BUCKETS[0] == pytest.approx(0.001)
+        ratios = [
+            b / a for a, b in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:])
+        ]
+        assert all(r == pytest.approx(2.0) for r in ratios)
+
+
+class TestExposition:
+    def test_render_round_trip(self):
+        reg = Registry()
+        c = Counter("rt_total", "round trip", ("replica_id",), registry=reg)
+        g = Gauge("rt_gauge", "a gauge", registry=reg)
+        h = Histogram(
+            "rt_seconds", "hist", ("phase",), buckets=(0.5, 1.5), registry=reg
+        )
+        c.labels(replica_id="r0:uuid").inc(4)
+        g.set(-2.5)
+        h.labels(phase="commit").observe(1.0)
+        fams = parse_text_exposition(reg.render())
+        assert fams["rt_total"]["type"] == "counter"
+        assert fams["rt_total"]["help"] == "round trip"
+        assert (
+            fams["rt_total"]["samples"][
+                ("rt_total", (("replica_id", "r0:uuid"),))
+            ]
+            == 4
+        )
+        # aggregate series present too
+        assert fams["rt_total"]["samples"][("rt_total", ())] == 4
+        assert fams["rt_gauge"]["samples"][("rt_gauge", ())] == -2.5
+        hs = fams["rt_seconds"]["samples"]
+        assert hs[("rt_seconds_bucket", (("phase", "commit"), ("le", "0.5")))] == 0
+        assert hs[("rt_seconds_bucket", (("phase", "commit"), ("le", "1.5")))] == 1
+        assert hs[("rt_seconds_bucket", (("phase", "commit"), ("le", "+Inf")))] == 1
+        assert hs[("rt_seconds_count", (("phase", "commit"),))] == 1
+        assert hs[("rt_seconds_sum", (("phase", "commit"),))] == 1.0
+
+    def test_label_escaping_round_trip(self):
+        reg = Registry()
+        c = Counter("esc_total", "escapes", ("path",), registry=reg)
+        # includes the literal-backslash-before-n case a sequential
+        # str.replace unescape corrupts
+        nasty = 'a"b\\c\nd\\ne'
+        c.labels(path=nasty).inc()
+        text = reg.render()
+        fams = parse_text_exposition(text)  # strict parse must succeed
+        assert (
+            fams["esc_total"]["samples"][("esc_total", (("path", nasty),))]
+            == 1
+        )
+
+    def test_parser_rejects_malformed(self):
+        for bad in (
+            "no_value_here\n",
+            'x{unclosed="v} 1\n',
+            "name 1\nname 2\n",  # duplicate sample
+            "ok_metric notanumber\n",
+        ):
+            with pytest.raises(ValueError):
+                parse_text_exposition(bad)
+
+    def test_http_scrape_server(self):
+        reg = Registry()
+        Counter("srv_total", "s", registry=reg).inc(9)
+        server = MetricsHTTPServer(port=0, registry=reg)
+        try:
+            body = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5
+                )
+                .read()
+                .decode()
+            )
+        finally:
+            server.close()
+        fams = parse_text_exposition(body)
+        assert fams["srv_total"]["samples"][("srv_total", ())] == 9
+
+    def test_serve_from_env_gate(self, monkeypatch):
+        from torchft_tpu.utils import metrics as m
+
+        monkeypatch.delenv("TORCHFT_METRICS_PORT", raising=False)
+        assert m.maybe_serve_from_env() is None
+
+
+class TestOTLPMetrics:
+    def test_encoding_against_stub(self, collector):
+        reg = Registry()
+        c = Counter("otlp_total", "c", ("replica_id",), registry=reg)
+        c.labels(replica_id="r0").inc(3)
+        Gauge("otlp_gauge", "g", registry=reg).set(1.5)
+        h = Histogram("otlp_seconds", "h", buckets=(1.0, 2.0), registry=reg)
+        h.observe(1.5)
+        exp = OTLPMetricsExporter(
+            collector.endpoint, registry=reg, interval_s=3600
+        )
+        try:
+            assert exp.flush()
+        finally:
+            exp.close()
+        req = collector.requests[0]
+        assert req["path"] == "/v1/metrics"
+        sm = req["body"]["resourceMetrics"][0]["scopeMetrics"][0]
+        by_name = {m["name"]: m for m in sm["metrics"]}
+        csum = by_name["otlp_total"]["sum"]
+        assert csum["isMonotonic"] and csum["aggregationTemporality"] == 2
+        # data points: aggregate (no attrs) + the labeled child
+        vals = {
+            tuple(
+                (a["key"], a["value"]["stringValue"])
+                for a in p["attributes"]
+            ): p["asDouble"]
+            for p in csum["dataPoints"]
+        }
+        assert vals[()] == 3.0
+        assert vals[(("replica_id", "r0"),)] == 3.0
+        assert by_name["otlp_gauge"]["gauge"]["dataPoints"][0]["asDouble"] == 1.5
+        hp = by_name["otlp_seconds"]["histogram"]["dataPoints"][0]
+        assert hp["explicitBounds"] == [1.0, 2.0]
+        assert hp["bucketCounts"] == ["0", "1", "0"]  # per-bucket, not cum
+        assert hp["count"] == "1"
+        assert exp.exported == 1 and exp.dropped == 0
+
+    def test_collector_down_never_raises(self):
+        reg = Registry()
+        Counter("down_total", "c", registry=reg).inc()
+        exp = OTLPMetricsExporter(
+            "http://127.0.0.1:9", registry=reg, interval_s=3600, timeout_s=0.5
+        )
+        try:
+            assert exp.flush() is False
+        finally:
+            exp.close()
+        assert exp.dropped == 1 and exp.exported == 0
+
+    def test_export_from_env_gate(self, monkeypatch):
+        from torchft_tpu.utils import metrics as m
+
+        monkeypatch.delenv("TORCHFT_USE_OTEL", raising=False)
+        assert m.maybe_export_from_env() is None
+
+
+class TestOTLPTraces:
+    def test_span_tree_encoding(self, collector):
+        exp = OTLPHTTPSpanExporter(
+            collector.endpoint, flush_interval_s=0.1
+        )
+        tracer = Tracer(exp)
+        trace_id = new_trace_id()
+        root = new_span_id()
+        try:
+            t0 = time.time_ns()
+            tracer.export_span(
+                name="quorum_rpc",
+                trace_id=trace_id,
+                parent_span_id=root,
+                start_ns=t0,
+                end_ns=t0 + 1_000_000,
+                attributes={"step": 3, "quorum_id": 7, "replica_id": "r0"},
+            )
+            tracer.export_span(
+                name="quorum_round",
+                trace_id=trace_id,
+                span_id=root,
+                start_ns=t0,
+                end_ns=t0 + 2_000_000,
+                attributes={"step": 3, "quorum_id": 7, "commit_result": True},
+            )
+            assert exp.flush(timeout=5.0)
+        finally:
+            exp.close()
+        req = collector.requests[0]
+        assert req["path"] == "/v1/traces"
+        spans = req["body"]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans}
+        child, parent = by_name["quorum_rpc"], by_name["quorum_round"]
+        assert len(parent["traceId"]) == 32 and len(parent["spanId"]) == 16
+        assert child["traceId"] == parent["traceId"] == trace_id
+        assert child["parentSpanId"] == parent["spanId"] == root
+        assert "parentSpanId" not in parent
+        attrs = {a["key"]: a["value"] for a in child["attributes"]}
+        # the correlation keys shared with the structured-event pipeline
+        assert attrs["step"] == {"intValue": "3"}
+        assert attrs["quorum_id"] == {"intValue": "7"}
+        assert exp.exported == 2 and exp.dropped == 0
+
+    def test_collector_down_never_raises(self):
+        exp = OTLPHTTPSpanExporter(
+            "http://127.0.0.1:9", flush_interval_s=0.05, timeout_s=0.5
+        )
+        try:
+            exp.export(
+                {
+                    "name": "x",
+                    "trace_id": new_trace_id(),
+                    "span_id": new_span_id(),
+                    "start_ns": 1,
+                    "end_ns": 2,
+                }
+            )
+            deadline = time.monotonic() + 5.0
+            while exp.dropped == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            exp.close()
+        assert exp.dropped == 1 and exp.exported == 0
+
+    def test_export_after_close_counts_dropped(self):
+        exp = OTLPHTTPSpanExporter("http://127.0.0.1:9", timeout_s=0.5)
+        exp.close()
+        exp.export(
+            {
+                "name": "late",
+                "trace_id": new_trace_id(),
+                "span_id": new_span_id(),
+                "start_ns": 1,
+                "end_ns": 2,
+            }
+        )
+        assert exp.dropped == 1
+
+
+class TestNewEventKinds:
+    def test_heal_and_reconfigure_are_valid_kinds(self):
+        from torchft_tpu.utils.logging import log_event, recent_events
+
+        log_event("heal", "healing peer", direction="recv", step=5)
+        log_event("reconfigure", "pg reconfigured", quorum_id=2)
+        kinds = [e["kind"] for e in recent_events()[-2:]]
+        assert kinds == ["heal", "reconfigure"]
+        with pytest.raises(ValueError):
+            log_event("bogus", "nope")
+
+    def test_otel_severity_covers_every_kind(self):
+        from torchft_tpu.utils.logging import _LOGGERS
+        from torchft_tpu.utils.otel import _SEVERITY
+
+        assert set(_SEVERITY) == set(_LOGGERS)

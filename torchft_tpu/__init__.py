@@ -31,12 +31,22 @@ from torchft_tpu.parallel.process_group import (
 # Reference name: torchft.Optimizer (torchft/optim.py re-exported at root).
 Optimizer = OptimizerWrapper
 
-# OTLP log export, gated on TORCHFT_USE_OTEL (reference wires its OTEL
-# pipeline at import, torchft/__init__.py:20-22 + otel.py:42-86).
+# Telemetry from env, at import (reference wires its OTEL pipeline at
+# import, torchft/__init__.py:20-22 + otel.py:42-86): OTLP logs + metrics
+# + traces gated on TORCHFT_USE_OTEL; the Prometheus scrape server gated
+# on TORCHFT_METRICS_PORT.
+from torchft_tpu.utils.metrics import (
+    maybe_export_from_env as _metrics_export_install,
+    maybe_serve_from_env as _metrics_serve_install,
+)
 from torchft_tpu.utils.otel import maybe_install_from_env as _otel_install
+from torchft_tpu.utils.tracing import maybe_install_from_env as _traces_install
 
 _otel_install()
-del _otel_install
+_metrics_export_install()
+_traces_install()
+_metrics_serve_install()
+del _otel_install, _metrics_export_install, _traces_install, _metrics_serve_install
 
 __all__ = [
     "DiLoCo",

@@ -20,6 +20,14 @@ _LIB_NAME = "libtorchft_tpu_native.so"
 _build_lock = threading.Lock()
 _lib: "ctypes.CDLL | None" = None
 
+# Signature of a lighthouse /metrics supplement provider: writes exposition
+# text into (buf, cap); returns bytes written, or the negated required size
+# when cap is too small.  Called from native HTTP threads — ctypes acquires
+# the GIL around the Python callable automatically.
+METRICS_PROVIDER_CFUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.c_char), ctypes.c_int
+)
+
 
 def _build() -> None:
     result = subprocess.run(
@@ -112,6 +120,11 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_server_address.argtypes = [ctypes.c_int64]
         lib.tft_server_shutdown.restype = ctypes.c_int
         lib.tft_server_shutdown.argtypes = [ctypes.c_int64]
+
+        lib.tft_lighthouse_set_metrics_provider.restype = ctypes.c_int
+        lib.tft_lighthouse_set_metrics_provider.argtypes = [
+            ctypes.c_int64, METRICS_PROVIDER_CFUNC,
+        ]
 
         lib.tft_compute_quorum_results.restype = ctypes.c_void_p
         lib.tft_compute_quorum_results.argtypes = [

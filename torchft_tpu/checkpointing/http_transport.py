@@ -24,6 +24,7 @@ from typing import Any, Callable, List, Optional
 
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.rwlock import RWLock
 
 logger = logging.getLogger(__name__)
@@ -98,7 +99,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(total))
                 self.end_headers()
+                t0 = time.perf_counter()
                 writer(self.wfile)
+                _metrics.CHECKPOINT_BYTES.labels(
+                    transport="http", direction="send"
+                ).inc(total)
+                _metrics.CHECKPOINT_DURATION.labels(
+                    transport="http", direction="send"
+                ).observe(time.perf_counter() - t0)
         except TimeoutError:
             self.send_error(503, "checkpoint busy")
         except BrokenPipeError:
@@ -165,6 +173,7 @@ class HTTPTransport(CheckpointTransport[Any]):
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
         deadline = time.monotonic() + timeout
+        t_recv = time.perf_counter()
 
         into = None
         if self._state_dict_fn is not None:
@@ -191,6 +200,9 @@ class HTTPTransport(CheckpointTransport[Any]):
                 t = max(deadline - time.monotonic(), 0.001)
                 try:
                     with urllib.request.urlopen(f"{base}/{path}", timeout=t) as resp:
+                        _metrics.CHECKPOINT_BYTES.labels(
+                            transport="http", direction="recv"
+                        ).inc(int(resp.headers.get("Content-Length") or 0))
                         return ser.deserialize_from(resp, into=into)
                 except urllib.error.HTTPError as e:
                     if e.code != 503 or time.monotonic() + backoff >= deadline:
@@ -198,16 +210,24 @@ class HTTPTransport(CheckpointTransport[Any]):
                 except urllib.error.URLError:
                     if time.monotonic() + backoff >= deadline:
                         raise
+                _metrics.CHECKPOINT_RETRIES.labels(transport="http").inc()
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 1.0)
 
+        def _done() -> None:
+            _metrics.CHECKPOINT_DURATION.labels(
+                transport="http", direction="recv"
+            ).observe(time.perf_counter() - t_recv)
+
         if self._num_chunks <= 0:
             skeleton, leaves, n = fetch("full")
+            _done()
             return ser.reassemble(skeleton, leaves, n)
 
         # Parallel chunk fetch (reference http_transport.py:244-267).
         with ThreadPoolExecutor(max_workers=self._num_chunks) as pool:
             results = list(pool.map(fetch, [f"chunk_{i}" for i in range(self._num_chunks)]))
+        _done()
         skeleton, _, n = results[0]
         merged: dict = {}
         for _, leaves, _ in results:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import time
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -19,6 +20,7 @@ import numpy as np
 
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.parallel.process_group import ProcessGroup
+from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +68,8 @@ class PGTransport(CheckpointTransport[Any]):
             pickle.dumps({"step": step, "skeleton": skeleton, "leaves": metas}),
             dtype=np.uint8,
         )
+        t0 = time.perf_counter()
+        nbytes = header.nbytes + sum(a.nbytes for a in arrays if a is not None)
         for dst in dst_ranks:
             # submit the whole stream, then reap: the PG worker executes
             # in submission order, and keeping its queue non-empty lets it
@@ -81,10 +85,17 @@ class PGTransport(CheckpointTransport[Any]):
                     )
             for w in works:
                 w.wait(timeout=timeout)
+            _metrics.CHECKPOINT_BYTES.labels(
+                transport="pg", direction="send"
+            ).inc(nbytes)
+        _metrics.CHECKPOINT_DURATION.labels(
+            transport="pg", direction="send"
+        ).observe(time.perf_counter() - t0)
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
+        t0 = time.perf_counter()
         header_bytes = self._pg.recv(src_rank, tag=_META_TAG).wait(timeout=timeout)
         header = pickle.loads(header_bytes.tobytes())
         if header["step"] != step:
@@ -158,5 +169,12 @@ class PGTransport(CheckpointTransport[Any]):
             # latches the error and reconfigures at the next quorum.
             self._pg.abort()
             raise
+        _metrics.CHECKPOINT_BYTES.labels(transport="pg", direction="recv").inc(
+            header_bytes.nbytes
+            + sum(l.nbytes for l in leaves if isinstance(l, np.ndarray))
+        )
+        _metrics.CHECKPOINT_DURATION.labels(
+            transport="pg", direction="recv"
+        ).observe(time.perf_counter() - t0)
         treedef = jax.tree_util.tree_structure(header["skeleton"])
         return jax.tree_util.tree_unflatten(treedef, leaves)

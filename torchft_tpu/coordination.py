@@ -309,8 +309,11 @@ class _NativeServer:
 class LighthouseServer(_NativeServer):
     """Cluster quorum authority (C++). Reference: src/lighthouse.rs.
 
-    Binds ``[::]:port`` (port 0 = ephemeral); serves framed-JSON RPC and an
-    HTML dashboard on the same port.
+    Binds ``[::]:port`` (port 0 = ephemeral); serves framed-JSON RPC, an
+    HTML dashboard, and Prometheus ``GET /metrics`` on the same port.  The
+    /metrics exposition is the native lighthouse counters plus this
+    process's ``torchft_tpu.utils.metrics`` registry, rendered live via a
+    provider callback — the one scrape endpoint a single-host job needs.
     """
 
     def __init__(
@@ -332,6 +335,45 @@ class LighthouseServer(_NativeServer):
             heartbeat_timeout_ms,
         )
         super().__init__(handle)
+        self._metrics_cb: Any = None
+        self._install_metrics_provider()
+
+    def _install_metrics_provider(self) -> None:
+        from torchft_tpu.utils import metrics as _metrics
+
+        import ctypes
+
+        def _provider(buf: Any, cap: int) -> int:
+            # Contract (native/lighthouse.h MetricsProvider): write up to
+            # ``cap`` bytes; return bytes written, or -needed if too small.
+            # Never raise: a scrape must not be able to wedge the server.
+            try:
+                text = _metrics.REGISTRY.render().encode()
+            except Exception:  # noqa: BLE001
+                return 0
+            if len(text) > cap:
+                return -len(text)
+            ctypes.memmove(buf, text, len(text))
+            return len(text)
+
+        # the CFUNCTYPE object must outlive the native registration
+        self._metrics_cb = _native.METRICS_PROVIDER_CFUNC(_provider)
+        _native.get_lib().tft_lighthouse_set_metrics_provider(
+            self._handle, self._metrics_cb
+        )
+
+    def shutdown(self) -> None:
+        """Stop the server and release its socket; idempotent.
+
+        Clears the /metrics provider BEFORE tearing the server down so no
+        native HTTP thread can call into a collected callback (shutdown
+        drains in-flight connections before returning)."""
+        if self._handle is not None and self._metrics_cb is not None:
+            _native.get_lib().tft_lighthouse_set_metrics_provider(
+                self._handle, _native.METRICS_PROVIDER_CFUNC()
+            )
+            self._metrics_cb = None
+        super().shutdown()
 
 
 class StoreServer(_NativeServer):

@@ -25,6 +25,7 @@ Semantics parity:
 from __future__ import annotations
 
 import logging
+import time
 from types import TracebackType
 from typing import Any, Callable, Dict, List, Optional, Type
 
@@ -34,6 +35,7 @@ import optax
 
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.work import Work
+from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -193,6 +195,12 @@ class _Fragment:
             self.original_parameters,
             local,
         )
+        # payload-byte fallback for the wire gauge when the collective
+        # doesn't report actual wire bytes (unquantized path)
+        self._payload_bytes = sum(
+            np.asarray(v).nbytes
+            for v in jax.tree_util.tree_leaves(pseudograds)
+        )
         assert not self._allreduce_work
         self._allreduce_work.append(
             self._manager.allreduce(pseudograds, should_quantize=self._should_quantize)
@@ -208,8 +216,18 @@ class _Fragment:
         """Wait for the allreduce, vote, and outer-step on success
         (reference :423-476)."""
         assert self._allreduce_work, "perform_sync before prepare_sync"
+        t_sync = time.perf_counter()
         work = self._allreduce_work.pop()
         avg_pseudograds = work.wait(timeout=self._manager._timeout)
+        wire_bytes = getattr(work, "wire_bytes", None)
+        if wire_bytes is None:
+            # explicit None check: wire_bytes == 0 is a real measurement
+            # (world size 1 sends nothing) and must not fall back to the
+            # full payload size
+            wire_bytes = getattr(self, "_payload_bytes", 0)
+        _metrics.DILOCO_WIRE_BYTES.labels(fragment=str(self._fragment_id)).set(
+            wire_bytes
+        )
 
         # save local then roll back to the global backup: a failed commit
         # must leave us on consistent (pre-divergence) state
@@ -247,6 +265,9 @@ class _Fragment:
             )
             self._write_fragment(merged)
         self._local_parameters = None
+        _metrics.DILOCO_SYNC_SECONDS.labels(fragment=str(self._fragment_id)).set(
+            time.perf_counter() - t_sync
+        )
         return should_commit
 
 

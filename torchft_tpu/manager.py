@@ -41,6 +41,8 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, StoreServer
 from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_SUM
 from torchft_tpu.parallel.work import Work, completed_work
+from torchft_tpu.utils import metrics as metrics
+from torchft_tpu.utils import tracing as tracing
 from torchft_tpu.utils.logging import ReplicaLogger, log_event
 from torchft_tpu.utils.rwlock import RWLock
 
@@ -175,9 +177,15 @@ class Manager:
         # Wall-clock spent in each protocol phase since the last
         # ``pop_phase_times`` — the FT-overhead observability surface
         # (the reference only exposes these as profiler spans,
-        # torchft/manager.py:385,591,790).
+        # torchft/manager.py:385,591,790).  ``_record_phase`` additionally
+        # feeds the non-destructive telemetry layer: the
+        # torchft_quorum_duration_seconds histogram and, when a tracer is
+        # installed, one child span per phase under the round's root span.
         self._phase_acc: Dict[str, float] = {}
         self._phase_lock = threading.Lock()
+        # (trace_id, root_span_id, start_ns) of the in-flight quorum round,
+        # None when no tracer is installed or no round is open.
+        self._round_trace: "Optional[tuple[str, str, int]]" = None
 
         # --- coordination wiring (reference manager.py:277-325) -----------
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
@@ -248,6 +256,26 @@ class Manager:
         store.close()
 
         self._logger = ReplicaLogger(self, self._replica_id, self._group_rank)
+        # Opt-in per-manager scrape endpoint (TORCHFT_METRICS_PORT);
+        # process-wide singleton, so multi-manager tests don't fight.
+        metrics.maybe_serve_from_env()
+        # Bound metric children cached per replica: the labels() lookup is
+        # ~9 us and _record_phase sits on the step hot path — caching keeps
+        # the telemetry cost per phase at the observe() itself (~1 us).
+        self._phase_hist: Dict[str, Any] = {}
+        self._m_allreduces = metrics.ALLREDUCES.labels(
+            replica_id=self._replica_id
+        )
+        self._m_commits = {
+            result: metrics.COMMITS.labels(
+                replica_id=self._replica_id, result=result
+            )
+            for result in ("success", "failure")
+        }
+        self._m_step = metrics.STEP.labels(replica_id=self._replica_id)
+        self._m_participants = metrics.PARTICIPANTS.labels(
+            replica_id=self._replica_id
+        )
 
     @staticmethod
     def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
@@ -320,6 +348,13 @@ class Manager:
         self._errored = None
         self._healing = False
 
+        tracer = tracing.get_tracer()
+        self._round_trace = (
+            (tracing.new_trace_id(), tracing.new_span_id(), time.time_ns())
+            if tracer is not None
+            else None
+        )
+
         self._quorum_future = self._executor.submit(
             self._async_quorum,
             allow_heal=allow_heal,
@@ -388,6 +423,7 @@ class Manager:
                 self._participating_replica_rank = None
 
         if quorum.quorum_id != self._quorum_id:
+            metrics.QUORUM_CHANGES.labels(replica_id=self._replica_id).inc()
             log_event(
                 "quorum",
                 "quorum changed",
@@ -414,6 +450,16 @@ class Manager:
                     )
                 self._record_phase("pg_configure", time.perf_counter() - t_cfg)
                 self._quorum_id = quorum.quorum_id
+                log_event(
+                    "reconfigure",
+                    "pg reconfigured",
+                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    replica_id=self._replica_id,
+                    rank=self._group_rank,
+                    quorum_id=quorum.quorum_id,
+                    step=quorum.max_step,
+                    replica_world_size=quorum.replica_world_size,
+                )
             except Exception as e:  # noqa: BLE001 - captured into the protocol
                 self._logger.exception(f"got exception in pg configure: {e}")
                 self.report_error(e)
@@ -438,6 +484,20 @@ class Manager:
                         timeout=self._timeout,
                     )
                 self._record_phase("heal_send", time.perf_counter() - t_send)
+                metrics.HEALS.labels(
+                    replica_id=self._replica_id, direction="send"
+                ).inc()
+                log_event(
+                    "heal",
+                    "sent checkpoint to healing peers",
+                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    replica_id=self._replica_id,
+                    rank=self._group_rank,
+                    quorum_id=quorum.quorum_id,
+                    step=quorum.max_step,
+                    direction="send",
+                    dst_ranks=quorum.recover_dst_replica_ranks,
+                )
 
             if quorum.heal:
                 self._healing = True
@@ -471,6 +531,20 @@ class Manager:
                 # to make reasoning (and tests) simpler
                 self._step = quorum.max_step
                 self._record_phase("heal_recv", time.perf_counter() - t_recv)
+                metrics.HEALS.labels(
+                    replica_id=self._replica_id, direction="recv"
+                ).inc()
+                log_event(
+                    "heal",
+                    "received checkpoint from peer",
+                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    replica_id=self._replica_id,
+                    rank=self._group_rank,
+                    quorum_id=quorum.quorum_id,
+                    step=quorum.max_step,
+                    direction="recv",
+                    src_rank=quorum.recover_src_replica_rank,
+                )
         except Exception as e:  # noqa: BLE001 - captured into the protocol
             self._logger.exception(f"got exception in recovery: {e}")
             self.report_error(e)
@@ -553,6 +627,7 @@ class Manager:
         else:
             pg_reduce_op = reduce_op
 
+        self._m_allreduces.inc()
         try:
             t_submit = time.perf_counter()
             if should_quantize:
@@ -612,6 +687,7 @@ class Manager:
         """Latch an async error; the current step will not be committed
         (reference manager.py:469-482)."""
         self._errored = e
+        metrics.ERRORS.labels(replica_id=self._replica_id).inc()
         log_event(
             "error",
             str(e),
@@ -656,6 +732,8 @@ class Manager:
             timeout=_to_sec(timeout, self._timeout),
         )
         self._record_phase("commit", time.perf_counter() - t_commit)
+        self._m_commits["success" if should_commit else "failure"].inc()
+        self._m_participants.set(self.num_participants())
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas}, "
             f"errored={self._errored}"
@@ -689,6 +767,30 @@ class Manager:
                 )
                 self._logger.exception(msg)
                 raise RuntimeError(msg)
+        self._m_step.set(self._step)
+
+        # Close the quorum round's root span (children were emitted per
+        # phase from _record_phase); trace joins to the structured events
+        # on the shared step/quorum_id attributes.
+        tracer = tracing.get_tracer()
+        rt, self._round_trace = self._round_trace, None
+        if tracer is not None and rt is not None:
+            trace_id, root_span_id, start_ns = rt
+            tracer.export_span(
+                name="quorum_round",
+                trace_id=trace_id,
+                span_id=root_span_id,
+                start_ns=start_ns,
+                end_ns=time.time_ns(),
+                attributes={
+                    "replica_id": self._replica_id,
+                    "rank": self._group_rank,
+                    "quorum_id": self._quorum_id,
+                    "step": self._step,
+                    "commit_result": should_commit,
+                },
+                ok=self._errored is None,
+            )
         return should_commit
 
     # ------------------------------------------------------------------
@@ -696,11 +798,56 @@ class Manager:
     # ------------------------------------------------------------------
 
     def _record_phase(self, name: str, dt: float) -> None:
+        """Record one phase timing into every observability surface: the
+        destructive accumulator (bench), the non-destructive
+        torchft_quorum_duration_seconds histogram (scrapers), and — when a
+        tracer is installed — a child span under the round's root span.
+        Called from the caller thread AND the async quorum thread."""
         with self._phase_lock:
             self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dt
+        child = self._phase_hist.get(name)
+        if child is None:
+            # benign race: concurrent creators both resolve to the same
+            # underlying child (labels() is keyed), last write wins
+            child = metrics.QUORUM_DURATION.labels(
+                replica_id=self._replica_id, phase=name
+            )
+            self._phase_hist[name] = child
+        child.observe(dt)
+        tracer = tracing.get_tracer()
+        rt = self._round_trace
+        if tracer is not None and rt is not None:
+            end_ns = time.time_ns()
+            tracer.export_span(
+                name=name,
+                trace_id=rt[0],
+                parent_span_id=rt[1],
+                start_ns=end_ns - int(dt * 1e9),
+                end_ns=end_ns,
+                attributes={
+                    "replica_id": self._replica_id,
+                    "quorum_id": self._quorum_id,
+                    "step": self._step,
+                },
+            )
+
+    def phase_times(self) -> "Dict[str, float]":
+        """Non-destructive snapshot of the per-phase accumulator (same keys
+        as :meth:`pop_phase_times`, which documents them).  Safe for any
+        number of concurrent consumers — scrapers should prefer the
+        ``torchft_quorum_duration_seconds`` histogram, which this same data
+        also feeds."""
+        with self._phase_lock:
+            return dict(self._phase_acc)
 
     def pop_phase_times(self) -> "Dict[str, float]":
         """Wall-clock seconds spent per protocol phase since the last call.
+
+        .. deprecated:: destructive single-consumer drain — two consumers
+           (e.g. bench + a scraper) corrupt each other's view.  New code
+           should read :meth:`phase_times` (non-destructive snapshot) or
+           the ``torchft_quorum_duration_seconds`` histogram; this method
+           remains for bench.py's per-step reset semantics.
 
         Caller-thread keys: ``quorum_wait`` (blocked waiting for the async
         quorum work — the part NOT hidden behind the forward pass; includes

@@ -49,7 +49,21 @@ def initialize_multihost(
     if platform is not None:
         jax.config.update("jax_platforms", platform)
     if cpu_devices_per_process is not None:
-        jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+        try:
+            jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+        except AttributeError:
+            # jax < 0.5 has no jax_num_cpu_devices; the XLA flag is the
+            # pre-0.5 spelling and must land before backend init (we are
+            # before jax.distributed.initialize, so it does)
+            import os
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count="
+                    f"{cpu_devices_per_process}"
+                ).strip()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -48,6 +48,7 @@ import numpy as np
 
 from torchft_tpu.coordination import StoreClient
 from torchft_tpu.parallel.work import Work, completed_work, failed_work
+from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -409,6 +410,7 @@ class ProcessGroupTCP(ProcessGroup):
         if world_size == 1:
             self._peers = {}
             self._start_worker(gen)
+            _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
             return
 
         addr, _, prefix = store_addr.partition("/")
@@ -457,6 +459,7 @@ class ProcessGroupTCP(ProcessGroup):
                 peers[peer_rank] = _PeerConn(sock, peer_rank)
             self._peers = peers
             self._start_worker(gen)
+            _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
         except Exception:
             self._teardown()
             raise
@@ -519,6 +522,7 @@ class ProcessGroupTCP(ProcessGroup):
 
     def abort(self) -> None:
         self._dump_flight("process group aborted")
+        _metrics.PG_ABORTS.labels(transport="tcp").inc()
         with self._lock:
             self._aborted = True
             if self._errored is None:
@@ -1655,6 +1659,7 @@ class ProcessGroupBaby(ProcessGroup):
             daemon=True,
         )
         self._reader.start()
+        _metrics.PG_RECONFIGURES.labels(transport="baby").inc()
 
     def _recv_ack(self, pipe: Any) -> Any:
         try:
@@ -1817,6 +1822,7 @@ class ProcessGroupBaby(ProcessGroup):
     # -- ProcessGroup API --------------------------------------------------
 
     def abort(self) -> None:
+        _metrics.PG_ABORTS.labels(transport="baby").inc()
         self._kill_worker()  # latches _PGAborted via _fail_all
 
     def errored(self) -> Optional[Exception]:

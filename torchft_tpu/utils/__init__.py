@@ -4,14 +4,34 @@ from torchft_tpu.utils.futures import (
     future_wait,
 )
 from torchft_tpu.utils.logging import ReplicaLogger, log_event, recent_events
+from torchft_tpu.utils.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsHTTPServer,
+    counter,
+    gauge,
+    histogram,
+    parse_text_exposition,
+)
 from torchft_tpu.utils.rwlock import RWLock
 
 __all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsHTTPServer",
+    "REGISTRY",
     "RWLock",
     "context_timeout",
+    "counter",
     "future_timeout",
     "future_wait",
+    "gauge",
+    "histogram",
     "log_event",
+    "parse_text_exposition",
     "recent_events",
     "ReplicaLogger",
 ]

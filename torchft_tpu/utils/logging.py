@@ -41,6 +41,10 @@ _LOGGERS = {
     "commit": logging.getLogger("torchft_commits"),
     "error": logging.getLogger("torchft_errors"),
     "abort": logging.getLogger("torchft_aborts"),
+    # telemetry-layer kinds: live checkpoint transfer and PG membership
+    # reconfiguration (mirror _SEVERITY in utils/otel.py when extending)
+    "heal": logging.getLogger("torchft_heals"),
+    "reconfigure": logging.getLogger("torchft_reconfigures"),
 }
 
 _lock = threading.Lock()
@@ -201,7 +205,7 @@ def _env_jsonl_exporter() -> "Optional[JSONLFileExporter]":
 
 def log_event(kind: str, message: str, **extra: Any) -> None:
     """Record a structured protocol event
-    (kind in {quorum, commit, error, abort})."""
+    (kind in {quorum, commit, error, abort, heal, reconfigure})."""
     if kind not in _LOGGERS:
         raise ValueError(f"unknown event kind {kind!r}, expected one of {sorted(_LOGGERS)}")
     record = {"ts": time.time(), "kind": kind, "message": message, **extra}

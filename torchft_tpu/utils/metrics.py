@@ -1,0 +1,909 @@
+"""Unified metrics layer: registry, Prometheus exposition, OTLP export.
+
+The structured-event pipeline (utils/logging.py) answers "what happened";
+this module answers "how often / how long / how many bytes" — the live,
+NON-destructive observability surface an elastic trainer needs (contrast
+``Manager.pop_phase_times``, a single-consumer drain).  Reliable-collective
+systems (Prime PCCL, PAPERS.md) treat per-phase counters as first-class
+diagnostics; same stance here.
+
+Three building blocks, stdlib only (this environment ships no
+prometheus_client / opentelemetry SDK):
+
+- a thread-safe :class:`Registry` of :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` families with labeled children
+  (``.labels(replica_id=..., phase=...)``).  Counter and Histogram
+  families additionally maintain an **unlabeled aggregate series** (the
+  sum over all children) so a fresh process — or a scraper that wants the
+  cluster-wide total without PromQL — always sees every family's series,
+  zero-valued before first use;
+- Prometheus text exposition (:meth:`Registry.render`, text format 0.0.4
+  with full label escaping) served by the lighthouse dashboard port
+  (native ``GET /metrics``, see coordination.py), by the opt-in
+  per-manager :class:`MetricsHTTPServer` (``TORCHFT_METRICS_PORT``), and
+  parseable back via :func:`parse_text_exposition` (tests + the tier-1
+  smoke check);
+- an OTLP/HTTP **metrics** exporter (``POST /v1/metrics``, JSON encoding,
+  cumulative temporality) in the style of ``utils/otel.py``'s log
+  exporter, gated on the same ``TORCHFT_USE_OTEL`` env.
+
+Failure policy matches every sink in this framework: a dead collector or
+a wedged scraper never takes down training.
+
+Every torchft-exported instrument is defined at the bottom of this module
+(one source of truth for the docs table in docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import atexit
+import bisect
+import json
+import logging
+import os
+import re
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Fixed exponential latency buckets: 1 ms .. ~65 s doubling, suitable for
+# everything from a sub-ms fast quorum to a full heal over a slow link.
+DEFAULT_BUCKETS: "Tuple[float, ...]" = tuple(0.001 * 2**i for i in range(17))
+
+# Process start, the OTLP cumulative-sum start timestamp.
+_START_NS = time.time_ns()
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample-value formatting (ints without the trailing .0)."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(items: "Sequence[Tuple[str, str]]") -> str:
+    if not items:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in items
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One metric family: name, help, label names, children keyed by label
+    values.  All mutation goes through ``self._lock`` — increments arrive
+    from the training loop, the async quorum thread, PG worker threads and
+    checkpoint server threads concurrently."""
+
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: "Sequence[str]" = (),
+        registry: "Optional[Registry]" = None,
+    ) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln == "le":
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: "Dict[Tuple[str, ...], Any]" = {}
+        self._default = self._new_state()
+        if registry is None:
+            registry = REGISTRY
+        registry.register(self)
+
+    # subclass hooks ------------------------------------------------------
+    def _new_state(self) -> Any:
+        raise NotImplementedError
+
+    def labels(self, **labelvalues: Any) -> "_BoundChild":
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}"
+            )
+        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_state()
+                self._children[key] = child
+        return _BoundChild(self, child)
+
+    def _series(self) -> "List[Tuple[Tuple[Tuple[str, str], ...], Any]]":
+        """Snapshot [(label_items, state_copy)] — default series first.
+        The default (unlabeled) series renders for counters/histograms
+        always, and for gauges only when the family is unlabeled (a sum
+        of last-set gauge values is not a meaningful gauge)."""
+        with self._lock:
+            out: "List[Tuple[Tuple[Tuple[str, str], ...], Any]]" = []
+            if not self.labelnames or self.kind != "gauge":
+                out.append(((), self._copy_state(self._default)))
+            for key, child in self._children.items():
+                out.append(
+                    (tuple(zip(self.labelnames, key)), self._copy_state(child))
+                )
+            return out
+
+    def _copy_state(self, state: Any) -> Any:
+        return state
+
+
+class _BoundChild:
+    """A (family, child-state) pair returned by ``labels()``; updates fan
+    into the child AND the family's unlabeled aggregate (counters and
+    histograms — see module docstring)."""
+
+    __slots__ = ("_metric", "_state")
+
+    def __init__(self, metric: _Metric, state: Any) -> None:
+        self._metric = metric
+        self._state = state
+
+    def inc(self, amount: float = 1) -> None:
+        self._metric._inc_state(self._state, amount, aggregate=True)
+
+    def dec(self, amount: float = 1) -> None:
+        self.inc(-amount)
+
+    def set(self, value: float) -> None:
+        self._metric._set_state(self._state, value)
+
+    def observe(self, value: float) -> None:
+        self._metric._observe_state(self._state, value, aggregate=True)
+
+    def get(self) -> Any:
+        return self._metric._read_state(self._state)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_state(self) -> "List[float]":
+        return [0.0]
+
+    def inc(self, amount: float = 1) -> None:
+        self._inc_state(self._default, amount, aggregate=False)
+
+    def get(self) -> float:
+        return self._read_state(self._default)
+
+    def _inc_state(self, state: "List[float]", amount: float, aggregate: bool) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            state[0] += amount
+            if aggregate and state is not self._default:
+                self._default[0] += amount
+
+    def _set_state(self, state: Any, value: float) -> None:
+        raise TypeError("set() is not valid on a counter")
+
+    def _observe_state(self, state: Any, value: float, aggregate: bool) -> None:
+        raise TypeError("observe() is not valid on a counter")
+
+    def _read_state(self, state: "List[float]") -> float:
+        with self._lock:
+            return state[0]
+
+    def _copy_state(self, state: "List[float]") -> float:
+        return state[0]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_state(self) -> "List[float]":
+        return [0.0]
+
+    def set(self, value: float) -> None:
+        self._set_state(self._default, value)
+
+    def inc(self, amount: float = 1) -> None:
+        self._inc_state(self._default, amount, aggregate=False)
+
+    def dec(self, amount: float = 1) -> None:
+        self.inc(-amount)
+
+    def get(self) -> float:
+        return self._read_state(self._default)
+
+    def _inc_state(self, state: "List[float]", amount: float, aggregate: bool) -> None:
+        with self._lock:
+            state[0] += amount
+
+    def _set_state(self, state: "List[float]", value: float) -> None:
+        with self._lock:
+            state[0] = float(value)
+
+    def _observe_state(self, state: Any, value: float, aggregate: bool) -> None:
+        raise TypeError("observe() is not valid on a gauge")
+
+    def _read_state(self, state: "List[float]") -> float:
+        with self._lock:
+            return state[0]
+
+    def _copy_state(self, state: "List[float]") -> float:
+        return state[0]
+
+
+class _HistState:
+    __slots__ = ("buckets", "sum", "count")
+
+    def __init__(self, nbuckets: int) -> None:
+        self.buckets = [0] * nbuckets  # per-bucket counts (not cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: "Sequence[str]" = (),
+        buckets: "Optional[Sequence[float]]" = None,
+        registry: "Optional[Registry]" = None,
+    ) -> None:
+        bounds = tuple(sorted(DEFAULT_BUCKETS if buckets is None else buckets))
+        if not bounds or any(
+            b >= n for b, n in zip(bounds, bounds[1:])
+        ):
+            raise ValueError("histogram buckets must be strictly increasing")
+        self.bounds = bounds  # upper bounds, +Inf implicit
+        super().__init__(name, help, labelnames, registry)
+
+    def _new_state(self) -> _HistState:
+        return _HistState(len(self.bounds) + 1)
+
+    def observe(self, value: float) -> None:
+        self._observe_state(self._default, value, aggregate=False)
+
+    def get(self) -> "Dict[str, Any]":
+        return self._read_state(self._default)
+
+    def _observe_state(self, state: _HistState, value: float, aggregate: bool) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            for s in (
+                (state, self._default)
+                if aggregate and state is not self._default
+                else (state,)
+            ):
+                s.buckets[idx] += 1
+                s.sum += value
+                s.count += 1
+
+    def _inc_state(self, state: Any, amount: float, aggregate: bool) -> None:
+        raise TypeError("inc() is not valid on a histogram")
+
+    def _set_state(self, state: Any, value: float) -> None:
+        raise TypeError("set() is not valid on a histogram")
+
+    def _read_state(self, state: _HistState) -> "Dict[str, Any]":
+        with self._lock:
+            return self._copy_state(state)
+
+    def _copy_state(self, state: _HistState) -> "Dict[str, Any]":
+        # cumulative bucket counts, Prometheus-style
+        cum: "List[int]" = []
+        total = 0
+        for c in state.buckets:
+            total += c
+            cum.append(total)
+        return {
+            "bounds": self.bounds,
+            "buckets": cum,  # len(bounds)+1, last == count (+Inf)
+            "sum": state.sum,
+            "count": state.count,
+        }
+
+
+class Registry:
+    """Named collection of metric families; renders and snapshots them."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and existing is not metric:
+                raise ValueError(f"metric {metric.name!r} already registered")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str) -> "Optional[_Metric]":
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> "List[_Metric]":
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every family."""
+        lines: "List[str]" = []
+        for m in self.metrics():
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for label_items, value in m._series():
+                if m.kind == "histogram":
+                    for bound, cum in zip(
+                        list(value["bounds"]) + [float("inf")], value["buckets"]
+                    ):
+                        items = label_items + (("le", _fmt_value(bound)),)
+                        lines.append(
+                            f"{m.name}_bucket{_render_labels(items)} {cum}"
+                        )
+                    lines.append(
+                        f"{m.name}_sum{_render_labels(label_items)} "
+                        f"{_fmt_value(value['sum'])}"
+                    )
+                    lines.append(
+                        f"{m.name}_count{_render_labels(label_items)} "
+                        f"{value['count']}"
+                    )
+                else:
+                    lines.append(
+                        f"{m.name}{_render_labels(label_items)} "
+                        f"{_fmt_value(value)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+    def collect(self) -> "List[Dict[str, Any]]":
+        """Structured snapshot for the OTLP encoder (and tests)."""
+        out: "List[Dict[str, Any]]" = []
+        for m in self.metrics():
+            out.append(
+                {
+                    "name": m.name,
+                    "help": m.help,
+                    "kind": m.kind,
+                    "series": [
+                        {"labels": dict(items), "value": value}
+                        for items, value in m._series()
+                    ],
+                }
+            )
+        return out
+
+
+REGISTRY = Registry()
+
+
+def _get_or_create(
+    cls: type, name: str, help: str, labelnames: "Sequence[str]", registry: "Optional[Registry]", **kw: Any
+) -> Any:
+    reg = registry if registry is not None else REGISTRY
+    existing = reg.get(name)
+    if existing is not None:
+        if not isinstance(existing, cls) or existing.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name!r} already registered with a different "
+                f"kind/labels"
+            )
+        return existing
+    return cls(name, help, labelnames, registry=reg, **kw)
+
+
+def counter(
+    name: str, help: str, labelnames: "Sequence[str]" = (), registry: "Optional[Registry]" = None
+) -> Counter:
+    """Get-or-create a :class:`Counter` in ``registry`` (default global)."""
+    return _get_or_create(Counter, name, help, labelnames, registry)
+
+
+def gauge(
+    name: str, help: str, labelnames: "Sequence[str]" = (), registry: "Optional[Registry]" = None
+) -> Gauge:
+    """Get-or-create a :class:`Gauge` in ``registry`` (default global)."""
+    return _get_or_create(Gauge, name, help, labelnames, registry)
+
+
+def histogram(
+    name: str,
+    help: str,
+    labelnames: "Sequence[str]" = (),
+    buckets: "Optional[Sequence[float]]" = None,
+    registry: "Optional[Registry]" = None,
+) -> Histogram:
+    """Get-or-create a :class:`Histogram` in ``registry`` (default global)."""
+    return _get_or_create(
+        Histogram, name, help, labelnames, registry, buckets=buckets
+    )
+
+
+# ---------------------------------------------------------------------------
+# text-exposition parser (round-trip tests + the tier-1 /metrics smoke check)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)(?: (?P<ts>-?[0-9]+))?$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+
+
+def _unescape_label_value(v: str) -> str:
+    # single left-to-right scan: sequential str.replace would corrupt a
+    # literal backslash followed by 'n' ('a\\nb' escapes to 'a\\\\nb'; the
+    # naive '\\n'-first replace turns that into backslash+newline)
+    out: "List[str]" = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v) and v[i + 1] in ('n', '\\', '"'):
+            out.append({"n": "\n", "\\": "\\", '"': '"'}[v[i + 1]])
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_value(v: str) -> float:
+    if v == "+Inf":
+        return float("inf")
+    if v == "-Inf":
+        return float("-inf")
+    return float(v)  # raises ValueError on garbage — the validator's job
+
+
+def parse_text_exposition(text: str) -> "Dict[str, Dict[str, Any]]":
+    """Strict parser for the Prometheus text format subset this module
+    (and the native lighthouse endpoint) emits.
+
+    Returns ``{family: {"type": ..., "help": ..., "samples":
+    {(sample_name, ((label, value), ...)): float}}}``; raises
+    ``ValueError`` on any malformed line — the tier-1 smoke check runs the
+    whole scrape through this to catch label-escaping regressions.
+    """
+    families: "Dict[str, Dict[str, Any]]" = {}
+
+    def family_for(sample_name: str) -> "Dict[str, Any]":
+        for suffix in ("_bucket", "_sum", "_count", ""):
+            base = sample_name[: -len(suffix)] if suffix else sample_name
+            if base in families and (
+                not suffix or families[base]["type"] == "histogram"
+            ):
+                return families[base]
+        return families.setdefault(
+            sample_name, {"type": "untyped", "help": "", "samples": {}}
+        )
+
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP ") :]
+            name, _, help_text = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise ValueError(f"line {lineno}: bad HELP name {name!r}")
+            families.setdefault(
+                name, {"type": "untyped", "help": "", "samples": {}}
+            )["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE ") :].split(" ")
+            if len(parts) != 2 or not _NAME_RE.match(parts[0]) or parts[
+                1
+            ] not in ("counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE line {line!r}")
+            families.setdefault(
+                parts[0], {"type": "untyped", "help": "", "samples": {}}
+            )["type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        labels: "List[Tuple[str, str]]" = []
+        raw = m.group("labels")
+        if raw is not None:
+            pos = 0
+            while pos < len(raw):
+                lm = _LABEL_PAIR_RE.match(raw, pos)
+                if not lm:
+                    raise ValueError(
+                        f"line {lineno}: malformed labels {raw!r}"
+                    )
+                labels.append(
+                    (lm.group("name"), _unescape_label_value(lm.group("value")))
+                )
+                pos = lm.end()
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: bad value in {line!r}") from e
+        fam = family_for(m.group("name"))
+        key = (m.group("name"), tuple(labels))
+        if key in fam["samples"]:
+            raise ValueError(f"line {lineno}: duplicate sample {key!r}")
+        fam["samples"][key] = value
+    return families
+
+
+# ---------------------------------------------------------------------------
+# per-process HTTP scrape server (the per-manager surface)
+# ---------------------------------------------------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    registry: Registry  # injected per-server
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # quiet
+        logger.debug("metrics http: " + fmt, *args)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path.split("?")[0] not in ("/metrics", "/"):
+            self.send_error(404, "try /metrics")
+            return
+        try:
+            body = self.registry.render().encode()
+        except Exception as e:  # noqa: BLE001 - a scrape never kills training
+            logger.warning("metrics render failed: %s", e)
+            self.send_error(500, "render failed")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsHTTPServer:
+    """Tiny threaded scrape endpoint: ``GET /metrics`` on ``port``.
+
+    ``port=0`` picks an ephemeral port (tests).  Serving runs on a daemon
+    thread; ``close()`` stops it.
+    """
+
+    def __init__(self, port: int = 0, registry: "Optional[Registry]" = None) -> None:
+        handler = type(
+            "_BoundMetricsHandler",
+            (_MetricsHandler,),
+            {"registry": registry if registry is not None else REGISTRY},
+        )
+        self._server = ThreadingHTTPServer(("", port), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=lambda: self._server.serve_forever(poll_interval=0.1),
+            name="torchft_metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self.port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_env_server: "Optional[MetricsHTTPServer]" = None
+_env_server_lock = threading.Lock()
+
+
+def maybe_serve_from_env() -> "Optional[MetricsHTTPServer]":
+    """Start the process-wide scrape server when ``TORCHFT_METRICS_PORT``
+    is set (idempotent — every Manager in the process calls this; the
+    first one wins).  Port conflicts are logged, never raised: a taken
+    metrics port must not take down training."""
+    global _env_server
+    port = os.environ.get("TORCHFT_METRICS_PORT")
+    if not port:
+        return None
+    with _env_server_lock:
+        if _env_server is not None:
+            return _env_server
+        try:
+            _env_server = MetricsHTTPServer(int(port))
+        except (OSError, ValueError) as e:
+            logger.warning(
+                "could not start metrics server on port %s: %s", port, e
+            )
+            return None
+        return _env_server
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP metrics exporter (POST /v1/metrics, JSON encoding)
+# ---------------------------------------------------------------------------
+
+
+class OTLPMetricsExporter:
+    """Periodic cumulative-snapshot push of a registry to an OTLP/HTTP
+    collector, in the style of ``utils/otel.py``'s log exporter: daemon
+    flush thread, same resource-attribute loading, same failure policy
+    (failed posts drop with a warning and a ``dropped`` counter)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        registry: "Optional[Registry]" = None,
+        resource_attributes: "Optional[Dict[str, Any]]" = None,
+        service_name: str = "torchft_tpu",
+        interval_s: float = 10.0,
+        timeout_s: float = 5.0,
+    ) -> None:
+        from torchft_tpu.utils.otel import _kv_list, load_resource_attributes
+
+        self._endpoint = endpoint.rstrip("/")
+        if not self._endpoint.endswith("/v1/metrics"):
+            self._endpoint += "/v1/metrics"
+        self._registry = registry if registry is not None else REGISTRY
+        if resource_attributes is None:
+            resource_attributes = load_resource_attributes(service_name)
+        attrs = {"service.name": service_name, **resource_attributes}
+        self._resource = {"attributes": _kv_list(attrs)}
+        self._interval_s = interval_s
+        self._timeout_s = timeout_s
+        self._stop = threading.Event()
+        self.exported = 0  # successful posts
+        self.dropped = 0  # failed posts
+        self._thread = threading.Thread(
+            target=self._run, name="otlp_metrics_exporter", daemon=True
+        )
+        self._thread.start()
+        atexit.register(self._atexit_flush)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self.flush()
+
+    def flush(self) -> bool:
+        """Encode + post the current cumulative snapshot; True on 2xx."""
+        from torchft_tpu.utils.otel import post_otlp
+
+        try:
+            post_otlp(self._endpoint, self.encode(), self._timeout_s)
+            self.exported += 1
+            return True
+        except Exception as e:  # noqa: BLE001 - a sink never kills training
+            self.dropped += 1
+            logger.warning("OTLP metrics export failed: %s", e)
+            return False
+
+    def encode(self) -> bytes:
+        """OTLP JSON ``resourceMetrics`` document for the current snapshot
+        (cumulative temporality; counters are monotonic sums)."""
+        from torchft_tpu.utils.otel import _kv_list
+
+        now = str(time.time_ns())
+        start = str(_START_NS)
+        metrics_out: "List[Dict[str, Any]]" = []
+        for fam in self._registry.collect():
+            entry: "Dict[str, Any]" = {
+                "name": fam["name"],
+                "description": fam["help"],
+            }
+            if fam["kind"] == "histogram":
+                points = []
+                for s in fam["series"]:
+                    v = s["value"]
+                    # OTLP bucketCounts are per-bucket, not cumulative
+                    cum = v["buckets"]
+                    per = [c - p for c, p in zip(cum, [0] + cum[:-1])]
+                    points.append(
+                        {
+                            "attributes": _kv_list(s["labels"]),
+                            "startTimeUnixNano": start,
+                            "timeUnixNano": now,
+                            "count": str(v["count"]),
+                            "sum": v["sum"],
+                            "bucketCounts": [str(c) for c in per],
+                            "explicitBounds": list(v["bounds"]),
+                        }
+                    )
+                entry["histogram"] = {
+                    "dataPoints": points,
+                    "aggregationTemporality": 2,  # CUMULATIVE
+                }
+            else:
+                points = [
+                    {
+                        "attributes": _kv_list(s["labels"]),
+                        "startTimeUnixNano": start,
+                        "timeUnixNano": now,
+                        "asDouble": float(s["value"]),
+                    }
+                    for s in fam["series"]
+                ]
+                if fam["kind"] == "counter":
+                    entry["sum"] = {
+                        "dataPoints": points,
+                        "aggregationTemporality": 2,
+                        "isMonotonic": True,
+                    }
+                else:
+                    entry["gauge"] = {"dataPoints": points}
+            metrics_out.append(entry)
+        doc = {
+            "resourceMetrics": [
+                {
+                    "resource": self._resource,
+                    "scopeMetrics": [
+                        {
+                            "scope": {"name": "torchft_tpu"},
+                            "metrics": metrics_out,
+                        }
+                    ],
+                }
+            ]
+        }
+        return json.dumps(doc, default=str).encode()
+
+    def _atexit_flush(self) -> None:
+        if not self._stop.is_set():
+            self.flush()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            atexit.unregister(self._atexit_flush)
+        except Exception:  # noqa: BLE001 - interpreter-state dependent
+            pass
+        self._thread.join(timeout=self._timeout_s + 1.0)
+
+
+_env_metrics_exporter: "Optional[OTLPMetricsExporter]" = None
+
+
+def maybe_export_from_env() -> "Optional[OTLPMetricsExporter]":
+    """Start the OTLP metrics push when ``TORCHFT_USE_OTEL`` is truthy
+    (same gate and endpoint resolution as the log exporter:
+    ``OTEL_EXPORTER_OTLP_METRICS_ENDPOINT``, else
+    ``OTEL_EXPORTER_OTLP_ENDPOINT``, else the OTLP default)."""
+    global _env_metrics_exporter
+    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
+        return None
+    if _env_metrics_exporter is not None:
+        return _env_metrics_exporter
+    endpoint = (
+        os.environ.get("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT")
+        or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        or "http://localhost:4318"
+    )
+    try:
+        interval = float(
+            os.environ.get("TORCHFT_METRICS_EXPORT_INTERVAL_S", 10.0)
+        )
+    except ValueError:
+        # runs at `import torchft_tpu`: a typo'd env var must degrade to
+        # the default, never crash training
+        logger.warning(
+            "invalid TORCHFT_METRICS_EXPORT_INTERVAL_S=%r, using 10s",
+            os.environ.get("TORCHFT_METRICS_EXPORT_INTERVAL_S"),
+        )
+        interval = 10.0
+    _env_metrics_exporter = OTLPMetricsExporter(endpoint, interval_s=interval)
+    return _env_metrics_exporter
+
+
+# ---------------------------------------------------------------------------
+# torchft instruments — the one place every exported metric is defined
+# (docs/observability.md carries the rendered table; keep the two in sync)
+# ---------------------------------------------------------------------------
+
+QUORUM_DURATION = histogram(
+    "torchft_quorum_duration_seconds",
+    "Wall-clock seconds per FT protocol phase (quorum_wait/quorum_rpc/"
+    "pg_configure/heal_send/heal_recv/host_sync/ring/commit)",
+    ("replica_id", "phase"),
+)
+QUORUM_CHANGES = counter(
+    "torchft_quorum_changes_total",
+    "Quorum membership changes observed (PG reconfigures triggered)",
+    ("replica_id",),
+)
+COMMITS = counter(
+    "torchft_commits_total",
+    "should_commit votes by outcome",
+    ("replica_id", "result"),
+)
+ERRORS = counter(
+    "torchft_errors_total",
+    "Errors latched into the step protocol (report_error)",
+    ("replica_id",),
+)
+HEALS = counter(
+    "torchft_heals_total",
+    "Live checkpoint transfers by direction (send=to peers, recv=healing)",
+    ("replica_id", "direction"),
+)
+ALLREDUCES = counter(
+    "torchft_allreduce_total",
+    "Fault-tolerant allreduce submissions",
+    ("replica_id",),
+)
+STEP = gauge(
+    "torchft_step",
+    "Current committed step of this replica",
+    ("replica_id",),
+)
+PARTICIPANTS = gauge(
+    "torchft_participants",
+    "Live participant count of the current quorum",
+    ("replica_id",),
+)
+PG_RECONFIGURES = counter(
+    "torchft_pg_reconfigures_total",
+    "Process-group configure() completions by transport",
+    ("transport",),
+)
+PG_ABORTS = counter(
+    "torchft_pg_aborts_total",
+    "Process-group abort() calls by transport",
+    ("transport",),
+)
+CHECKPOINT_BYTES = counter(
+    "torchft_checkpoint_bytes_total",
+    "Checkpoint payload bytes streamed by transport and direction",
+    ("transport", "direction"),
+)
+CHECKPOINT_DURATION = histogram(
+    "torchft_checkpoint_duration_seconds",
+    "Checkpoint send/recv wall-clock seconds by transport and direction",
+    ("transport", "direction"),
+)
+CHECKPOINT_RETRIES = counter(
+    "torchft_checkpoint_retries_total",
+    "Checkpoint fetch retries (sender not yet staged / transient errors)",
+    ("transport",),
+)
+DILOCO_SYNC_SECONDS = gauge(
+    "torchft_diloco_last_sync_seconds",
+    "Duration of the most recent DiLoCo fragment sync (perform_sync)",
+    ("fragment",),
+)
+DILOCO_WIRE_BYTES = gauge(
+    "torchft_diloco_last_wire_bytes",
+    "Wire bytes of the most recent DiLoCo fragment allreduce (quantized "
+    "actual when available, else payload bytes)",
+    ("fragment",),
+)

@@ -34,6 +34,7 @@ warning and a ``dropped`` counter for tests/ops to inspect.
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -49,10 +50,14 @@ TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON = "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON"
 
 _SEVERITY = {
     # event kind -> (OTLP severityNumber, severityText)
+    # (mirror _LOGGERS in utils/logging.py when extending: an unmapped
+    # kind silently exports as INFO, which buries errors)
     "quorum": (9, "INFO"),
     "commit": (9, "INFO"),
     "error": (17, "ERROR"),
     "abort": (17, "ERROR"),
+    "heal": (9, "INFO"),
+    "reconfigure": (9, "INFO"),
 }
 
 
@@ -91,13 +96,36 @@ def load_resource_attributes(name: str = "torchft_tpu") -> "Dict[str, Any]":
         return {}
 
 
-class OTLPHTTPExporter(EventExporter):
-    """Batched OTLP/HTTP (JSON encoding) log exporter.
+def post_otlp(endpoint: str, body: bytes, timeout_s: float) -> None:
+    """POST one OTLP JSON document; raises on non-2xx or network failure
+    (callers own the drop-with-warning failure policy).  The one HTTP leg
+    shared by the logs, traces, and metrics exporters."""
+    req = urllib.request.Request(
+        endpoint,
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        if not (200 <= resp.status < 300):
+            raise OSError(f"collector returned HTTP {resp.status}")
 
-    Every structured event becomes one OTLP logRecord: ``ts`` ->
-    timeUnixNano, ``kind`` -> severity + an attribute, ``message`` ->
-    body, remaining extras -> attributes.
+
+class BatchedOTLPHTTPExporter:
+    """Shared OTLP/HTTP batch pipeline (logs + traces legs subclass this;
+    the metrics leg pushes snapshots instead of batching records, so it
+    only shares :func:`post_otlp`).
+
+    Records buffer in memory and flush on a daemon thread every
+    ``flush_interval_s`` or ``max_batch`` records, whichever first; an
+    atexit flush ships a dying replica's last batch; exports after
+    ``close()`` count into ``dropped`` rather than vanishing; failed posts
+    drop with a warning — a dead collector never takes down training.
+
+    Subclasses set ``path_suffix`` and implement ``_encode(batch)``.
     """
+
+    path_suffix = "/v1/logs"
 
     def __init__(
         self,
@@ -109,8 +137,8 @@ class OTLPHTTPExporter(EventExporter):
         timeout_s: float = 5.0,
     ) -> None:
         self._endpoint = endpoint.rstrip("/")
-        if not self._endpoint.endswith("/v1/logs"):
-            self._endpoint += "/v1/logs"
+        if not self._endpoint.endswith(self.path_suffix):
+            self._endpoint += self.path_suffix
         if resource_attributes is None:
             resource_attributes = load_resource_attributes(service_name)
         attrs = {"service.name": service_name, **resource_attributes}
@@ -123,31 +151,44 @@ class OTLPHTTPExporter(EventExporter):
         self._closed = False
         self._posting = False
         self.exported = 0  # records acknowledged by the collector
-        self.dropped = 0  # records lost to collector/network failures
+        self.dropped = 0  # records lost (network failure or post-close)
         self._thread = threading.Thread(
             target=self._run, name="otlp_exporter", daemon=True
         )
         self._thread.start()
-
-    # -- EventExporter -----------------------------------------------------
+        # The last records of a dying replica (the abort/error that explains
+        # the death) are exactly the ones an FT postmortem needs: flush the
+        # buffer at interpreter exit instead of losing the final batch.
+        atexit.register(self._atexit_flush)
 
     def export(self, record: "Dict[str, Any]") -> None:
         with self._cv:
             if self._closed:
+                # a post-close export is a lost record, not a silent no-op:
+                # ops dashboards alert on `dropped`
+                self.dropped += 1
                 return
             self._buf.append(record)
             if len(self._buf) >= self._max_batch:
                 self._cv.notify()
 
+    def _atexit_flush(self) -> None:
+        if not self._closed:
+            self.flush(timeout=2.0)
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
             self._cv.notify()
+        try:
+            atexit.unregister(self._atexit_flush)
+        except Exception:  # noqa: BLE001 - interpreter-state dependent
+            pass
         self._thread.join(timeout=self._timeout_s + 1.0)
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until the current buffer has been posted (tests, and the
-        pre-exit flush an FT system wants for its last events)."""
+        pre-exit flush an FT system wants for its last records)."""
         import time as _t
 
         with self._cv:
@@ -181,6 +222,33 @@ class OTLPHTTPExporter(EventExporter):
                 return
 
     def _encode(self, batch: "List[Dict[str, Any]]") -> bytes:
+        raise NotImplementedError
+
+    def _post(self, batch: "List[Dict[str, Any]]") -> None:
+        try:
+            post_otlp(self._endpoint, self._encode(batch), self._timeout_s)
+            self.exported += len(batch)
+        except Exception as e:  # noqa: BLE001 - a sink never kills training
+            self.dropped += len(batch)
+            logger.warning(
+                "OTLP export of %d record(s) to %s failed: %s",
+                len(batch),
+                self._endpoint,
+                e,
+            )
+
+
+class OTLPHTTPExporter(BatchedOTLPHTTPExporter, EventExporter):
+    """Batched OTLP/HTTP (JSON encoding) log exporter.
+
+    Every structured event becomes one OTLP logRecord: ``ts`` ->
+    timeUnixNano, ``kind`` -> severity + an attribute, ``message`` ->
+    body, remaining extras -> attributes.
+    """
+
+    path_suffix = "/v1/logs"
+
+    def _encode(self, batch: "List[Dict[str, Any]]") -> bytes:
         records = []
         for rec in batch:
             rec = dict(rec)
@@ -211,26 +279,6 @@ class OTLPHTTPExporter(EventExporter):
             ]
         }
         return json.dumps(doc, default=str).encode()
-
-    def _post(self, batch: "List[Dict[str, Any]]") -> None:
-        body = self._encode(batch)
-        req = urllib.request.Request(
-            self._endpoint,
-            data=body,
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
-                if 200 <= resp.status < 300:
-                    self.exported += len(batch)
-                    return
-                raise OSError(f"collector returned HTTP {resp.status}")
-        except Exception as e:  # noqa: BLE001 - a sink never kills training
-            self.dropped += len(batch)
-            logger.warning(
-                "OTLP export of %d event(s) failed: %s", len(batch), e
-            )
 
 
 def maybe_install_from_env() -> "Optional[OTLPHTTPExporter]":

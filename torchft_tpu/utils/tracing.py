@@ -1,0 +1,157 @@
+"""OTLP/HTTP trace export for the quorum/recovery hot path.
+
+Third leg of the telemetry layer (logs: utils/otel.py, metrics:
+utils/metrics.py): the Manager emits one root span per quorum round
+("quorum_round", start_quorum -> should_commit) with child spans for each
+protocol phase (quorum_rpc, pg_configure, heal_send, heal_recv, commit,
+...).  Spans carry ``step`` / ``quorum_id`` / ``replica_id`` attributes —
+the same keys the structured events carry — so a trace backend and a log
+backend can be joined on them.
+
+No opentelemetry SDK in this environment: spans are encoded directly as
+the OTLP/HTTP **JSON** traces protocol (``POST <endpoint>/v1/traces``,
+``resourceSpans`` documents) with the same batching, gating
+(``TORCHFT_USE_OTEL``) and failure policy as the log exporter — a dead
+collector never takes down training.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu.utils.otel import BatchedOTLPHTTPExporter, _kv_list
+
+logger = logging.getLogger(__name__)
+
+
+def new_trace_id() -> str:
+    """128-bit trace id as 32 lowercase hex chars."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """64-bit span id as 16 lowercase hex chars."""
+    return os.urandom(8).hex()
+
+
+class OTLPHTTPSpanExporter(BatchedOTLPHTTPExporter):
+    """Batched OTLP/HTTP (JSON encoding) span exporter: the shared
+    ``BatchedOTLPHTTPExporter`` pipeline (daemon flush thread, atexit
+    flush, dropped counter, a dead collector never kills training) with
+    the ``/v1/traces`` encoding.  ``export`` takes the internal span dict
+    produced by :meth:`Tracer.export_span`."""
+
+    path_suffix = "/v1/traces"
+
+    def __init__(self, endpoint: str, max_batch: int = 128, **kw: Any) -> None:
+        super().__init__(endpoint, max_batch=max_batch, **kw)
+
+    def _encode(self, batch: "List[Dict[str, Any]]") -> bytes:
+        spans = []
+        for s in batch:
+            span: "Dict[str, Any]" = {
+                "traceId": s["trace_id"],
+                "spanId": s["span_id"],
+                "name": s["name"],
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(s["start_ns"]),
+                "endTimeUnixNano": str(s["end_ns"]),
+                "attributes": _kv_list(s.get("attributes", {})),
+                "status": {"code": 1 if s.get("ok", True) else 2},
+            }
+            if s.get("parent_span_id"):
+                span["parentSpanId"] = s["parent_span_id"]
+            spans.append(span)
+        doc = {
+            "resourceSpans": [
+                {
+                    "resource": self._resource,
+                    "scopeSpans": [
+                        {"scope": {"name": "torchft_tpu"}, "spans": spans}
+                    ],
+                }
+            ]
+        }
+        return json.dumps(doc, default=str).encode()
+
+
+class Tracer:
+    """Thin span factory over an exporter; the Manager is the only caller
+    on the hot path, so the API is one call per finished span (no context
+    propagation machinery needed for a single-process span tree)."""
+
+    def __init__(self, exporter: OTLPHTTPSpanExporter) -> None:
+        self.exporter = exporter
+
+    def export_span(
+        self,
+        name: str,
+        trace_id: str,
+        start_ns: int,
+        end_ns: int,
+        span_id: "Optional[str]" = None,
+        parent_span_id: "Optional[str]" = None,
+        attributes: "Optional[Dict[str, Any]]" = None,
+        ok: bool = True,
+    ) -> str:
+        """Record one finished span; returns its span id."""
+        sid = span_id or new_span_id()
+        self.exporter.export(
+            {
+                "name": name,
+                "trace_id": trace_id,
+                "span_id": sid,
+                "parent_span_id": parent_span_id,
+                "start_ns": int(start_ns),
+                "end_ns": int(end_ns),
+                "attributes": attributes or {},
+                "ok": ok,
+            }
+        )
+        return sid
+
+
+_tracer: "Optional[Tracer]" = None
+_tracer_lock = threading.Lock()
+
+
+def install_tracer(tracer: Tracer) -> Tracer:
+    """Make ``tracer`` the process-wide tracer the Manager emits to."""
+    global _tracer
+    with _tracer_lock:
+        _tracer = tracer
+    return tracer
+
+
+def uninstall_tracer() -> None:
+    global _tracer
+    with _tracer_lock:
+        old, _tracer = _tracer, None
+    if old is not None:
+        old.exporter.close()
+
+
+def get_tracer() -> "Optional[Tracer]":
+    """The installed tracer, or None (the common case — callers must treat
+    tracing as strictly optional and zero-cost when absent)."""
+    return _tracer
+
+
+def maybe_install_from_env() -> "Optional[Tracer]":
+    """Install an OTLP span exporter when ``TORCHFT_USE_OTEL`` is truthy.
+    Endpoint: ``OTEL_EXPORTER_OTLP_TRACES_ENDPOINT``, else
+    ``OTEL_EXPORTER_OTLP_ENDPOINT``, else the OTLP default."""
+    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
+        return None
+    if _tracer is not None:
+        return _tracer
+    endpoint = (
+        os.environ.get("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT")
+        or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        or "http://localhost:4318"
+    )
+    return install_tracer(Tracer(OTLPHTTPSpanExporter(endpoint)))
