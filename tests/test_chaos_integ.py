@@ -1,0 +1,428 @@
+"""Chaos suite: multi-replica training under scheduled fault injection.
+
+The production chaos layer (``torchft_tpu.utils.faults``) drives every
+failure here — the same registry a deployment configures with
+``TORCHFT_FAULTS``.  Two tiers:
+
+- ``test_chaos_smoke_*`` (marker ``chaos``, tier-1, seeded, <60s): a
+  2-replica DDP run through an injected quorum failure, transport failure,
+  allreduce failure and a replica crash must recover, converge bitwise,
+  and report ``torchft_faults_injected_total`` counters exactly matching
+  the schedule.
+- ``test_chaos_soak_*`` (markers ``chaos, slow``, excluded from tier-1): a
+  randomized-but-seeded schedule hitting every registered production site
+  over longer DDP and DiLoCo runs.
+
+Every run is watchdog-bounded (``utils.futures.context_timeout`` aborting
+the live process groups + bounded future waits), so a deadlock fails fast
+with a diagnostic instead of eating the suite timeout.
+"""
+
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+from torchft_tpu.utils import faults, metrics
+from torchft_tpu.utils.faults import FaultRule, InjectedFault
+from torchft_tpu.utils.futures import context_timeout
+
+from tests.test_manager_integ import Runner, assert_bitwise_equal
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    yield server
+    server.shutdown()
+
+
+# every (site, action) pair any chaos test can schedule — snapshotting a
+# fixed key set keeps before/after deltas comparable across tests sharing
+# one process-wide metrics registry
+_SNAPSHOT_KEYS = [
+    (site, action)
+    for site in faults.KNOWN_SITES
+    for action in faults.ACTIONS
+]
+
+
+def _metrics_snapshot() -> "Dict[tuple, float]":
+    """Per-(site, action) values of torchft_faults_injected_total."""
+    return {
+        key: metrics.FAULTS_INJECTED.labels(site=key[0], action=key[1]).get()
+        for key in _SNAPSHOT_KEYS
+    }
+
+
+# The replica harness is the DDP Runner from test_manager_integ (same
+# training loop, same train.step crash-and-restart semantics) — one
+# harness for plain-recovery AND chaos tests, with the `pgs` sink giving
+# the chaos watchdog a handle to abort live groups on deadline expiry.
+def ChaosRunner(
+    replica_id: int,
+    lighthouse_addr: str,
+    total_steps: int,
+    pgs: "List[ProcessGroupTCP]",
+    attempts: int = 4,
+) -> Runner:
+    return Runner(
+        replica_id,
+        lighthouse_addr,
+        total_steps=total_steps,
+        min_replica_size=1,
+        attempts=attempts,
+        pgs=pgs,
+    )
+
+
+def run_with_watchdog(runners: "List[Runner]", budget: float) -> "List[dict]":
+    """Run replicas concurrently under a hard deadline.
+
+    Arms the shared timeout engine (utils/futures.py — itself guarded by
+    the process watchdog): on expiry every live PG is aborted, unwedging
+    any stuck collective so the bounded future waits below fail with a
+    real error instead of hanging to the suite timeout.
+    """
+    pgs: "List[ProcessGroupTCP]" = []
+    for r in runners:
+        r.pgs = pgs
+    tripped = threading.Event()
+
+    def _trip() -> None:
+        tripped.set()
+        for pg in list(pgs):
+            try:
+                pg.abort()
+            except Exception:  # noqa: BLE001 - unwedge best-effort
+                pass
+
+    with context_timeout(_trip, budget):
+        with ThreadPoolExecutor(max_workers=len(runners)) as ex:
+            futures = [ex.submit(r.run) for r in runners]
+            results = [f.result(timeout=budget + 10) for f in futures]
+    assert not tripped.is_set(), "chaos watchdog tripped: run wedged past deadline"
+    return results
+
+
+# ---------------------------------------------------------------------------
+# tier-1 seeded smoke (<60s)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosSmoke:
+    def test_chaos_smoke_ddp(self, lighthouse):
+        """Seeded 2-replica run: one injected quorum failure, one transport
+        failure, one allreduce failure, one replica crash.  Must recover,
+        converge bitwise, and the faults-injected counters (registry AND
+        the metrics surface) must match the schedule exactly."""
+        schedule = [
+            FaultRule(site="manager.quorum", replica="replica_0", step=1),
+            FaultRule(site="pg.allreduce", replica="replica_1", step=2),
+            FaultRule(site="train.step", replica="replica_1", step=3),
+            # first heal recv anywhere fails once; the protocol must retry
+            # the heal on the next quorum round
+            FaultRule(site="transport.recv", after_step=0),
+        ]
+        before = _metrics_snapshot()
+        faults.FAULTS.configure(list(schedule), seed=1234)
+
+        runners = [
+            ChaosRunner(i, lighthouse.address(), total_steps=6, pgs=[])
+            for i in range(2)
+        ]
+        results = run_with_watchdog(runners, budget=120.0)
+
+        assert all(r["manager_state"]["step"] == 6 for r in results)
+        assert_bitwise_equal(results)
+
+        # accounting: every scheduled one-shot rule fired exactly once...
+        expected = {
+            ("manager.quorum", "raise"): 1,
+            ("pg.allreduce", "raise"): 1,
+            ("train.step", "raise"): 1,
+            ("transport.recv", "raise"): 1,
+        }
+        assert faults.FAULTS.counts() == expected
+        # ...and the metrics registry tells the identical story
+        after = _metrics_snapshot()
+        deltas = {k: after[k] - before[k] for k in after if after[k] != before[k]}
+        assert deltas == {k: float(v) for k, v in expected.items()}
+
+    def test_chaos_smoke_latency_and_drop(self, lighthouse):
+        """Delay and drop actions on the quorum path: latency injection
+        must not break the protocol, and an injected lighthouse-RPC drop
+        must ride the client's reconnect path."""
+        faults.FAULTS.configure(
+            [
+                FaultRule(
+                    site="manager.quorum",
+                    action="delay",
+                    delay=0.2,
+                    after_step=0,
+                    times=2,
+                ),
+            ],
+            seed=7,
+        )
+        runners = [
+            ChaosRunner(i, lighthouse.address(), total_steps=3, pgs=[])
+            for i in range(2)
+        ]
+        results = run_with_watchdog(runners, budget=90.0)
+        assert all(r["manager_state"]["step"] == 3 for r in results)
+        assert_bitwise_equal(results)
+        assert faults.FAULTS.counts() == {("manager.quorum", "delay"): 2}
+
+        # lighthouse.rpc drop: the persistent client reconnects and retries
+        # the (idempotent) call transparently
+        faults.FAULTS.configure(
+            [FaultRule(site="lighthouse.rpc", action="drop")], seed=8
+        )
+        client = LighthouseClient(lighthouse.address(), connect_timeout=5.0)
+        try:
+            status = client.status(timeout=5.0)
+        finally:
+            client.close()
+        assert isinstance(status, dict) and status
+        assert faults.FAULTS.counts() == {("lighthouse.rpc", "drop"): 1}
+
+    def test_quorum_retries_ride_injected_drop(self):
+        """TORCHFT_QUORUM_RETRIES backoff semantics end to end: an injected
+        connection drop at the manager.quorum site is retried with backoff
+        inside the quorum budget — the step completes with NO error latched
+        and the retry counter moves."""
+        retries_before = metrics.RETRIES.labels(op="manager.quorum").get()
+        faults.FAULTS.configure(
+            [FaultRule(site="manager.quorum", action="drop")], seed=3
+        )
+        state = {"w": np.zeros(2, dtype=np.float32)}
+        server = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+        try:
+            manager = Manager(
+                pg=ProcessGroupTCP(timeout=10.0),
+                min_replica_size=1,
+                load_state_dict=lambda sd: state.update(sd),
+                state_dict=lambda: dict(state),
+                lighthouse_addr=server.address(),
+                replica_id="retryer",
+                group_rank=0,
+                group_world_size=1,
+                use_async_quorum=False,
+                timeout=10.0,
+                quorum_timeout=10.0,
+                quorum_retries=2,
+            )
+            try:
+                manager.start_quorum()
+                manager.allreduce({"g": np.ones(2, np.float32)}).wait(timeout=10)
+                assert manager.errored() is None, manager.errored()
+                assert manager.should_commit()
+            finally:
+                manager.shutdown()
+        finally:
+            server.shutdown()
+        assert faults.FAULTS.counts() == {("manager.quorum", "drop"): 1}
+        assert metrics.RETRIES.labels(op="manager.quorum").get() == retries_before + 1
+
+
+# ---------------------------------------------------------------------------
+# soaks (slow; excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _soak_schedule(rng: "random.Random", n_replicas: int, total_steps: int):
+    """Randomized-but-seeded schedule hitting every production site the
+    DDP path exercises.
+
+    Step-targeted rules use ``after_step`` thresholds, not exact steps: a
+    healing replica jumps its step straight to max_step, so an exact step
+    can legitimately be skipped — a threshold fires at the first
+    opportunity past it, keeping "faults injected == faults scheduled"
+    exact under every interleaving while the threshold/replica choices
+    stay randomized."""
+    pick = lambda: f"replica_{rng.randrange(n_replicas)}"  # noqa: E731
+    mid = lambda: rng.randrange(1, max(total_steps - 2, 2))  # noqa: E731
+    return [
+        FaultRule(site="train.step", replica=pick(), after_step=mid()),
+        FaultRule(site="manager.quorum", replica=pick(), after_step=mid()),
+        FaultRule(
+            site="manager.quorum", action="delay", delay=0.05, after_step=0, times=3
+        ),
+        FaultRule(site="manager.heal", action="delay", delay=0.05, after_step=0),
+        FaultRule(site="pg.allreduce", replica=pick(), after_step=mid()),
+        FaultRule(site="pg.reconfigure", replica=pick()),
+        FaultRule(site="transport.recv", after_step=0),
+        FaultRule(site="transport.send", after_step=0),
+        FaultRule(site="store.barrier", action="drop"),
+    ]
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_chaos_soak_ddp(self, lighthouse):
+        """3-replica DDP soak under a seeded randomized schedule touching
+        every DDP-path site; convergence + no deadlock + exact accounting."""
+        SEED, REPLICAS, STEPS = 20260803, 3, 10
+        schedule = _soak_schedule(random.Random(SEED), REPLICAS, STEPS)
+        faults.FAULTS.configure(list(schedule), seed=SEED)
+
+        runners = [
+            ChaosRunner(i, lighthouse.address(), total_steps=STEPS, pgs=[])
+            for i in range(REPLICAS)
+        ]
+        results = run_with_watchdog(runners, budget=300.0)
+        assert all(r["manager_state"]["step"] == STEPS for r in results)
+        assert_bitwise_equal(results)
+
+        counts = faults.FAULTS.counts()
+        # every one-shot raise/drop rule fired exactly once (after_step
+        # thresholds guarantee an eventual opportunity on every site)
+        assert counts[("train.step", "raise")] == 1
+        assert counts[("manager.quorum", "raise")] == 1
+        assert counts[("pg.allreduce", "raise")] == 1
+        assert counts[("pg.reconfigure", "raise")] == 1
+        assert counts[("transport.recv", "raise")] == 1
+        assert counts[("transport.send", "raise")] == 1
+        assert counts[("store.barrier", "drop")] == 1
+        # the train.step crash forces a heal, so the heal-latency rule fired
+        assert counts[("manager.heal", "delay")] == 1
+        # quorum latency: bounded by its times budget
+        assert counts[("manager.quorum", "delay")] == 3
+        # registry total == sum over the metrics surface story
+        assert faults.FAULTS.injected() == sum(counts.values())
+
+    def test_chaos_soak_diloco(self, lighthouse):
+        """2-replica Streaming-DiLoCo soak: a replica crash at the
+        fragment-sync boundary (local_sgd.sync) plus an allreduce failure;
+        the semi-sync protocol must re-form and converge exactly."""
+        SEED = 77
+        faults.FAULTS.configure(
+            [
+                FaultRule(site="local_sgd.sync", replica="diloco_1", step=2),
+                FaultRule(site="pg.allreduce", replica="diloco_0", step=4),
+            ],
+            seed=SEED,
+        )
+
+        outer_syncs, sync_every, n_fragments = 4, 4, 2
+        target_steps = outer_syncs * n_fragments
+        results: "Dict[int, dict]" = {}
+        errors: "Dict[int, BaseException]" = {}
+        pgs: "List[ProcessGroupTCP]" = []
+
+        def run(rid: int) -> None:
+            try:
+                for _ in range(4):  # restart loop: crash-and-heal
+                    try:
+                        results[rid] = _diloco_train(rid)
+                        return
+                    except InjectedFault:
+                        continue
+                raise RuntimeError(f"diloco_{rid} exhausted restarts")
+            except BaseException as e:  # noqa: BLE001
+                errors[rid] = e
+
+        def _diloco_train(rid: int) -> dict:
+            params = {
+                "layer0": np.zeros(4, dtype=np.float32),
+                "layer1": np.zeros(4, dtype=np.float32),
+            }
+            holder = {"p": params}
+
+            def get_params():
+                return dict(holder["p"])
+
+            def set_params(p):
+                holder["p"] = dict(p)
+
+            pg = ProcessGroupTCP(timeout=10.0)
+            pgs.append(pg)
+            manager = Manager(
+                pg=pg,
+                min_replica_size=1,
+                lighthouse_addr=lighthouse.address(),
+                replica_id=f"diloco_{rid}",
+                group_rank=0,
+                group_world_size=1,
+                use_async_quorum=False,
+                timeout=20.0,
+                quorum_timeout=20.0,
+                load_state_dict=lambda sd: holder.__setitem__(
+                    "p", {k: np.array(v) for k, v in sd.items()}
+                ),
+                state_dict=lambda: {k: np.array(v) for k, v in holder["p"].items()},
+            )
+            try:
+                algo = DiLoCo(
+                    manager,
+                    [["layer0"], ["layer1"]],
+                    get_params,
+                    set_params,
+                    optax.sgd(0.5, momentum=0.9, nesterov=True),
+                    sync_every=sync_every,
+                )
+                while manager.current_step() < target_steps:
+                    p = get_params()
+                    set_params(
+                        {
+                            k: v - 0.01 * (1.0 + i)
+                            for i, (k, v) in enumerate(sorted(p.items()))
+                        }
+                    )
+                    algo.step()
+                return {"params": get_params(), "manager_state": manager.state_dict()}
+            finally:
+                manager.shutdown()
+
+        tripped = threading.Event()
+
+        def _trip() -> None:
+            tripped.set()
+            for pg in list(pgs):
+                try:
+                    pg.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threads = [
+            threading.Thread(target=run, args=(r,), daemon=True) for r in range(2)
+        ]
+        with context_timeout(_trip, 300.0):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=310.0)
+        assert not tripped.is_set(), "diloco chaos watchdog tripped"
+        assert not any(t.is_alive() for t in threads), "diloco replica hung"
+        assert not errors, errors
+        assert set(results) == {0, 1}
+
+        assert all(
+            r["manager_state"]["step"] == target_steps for r in results.values()
+        )
+        base = results[0]["params"]
+        for k in base:
+            np.testing.assert_array_equal(base[k], results[1]["params"][k])
+        counts = faults.FAULTS.counts()
+        assert counts[("local_sgd.sync", "raise")] == 1
+        assert counts[("pg.allreduce", "raise")] == 1

@@ -1,0 +1,280 @@
+"""Unit tests for the chaos layer (torchft_tpu/utils/faults.py):
+schedule determinism under a fixed seed, env-spec parsing round-trip,
+site accounting, matching semantics, and the three actions."""
+
+import time
+
+import pytest
+
+from torchft_tpu.utils import faults, metrics
+from torchft_tpu.utils.faults import (
+    FAULTS,
+    FaultRegistry,
+    FaultRule,
+    InjectedConnectionDrop,
+    InjectedFault,
+    configure_from_env,
+    format_spec,
+    parse_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_global_registry():
+    FAULTS.configure([], seed=0)
+    yield
+    FAULTS.configure([])
+
+
+# ---------------------------------------------------------------------------
+# matching + actions
+# ---------------------------------------------------------------------------
+
+
+class TestMatching:
+    def test_exact_step_and_replica(self):
+        reg = FaultRegistry(seed=1)
+        reg.configure([FaultRule(site="pg.allreduce", replica="r1", step=3)])
+        # wrong site / replica / step: no fire
+        reg.check("pg.reconfigure", replica="r1", step=3)
+        reg.check("pg.allreduce", replica="r0", step=3)
+        reg.check("pg.allreduce", replica="r1", step=2)
+        assert reg.injected() == 0
+        with pytest.raises(InjectedFault):
+            reg.check("pg.allreduce", replica="r1", step=3)
+        assert reg.injected() == 1
+
+    def test_replica_incarnation_suffix_stripped(self):
+        reg = FaultRegistry()
+        reg.configure([FaultRule(site="manager.quorum", replica="replica_1")])
+        with pytest.raises(InjectedFault):
+            reg.check("manager.quorum", replica="replica_1:some-uuid-suffix")
+
+    def test_constrained_rule_never_fires_without_context(self):
+        reg = FaultRegistry()
+        reg.configure(
+            [
+                FaultRule(site="transport.recv", replica="r0"),
+                FaultRule(site="transport.send", after_step=2),
+            ]
+        )
+        # caller supplied no replica/step: constrained rules must not match
+        reg.check("transport.recv")
+        reg.check("transport.send")
+        assert reg.injected() == 0
+
+    def test_after_step(self):
+        reg = FaultRegistry()
+        reg.configure([FaultRule(site="train.step", after_step=5, times=-1)])
+        reg.check("train.step", step=4)
+        assert reg.injected() == 0
+        for s in (5, 6, 100):
+            with pytest.raises(InjectedFault):
+                reg.check("train.step", step=s)
+        assert reg.injected() == 3
+
+    def test_times_exhaustion(self):
+        reg = FaultRegistry()
+        reg.configure([FaultRule(site="store.barrier", times=2)])
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                reg.check("store.barrier")
+        # exhausted: subsequent checks pass through
+        reg.check("store.barrier")
+        assert reg.injected("store.barrier") == 2
+
+    def test_drop_is_a_connection_error(self):
+        reg = FaultRegistry()
+        reg.configure([FaultRule(site="lighthouse.rpc", action="drop")])
+        with pytest.raises(ConnectionError) as ei:
+            reg.check("lighthouse.rpc")
+        assert isinstance(ei.value, InjectedConnectionDrop)
+
+    def test_delay_sleeps_and_returns(self):
+        reg = FaultRegistry()
+        reg.configure([FaultRule(site="manager.quorum", action="delay", delay=0.05)])
+        t0 = time.monotonic()
+        reg.check("manager.quorum")  # must NOT raise
+        assert time.monotonic() - t0 >= 0.05
+        assert reg.counts() == {("manager.quorum", "delay"): 1}
+
+    def test_first_matching_rule_wins(self):
+        reg = FaultRegistry()
+        reg.configure(
+            [
+                FaultRule(site="pg.allreduce", action="delay", delay=0.0),
+                FaultRule(site="pg.allreduce", action="raise"),
+            ]
+        )
+        reg.check("pg.allreduce")  # delay rule fires, no raise
+        with pytest.raises(InjectedFault):
+            reg.check("pg.allreduce")  # first rule exhausted; second fires
+
+    def test_invalid_rules_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule(site="x", action="explode")
+        with pytest.raises(ValueError):
+            FaultRule(site="x", prob=1.5)
+        with pytest.raises(ValueError):
+            FaultRule(site="x", delay=-1.0)
+        with pytest.raises(ValueError):
+            FaultRule(site="")
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+def _drive(reg: FaultRegistry, steps: int = 200) -> list:
+    fired = []
+    for s in range(steps):
+        try:
+            reg.check("pg.allreduce", replica="r0", step=s)
+        except InjectedFault:
+            fired.append(s)
+    return fired
+
+
+class TestDeterminism:
+    RULES = lambda self: [  # noqa: E731 - fresh rule objects per registry
+        FaultRule(site="pg.allreduce", prob=0.15, after_step=10, times=-1)
+    ]
+
+    def test_same_seed_same_schedule(self):
+        a, b = FaultRegistry(), FaultRegistry()
+        a.configure(self.RULES(), seed=42)
+        b.configure(self.RULES(), seed=42)
+        fired_a, fired_b = _drive(a), _drive(b)
+        assert fired_a, "probabilistic rule never fired in 200 steps"
+        assert fired_a == fired_b
+        assert all(s >= 10 for s in fired_a)
+
+    def test_different_seed_different_schedule(self):
+        a, b = FaultRegistry(), FaultRegistry()
+        a.configure(self.RULES(), seed=42)
+        b.configure(self.RULES(), seed=43)
+        assert _drive(a) != _drive(b)
+
+    def test_reconfigure_replays(self):
+        reg = FaultRegistry()
+        reg.configure(self.RULES(), seed=7)
+        first = _drive(reg)
+        reg.configure(self.RULES(), seed=7)  # reset counts + rng streams
+        assert reg.injected() == 0
+        assert _drive(reg) == first
+
+
+# ---------------------------------------------------------------------------
+# spec round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_round_trip(self):
+        rules = [
+            FaultRule(site="pg.allreduce", replica="replica_1", step=2),
+            FaultRule(site="transport.recv", after_step=0, action="drop", times=2),
+            FaultRule(
+                site="manager.quorum",
+                prob=0.05,
+                after_step=3,
+                times=-1,
+                action="delay",
+                delay=0.2,
+            ),
+            FaultRule(site="train.step"),
+        ]
+        spec = format_spec(rules)
+        assert parse_spec(spec) == rules
+        # stable: formatting the reparse is identical
+        assert format_spec(parse_spec(spec)) == spec
+
+    def test_parse_defaults(self):
+        (rule,) = parse_spec("pg.reconfigure")
+        assert rule == FaultRule(site="pg.reconfigure")
+        assert rule.action == "raise" and rule.times == 1 and rule.prob == 1.0
+
+    def test_parse_whitespace_and_empty_segments(self):
+        rules = parse_spec(" pg.allreduce : step=1 ; ; transport.send ")
+        assert [r.site for r in rules] == ["pg.allreduce", "transport.send"]
+        assert rules[0].step == 1
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            parse_spec("pg.allreduce:bogus_key=1")
+        with pytest.raises(ValueError):
+            parse_spec("pg.allreduce:step")  # no '='
+        with pytest.raises(ValueError):
+            parse_spec("pg.allreduce:step=abc")
+        with pytest.raises(ValueError):
+            parse_spec("pg.allreduce:action=explode")
+
+    def test_configure_from_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "TORCHFT_FAULTS", "train.step:replica=r9,step=4;store.barrier:times=3"
+        )
+        monkeypatch.setenv("TORCHFT_FAULTS_SEED", "99")
+        assert configure_from_env()
+        rules = FAULTS.rules()
+        assert [r.site for r in rules] == ["train.step", "store.barrier"]
+        assert rules[0].replica == "r9" and rules[1].times == 3
+
+    def test_configure_from_env_empty(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_FAULTS", raising=False)
+        assert not configure_from_env()
+
+
+# ---------------------------------------------------------------------------
+# accounting: registry counters + metrics + structured events
+# ---------------------------------------------------------------------------
+
+
+class TestAccounting:
+    def test_counts_by_site_and_action(self):
+        reg = FaultRegistry()
+        reg.configure(
+            [
+                FaultRule(site="pg.allreduce", times=2),
+                FaultRule(site="transport.send", action="drop"),
+                FaultRule(site="manager.heal", action="delay", delay=0.0),
+            ]
+        )
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                reg.check("pg.allreduce")
+        with pytest.raises(InjectedConnectionDrop):
+            reg.check("transport.send")
+        reg.check("manager.heal")
+        assert reg.counts() == {
+            ("pg.allreduce", "raise"): 2,
+            ("transport.send", "drop"): 1,
+            ("manager.heal", "delay"): 1,
+        }
+        assert reg.injected() == 4
+        assert reg.injected("pg.allreduce") == 2
+
+    def test_metrics_and_event_emitted(self):
+        before = metrics.FAULTS_INJECTED.labels(
+            site="train.step", action="raise"
+        ).get()
+        FAULTS.configure([FaultRule(site="train.step")])
+        with pytest.raises(InjectedFault):
+            faults.check("train.step", replica="rX", step=7)
+        after = metrics.FAULTS_INJECTED.labels(
+            site="train.step", action="raise"
+        ).get()
+        assert after == before + 1
+        from torchft_tpu.utils.logging import recent_events
+
+        ev = [
+            e
+            for e in recent_events()
+            if e["kind"] == "fault" and e.get("site") == "train.step"
+        ]
+        assert ev and ev[-1]["action"] == "raise" and ev[-1]["step"] == 7
+
+    def test_empty_registry_check_is_noop(self):
+        reg = FaultRegistry()
+        reg.check("pg.allreduce", replica="r", step=1)
+        assert reg.injected() == 0

@@ -18,7 +18,20 @@ from torchft_tpu.local_sgd import DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.process_group import ProcessGroupTCP
 
-from tests.test_manager_integ import EventInjector, InjectedFailure
+from torchft_tpu.utils import faults
+from torchft_tpu.utils.faults import FaultRule, InjectedFault
+
+
+def fail_at(replica: int, step: int) -> FaultRule:
+    """Replica-crash rule for the DiLoCo runners (train.step site)."""
+    return FaultRule(site="train.step", replica=f"diloco_{replica}", step=step)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
 
 
 @pytest.fixture
@@ -38,7 +51,6 @@ class DiLoCoRunner:
         self,
         replica_id: int,
         lighthouse_addr: str,
-        injector: EventInjector,
         outer_syncs: int = 4,
         sync_every: int = 4,
         n_fragments: int = 2,
@@ -48,7 +60,6 @@ class DiLoCoRunner:
     ) -> None:
         self.replica_id = replica_id
         self.lighthouse_addr = lighthouse_addr
-        self.injector = injector
         self.outer_syncs = outer_syncs
         self.sync_every = sync_every
         self.n_fragments = n_fragments
@@ -60,7 +71,7 @@ class DiLoCoRunner:
         for attempt in range(3):
             try:
                 return self._train()
-            except InjectedFailure:
+            except InjectedFault:
                 continue
         raise RuntimeError("exhausted attempts")
 
@@ -111,7 +122,11 @@ class DiLoCoRunner:
                 self.n_fragments if self.algo == "diloco" else 1
             )
             while manager.current_step() < target_steps:
-                self.injector.check(self.replica_id, manager.current_step(), None)
+                faults.check(
+                    "train.step",
+                    replica=f"diloco_{self.replica_id}",
+                    step=manager.current_step(),
+                )
                 if self.inner_sleep:
                     time.sleep(self.inner_sleep)
                 # deterministic inner update (same on all replicas)
@@ -143,9 +158,9 @@ def assert_params_equal(results):
 
 class TestLocalSGDInteg:
     def test_local_sgd_healthy(self, lighthouse):
-        injector = EventInjector()
         runners = [
-            DiLoCoRunner(i, lighthouse.address(), injector, algo="local_sgd", outer_syncs=3)
+            DiLoCoRunner(
+                i, lighthouse.address(), algo="local_sgd", outer_syncs=3)
             for i in range(2)
         ]
         results = run_replicas(runners)
@@ -153,22 +168,23 @@ class TestLocalSGDInteg:
         assert_params_equal(results)
 
     def test_local_sgd_recovery(self, lighthouse):
-        injector = EventInjector().fail_at(replica=1, step=1)
+        faults.FAULTS.configure([fail_at(replica=1, step=1)])
         runners = [
-            DiLoCoRunner(i, lighthouse.address(), injector, algo="local_sgd", outer_syncs=4)
+            DiLoCoRunner(
+                i, lighthouse.address(), algo="local_sgd", outer_syncs=4)
             for i in range(2)
         ]
         results = run_replicas(runners)
-        assert injector.count == 1
+        assert faults.FAULTS.injected() == 1
         assert all(r["manager_state"]["step"] == 4 for r in results)
         assert_params_equal(results)
 
 
 class TestDiLoCoInteg:
     def test_diloco_healthy_two_fragments(self, lighthouse):
-        injector = EventInjector()
         runners = [
-            DiLoCoRunner(i, lighthouse.address(), injector, outer_syncs=3)
+            DiLoCoRunner(
+                i, lighthouse.address(), outer_syncs=3)
             for i in range(2)
         ]
         results = run_replicas(runners)
@@ -180,10 +196,9 @@ class TestDiLoCoInteg:
         # int8-quantized pseudogradient exchange: lossy vs f32, but the
         # dequantized result is identical bytes on every replica, so
         # cross-replica bitwise equality still holds
-        injector = EventInjector()
         runners = [
             DiLoCoRunner(
-                i, lighthouse.address(), injector, outer_syncs=3, quantize=True
+                i, lighthouse.address(), outer_syncs=3, quantize=True
             )
             for i in range(2)
         ]
@@ -192,23 +207,23 @@ class TestDiLoCoInteg:
         assert_params_equal(results)
 
     def test_diloco_recovery(self, lighthouse):
-        injector = EventInjector().fail_at(replica=1, step=2)
+        faults.FAULTS.configure([fail_at(replica=1, step=2)])
         runners = [
-            DiLoCoRunner(i, lighthouse.address(), injector, outer_syncs=4)
+            DiLoCoRunner(
+                i, lighthouse.address(), outer_syncs=4)
             for i in range(2)
         ]
         results = run_replicas(runners)
-        assert injector.count == 1
+        assert faults.FAULTS.injected() == 1
         assert all(r["manager_state"]["step"] == 8 for r in results)
         assert_params_equal(results)
 
     def test_diloco_upscale_mid_run(self, lighthouse):
         # third replica joins after the first two have synced a few times;
         # inner steps are paced so the join lands mid-run.
-        injector = EventInjector()
         runners = [
             DiLoCoRunner(
-                i, lighthouse.address(), injector, outer_syncs=5, inner_sleep=0.05
+                i, lighthouse.address(), outer_syncs=5, inner_sleep=0.05
             )
             for i in range(3)
         ]

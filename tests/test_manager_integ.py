@@ -3,14 +3,18 @@
 The reference's central testing trick (reference:
 torchft/manager_integ_test.py:179-359): each replica group is a thread with
 its own Manager + store + PG; one real LighthouseServer binds port 0.
-Fault injection via step-keyed events; recovery must make state dicts
-converge **bitwise** across replicas (reference :361-362) — the
-zero-contribution allreduce hands the healer the same averaged gradients the
-participants applied, so one step after healing everyone is identical.
+Fault injection goes through the production chaos layer
+(``torchft_tpu.utils.faults`` — the same registry ``TORCHFT_FAULTS``
+configures in deployments), NOT a test-local injector: the reference's
+EventInjector/FakeProcessGroupWrapper pattern is superseded so integration
+tests and production share one injection mechanism.  Recovery must make
+state dicts converge **bitwise** across replicas (reference :361-362) —
+the zero-contribution allreduce hands the healer the same averaged
+gradients the participants applied, so one step after healing everyone is
+identical.
 """
 
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -20,70 +24,58 @@ import pytest
 
 from torchft_tpu.coordination import LighthouseServer
 from torchft_tpu.manager import Manager
-from torchft_tpu.parallel.process_group import (
-    FakeProcessGroupWrapper,
-    ProcessGroupTCP,
-)
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+from torchft_tpu.utils import faults
+from torchft_tpu.utils.faults import FaultRule, InjectedFault
 
 
-class InjectedFailure(Exception):
-    pass
+@pytest.fixture(autouse=True)
+def clean_faults():
+    """Every test starts and ends with an empty chaos schedule (the
+    registry is process-wide by design)."""
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
 
 
-class EventInjector:
-    """(replica, step)-keyed fault injection
-    (reference: manager_integ_test.py:79-161)."""
+def fail_at(replica: int, step: int) -> FaultRule:
+    """Replica-crash rule: ``train.step`` raises in the training loop of
+    ``replica_<replica>`` at ``step`` — the Runner treats it as a process
+    death and restarts (the EventInjector.fail_at analog)."""
+    return FaultRule(site="train.step", replica=f"replica_{replica}", step=step)
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._fail_at: "Dict[tuple, bool]" = {}
-        self._fail_allreduce_at: "Dict[tuple, bool]" = {}
-        self.count = 0
 
-    def fail_at(self, replica: int, step: int) -> "EventInjector":
-        with self._lock:
-            self._fail_at[(replica, step)] = True
-        return self
-
-    def fail_allreduce_at(self, replica: int, step: int) -> "EventInjector":
-        with self._lock:
-            self._fail_allreduce_at[(replica, step)] = True
-        return self
-
-    def check(self, replica: int, step: int, pg: FakeProcessGroupWrapper) -> None:
-        with self._lock:
-            if self._fail_at.pop((replica, step), None):
-                self.count += 1
-                raise InjectedFailure(
-                    f"injected failure replica={replica} step={step}"
-                )
-            if self._fail_allreduce_at.pop((replica, step), None):
-                self.count += 1
-                pg.report_future_error(
-                    RuntimeError(f"injected allreduce failure step={step}")
-                )
+def fail_allreduce_at(replica: int, step: int) -> FaultRule:
+    """Collective-failure rule: ``pg.allreduce`` fails inside
+    ``Manager.allreduce`` — latched via report_error, the step aborts
+    cleanly and the quorum re-forms (the fail_allreduce_at analog)."""
+    return FaultRule(site="pg.allreduce", replica=f"replica_{replica}", step=step)
 
 
 @dataclass
 class Runner:
-    """One replica group (single local rank) running a toy DDP loop."""
+    """One replica group (single local rank) running a toy DDP loop.
+
+    ``pgs``: optional shared sink every created ProcessGroup is appended
+    to — the chaos suite's watchdog aborts them on deadline expiry.
+    """
 
     replica_id: int
     lighthouse_addr: str
-    event_injector: EventInjector
     total_steps: int = 5
     min_replica_size: int = 1
     use_async_quorum: bool = True
     attempts: int = 3
     lr: float = 0.1
     state_history: "List[dict]" = field(default_factory=list)
+    pgs: "Optional[List[ProcessGroupTCP]]" = None
 
     def run(self) -> dict:
         last_exc: "Optional[BaseException]" = None
         for attempt in range(self.attempts):
             try:
                 return self._train(attempt)
-            except InjectedFailure as e:
+            except InjectedFault as e:
                 last_exc = e
                 continue
         raise RuntimeError(f"replica {self.replica_id} exhausted attempts") from last_exc
@@ -104,7 +96,9 @@ class Runner:
                 "momentum": {"w": momentum["w"].copy()},
             }
 
-        pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+        pg = ProcessGroupTCP(timeout=10.0)
+        if self.pgs is not None:
+            self.pgs.append(pg)
         manager = Manager(
             pg=pg,
             min_replica_size=self.min_replica_size,
@@ -121,7 +115,11 @@ class Runner:
         try:
             while manager.current_step() < self.total_steps:
                 step = manager.current_step()
-                self.event_injector.check(self.replica_id, step, pg)
+                # production injection point for replica-crash chaos: a
+                # scheduled train.step fault raises InjectedFault here
+                faults.check(
+                    "train.step", replica=f"replica_{self.replica_id}", step=step
+                )
 
                 manager.start_quorum()
                 # deterministic per-step pseudo-gradient, same on every
@@ -174,12 +172,12 @@ def assert_bitwise_equal(results):
 
 class TestDDPInteg:
     def test_ddp_healthy(self, lighthouse):
-        injector = EventInjector()
         runners = [
-            Runner(i, lighthouse.address(), injector, total_steps=4, min_replica_size=2)
+            Runner(i, lighthouse.address(), total_steps=4, min_replica_size=2)
             for i in range(2)
         ]
         results = run_replicas(runners)
+        assert faults.FAULTS.injected() == 0
         assert all(r["manager_state"]["step"] == 4 for r in results)
         # 2 participants x 4 steps
         assert all(r["manager_state"]["batches_committed"] == 8 for r in results)
@@ -187,12 +185,11 @@ class TestDDPInteg:
 
     @pytest.mark.parametrize("use_async", [True, False])
     def test_ddp_recovery(self, lighthouse, use_async):
-        injector = EventInjector().fail_at(replica=1, step=2)
+        faults.FAULTS.configure([fail_at(replica=1, step=2)])
         runners = [
             Runner(
                 i,
                 lighthouse.address(),
-                injector,
                 total_steps=5,
                 min_replica_size=1,
                 use_async_quorum=use_async,
@@ -200,30 +197,32 @@ class TestDDPInteg:
             for i in range(2)
         ]
         results = run_replicas(runners)
-        assert injector.count == 1
+        assert faults.FAULTS.injected() == 1
+        assert faults.FAULTS.counts() == {("train.step", "raise"): 1}
         assert all(r["manager_state"]["step"] == 5 for r in results)
         assert_bitwise_equal(results)
 
     def test_ddp_allreduce_failure_recovers(self, lighthouse):
-        injector = EventInjector().fail_allreduce_at(replica=1, step=1)
+        faults.FAULTS.configure([fail_allreduce_at(replica=1, step=1)])
         runners = [
-            Runner(i, lighthouse.address(), injector, total_steps=4, min_replica_size=1)
+            Runner(i, lighthouse.address(), total_steps=4, min_replica_size=1)
             for i in range(2)
         ]
         results = run_replicas(runners)
-        assert injector.count == 1
+        assert faults.FAULTS.injected() == 1
+        assert faults.FAULTS.counts() == {("pg.allreduce", "raise"): 1}
         assert all(r["manager_state"]["step"] == 4 for r in results)
         assert_bitwise_equal(results)
 
     def test_multi_replica_recovery(self, lighthouse):
         # two different replicas die at different steps
-        injector = EventInjector().fail_at(1, 1).fail_at(2, 2)
+        faults.FAULTS.configure([fail_at(1, 1), fail_at(2, 2)])
         runners = [
-            Runner(i, lighthouse.address(), injector, total_steps=5, min_replica_size=1)
+            Runner(i, lighthouse.address(), total_steps=5, min_replica_size=1)
             for i in range(3)
         ]
         results = run_replicas(runners)
-        assert injector.count == 2
+        assert faults.FAULTS.injected() == 2
         assert all(r["manager_state"]["step"] == 5 for r in results)
         assert_bitwise_equal(results)
 
@@ -231,27 +230,31 @@ class TestDDPInteg:
 class TestEventExport:
     def test_events_file_written_on_replica_kill(self, lighthouse, tmp_path, monkeypatch):
         """The persistent JSONL sink (TORCHFT_EVENTS_FILE) must capture the
-        quorum churn and the post-heal commits of a replica-kill run — the
-        crash-durable analog of the reference's OTLP exporter
-        (reference torchft/otel.py:42-86)."""
+        quorum churn, the injected fault, and the post-heal commits of a
+        replica-kill run — the crash-durable analog of the reference's OTLP
+        exporter (reference torchft/otel.py:42-86)."""
         import json
 
         events_file = tmp_path / "events.jsonl"
         monkeypatch.setenv("TORCHFT_EVENTS_FILE", str(events_file))
 
-        injector = EventInjector().fail_at(replica=1, step=2)
+        faults.FAULTS.configure([fail_at(replica=1, step=2)])
         runners = [
-            Runner(i, lighthouse.address(), injector, total_steps=5, min_replica_size=1)
+            Runner(i, lighthouse.address(), total_steps=5, min_replica_size=1)
             for i in range(2)
         ]
         results = run_replicas(runners)
-        assert injector.count == 1
+        assert faults.FAULTS.injected() == 1
         assert_bitwise_equal(results)
 
         lines = events_file.read_text().strip().splitlines()
         events = [json.loads(line) for line in lines]
         kinds = {e["kind"] for e in events}
         assert "quorum" in kinds and "commit" in kinds
+        # the chaos layer writes its injection as a structured event too
+        assert any(
+            e["kind"] == "fault" and e.get("site") == "train.step" for e in events
+        )
         # quorum changed at least twice: initial formation + post-kill rejoin
         assert sum(1 for e in events if e["kind"] == "quorum") >= 2
         # the killed replica's post-heal commits are present

@@ -1,0 +1,188 @@
+"""Unit tests for the unified retry policy (torchft_tpu/utils/retry.py):
+jitter bounds, deadline budgets never exceeded, exception classification,
+attempt accounting, and the abort-on-attempt-timeout wiring."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.utils import metrics
+from torchft_tpu.utils.retry import RetryPolicy
+
+
+class Flaky:
+    """Raises ``exc`` for the first ``failures`` calls, then returns ok."""
+
+    def __init__(self, failures: int, exc: BaseException = ConnectionError("boom")):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+        self.budgets = []
+
+    def __call__(self, budget):
+        self.calls += 1
+        self.budgets.append(budget)
+        if self.calls <= self.failures:
+            raise self.exc
+        return "ok"
+
+
+class TestBackoff:
+    def test_full_jitter_bounds(self):
+        policy = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=1.0)
+        rng = random.Random(0)
+        for attempt in range(20):
+            cap = min(1.0, 0.1 * 2.0**attempt)
+            for _ in range(50):
+                d = policy.backoff(attempt, rng)
+                assert 0.0 <= d <= cap, (attempt, d, cap)
+
+    def test_jitter_disabled_is_deterministic_cap(self):
+        policy = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=1.0, jitter=False)
+        assert policy.backoff(0) == pytest.approx(0.1)
+        assert policy.backoff(2) == pytest.approx(0.4)
+        assert policy.backoff(10) == pytest.approx(1.0)  # capped
+
+    def test_backoff_seeded_reproducible(self):
+        policy = RetryPolicy()
+        a = [policy.backoff(i, random.Random(5)) for i in range(8)]
+        b = [policy.backoff(i, random.Random(5)) for i in range(8)]
+        assert a == b
+
+
+class TestDeadline:
+    def test_total_budget_never_exceeded(self):
+        policy = RetryPolicy(base_delay=0.02, max_delay=0.05)
+        fn = Flaky(failures=10**9)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError) as ei:
+            policy.run(fn, timeout=0.4, op="test.budget")
+        elapsed = time.monotonic() - t0
+        # sleeps are clamped to the remaining budget, so overshoot is at
+        # most one (fast) attempt's duration
+        assert elapsed < 0.4 + 0.2, elapsed
+        assert fn.calls >= 2
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_zero_budget_raises_before_first_attempt(self):
+        policy = RetryPolicy()
+        fn = Flaky(failures=0)
+        with pytest.raises(TimeoutError):
+            policy.run(fn, timeout=0.0)
+        assert fn.calls == 0
+
+    def test_attempts_receive_remaining_budget(self):
+        policy = RetryPolicy(base_delay=0.01, max_delay=0.01, jitter=False)
+        fn = Flaky(failures=2)
+        assert policy.run(fn, timeout=5.0) == "ok"
+        assert len(fn.budgets) == 3
+        # budgets monotonically shrink toward the shared deadline
+        assert fn.budgets[0] <= 5.0
+        assert fn.budgets[0] > fn.budgets[1] > fn.budgets[2]
+
+    def test_attempt_timeout_clamped_to_remaining(self):
+        policy = RetryPolicy(attempt_timeout=10.0)
+        fn = Flaky(failures=0)
+        policy.run(fn, timeout=1.0)
+        assert fn.budgets[0] <= 1.0  # clamped below attempt_timeout
+
+    def test_unbounded_run_passes_none_budget(self):
+        policy = RetryPolicy()
+        fn = Flaky(failures=0)
+        assert policy.run(fn) == "ok"
+        assert fn.budgets == [None]
+
+
+class TestClassification:
+    def test_non_retryable_raises_immediately(self):
+        policy = RetryPolicy(retryable=(ConnectionError,))
+        fn = Flaky(failures=5, exc=ValueError("not transient"))
+        with pytest.raises(ValueError):
+            policy.run(fn, timeout=5.0)
+        assert fn.calls == 1
+
+    def test_max_attempts_reraises_original(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.001)
+        fn = Flaky(failures=10)
+        with pytest.raises(ConnectionError):
+            policy.run(fn, timeout=5.0)
+        assert fn.calls == 3
+
+    def test_retry_if_predicate_overrides_types(self):
+        class Weird(Exception):
+            pass
+
+        policy = RetryPolicy(
+            base_delay=0.001,
+            max_delay=0.001,
+            retry_if=lambda e: isinstance(e, Weird),
+        )
+        ok = Flaky(failures=2, exc=Weird())
+        assert policy.run(ok, timeout=5.0) == "ok"
+        # the predicate replaces the type tuple entirely
+        no = Flaky(failures=2, exc=ConnectionError("x"))
+        with pytest.raises(ConnectionError):
+            policy.run(no, timeout=5.0)
+        assert no.calls == 1
+
+    def test_attempt_timeout_retryable_by_default_but_not_when_narrowed(self):
+        # TimeoutError subclasses OSError (PEP 3151), so the default tuple
+        # retries per-attempt socket timeouts...
+        policy = RetryPolicy(base_delay=0.001, max_delay=0.001)
+        fn = Flaky(failures=3, exc=TimeoutError("attempt timed out"))
+        assert policy.run(fn, timeout=5.0) == "ok"
+        # ...while deadline-owning policies narrow to ConnectionError and
+        # surface the expiry immediately (the manager.quorum stance)
+        narrow = RetryPolicy(retryable=(ConnectionError,))
+        fn2 = Flaky(failures=3, exc=TimeoutError("attempt timed out"))
+        with pytest.raises(TimeoutError):
+            narrow.run(fn2, timeout=5.0)
+        assert fn2.calls == 1
+
+
+class TestObservability:
+    def test_retry_counter_and_on_retry(self):
+        before = metrics.RETRIES.labels(op="test.obs").get()
+        seen = []
+        policy = RetryPolicy(base_delay=0.001, max_delay=0.001)
+        fn = Flaky(failures=2)
+        policy.run(
+            fn,
+            timeout=5.0,
+            op="test.obs",
+            on_retry=lambda e, n, d: seen.append((type(e).__name__, n, d)),
+        )
+        assert metrics.RETRIES.labels(op="test.obs").get() == before + 2
+        assert [s[:2] for s in seen] == [("ConnectionError", 1), ("ConnectionError", 2)]
+        assert all(d >= 0 for _, _, d in seen)
+
+
+class TestAbortCallback:
+    def test_abort_cb_fires_on_attempt_timeout(self):
+        """A wedged attempt must be actively cancelled: abort_cb (the
+        pg.abort analog) fires at the attempt deadline and unwedges it."""
+        aborted = threading.Event()
+        unwedge = threading.Event()
+
+        def abort():
+            aborted.set()
+            unwedge.set()
+
+        calls = []
+
+        def fn(budget):
+            calls.append(budget)
+            if len(calls) == 1:
+                # simulate a wedged socket wait that only the abort releases
+                assert unwedge.wait(timeout=5.0), "abort_cb never fired"
+                raise ConnectionError("aborted mid-attempt")
+            return "ok"
+
+        policy = RetryPolicy(
+            base_delay=0.001, max_delay=0.001, attempt_timeout=0.1
+        )
+        assert policy.run(fn, timeout=10.0, abort_cb=abort) == "ok"
+        assert aborted.is_set()
+        assert len(calls) == 2

@@ -24,10 +24,29 @@ from typing import Any, Callable, List, Optional
 
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
 
 logger = logging.getLogger(__name__)
+
+# Checkpoint fetch retry: the healer and the sender learn the quorum
+# simultaneously, so the sender may still be device->host staging the
+# snapshot — poll through retryable 503s (and connection errors during a
+# sender restart) with jittered backoff until the receiver's deadline.
+# Permanent failures (404 bad path / chunk range) fail immediately.
+_FETCH_POLICY = RetryPolicy(
+    name="transport.http.fetch",
+    base_delay=0.05,
+    multiplier=2.0,
+    max_delay=1.0,
+    retry_if=lambda e: (
+        e.code == 503
+        if isinstance(e, urllib.error.HTTPError)
+        else isinstance(e, (urllib.error.URLError, ConnectionError, OSError))
+    ),
+)
 
 
 class _HTTPServerIPv6(ThreadingHTTPServer):
@@ -157,6 +176,7 @@ class HTTPTransport(CheckpointTransport[Any]):
     def send_checkpoint(
         self, dst_ranks: "List[int]", step: int, state_dict: Any, timeout: float
     ) -> None:
+        _faults.check("transport.send", step=step)
         # Pull transport: stage a host snapshot; receivers fetch within their
         # own timeout. Device arrays are copied to host once here.
         import numpy as np
@@ -171,6 +191,7 @@ class HTTPTransport(CheckpointTransport[Any]):
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
+        _faults.check("transport.recv", step=step)
         base = f"{metadata}/checkpoint/{step}"
         deadline = time.monotonic() + timeout
         t_recv = time.perf_counter()
@@ -191,28 +212,24 @@ class HTTPTransport(CheckpointTransport[Any]):
                 into = None
 
         def fetch(path: str):
-            # The healer and the sender learn the quorum simultaneously; the
-            # sender may still be device->host staging the snapshot. Poll
-            # through retryable 503s (and connection errors during sender
-            # restart) until the deadline; permanent 404s fail immediately.
-            backoff = 0.05
-            while True:
-                t = max(deadline - time.monotonic(), 0.001)
-                try:
-                    with urllib.request.urlopen(f"{base}/{path}", timeout=t) as resp:
-                        _metrics.CHECKPOINT_BYTES.labels(
-                            transport="http", direction="recv"
-                        ).inc(int(resp.headers.get("Content-Length") or 0))
-                        return ser.deserialize_from(resp, into=into)
-                except urllib.error.HTTPError as e:
-                    if e.code != 503 or time.monotonic() + backoff >= deadline:
-                        raise
-                except urllib.error.URLError:
-                    if time.monotonic() + backoff >= deadline:
-                        raise
-                _metrics.CHECKPOINT_RETRIES.labels(transport="http").inc()
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 1.0)
+            # Retry/backoff policy: _FETCH_POLICY (module top) — retryable
+            # 503s and connection errors poll until the receiver's deadline.
+            def attempt(budget: "Optional[float]"):
+                t = max(budget if budget is not None else 0.001, 0.001)
+                with urllib.request.urlopen(f"{base}/{path}", timeout=t) as resp:
+                    _metrics.CHECKPOINT_BYTES.labels(
+                        transport="http", direction="recv"
+                    ).inc(int(resp.headers.get("Content-Length") or 0))
+                    return ser.deserialize_from(resp, into=into)
+
+            return _FETCH_POLICY.run(
+                attempt,
+                timeout=max(deadline - time.monotonic(), 0.001),
+                op="transport.http.fetch",
+                on_retry=lambda e, n, d: _metrics.CHECKPOINT_RETRIES.labels(
+                    transport="http"
+                ).inc(),
+            )
 
         def _done() -> None:
             _metrics.CHECKPOINT_DURATION.labels(

@@ -20,7 +20,9 @@ import numpy as np
 
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.parallel.process_group import ProcessGroup
+from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.futures import context_timeout
 
 logger = logging.getLogger(__name__)
 
@@ -34,7 +36,11 @@ class PGTransport(CheckpointTransport[Any]):
     Args:
         pg: the (replica-dimension) process group; src/dst ranks are replica
             ranks within the current quorum.
-        timeout: per-transfer deadline.
+        timeout: per-transfer deadline.  Both directions ARM it: the whole
+            send/recv runs under a ``utils.futures.context_timeout`` whose
+            expiry callback is ``pg.abort`` — a dead peer mid-stream cannot
+            wedge healing past the deadline, because the abort closes the
+            sockets out from under every queued op.
         state_dict_fn: optional callable returning a same-structure state
             dict whose buffers are received into (in-place fast path).
     """
@@ -57,6 +63,7 @@ class PGTransport(CheckpointTransport[Any]):
     ) -> None:
         from torchft_tpu.checkpointing.serialization import _flatten, _leaf_meta
 
+        _faults.check("transport.send", step=step)
         skeleton, leaves = _flatten(state_dict)
         metas = []
         arrays: List[Optional[np.ndarray]] = []
@@ -70,24 +77,28 @@ class PGTransport(CheckpointTransport[Any]):
         )
         t0 = time.perf_counter()
         nbytes = header.nbytes + sum(a.nbytes for a in arrays if a is not None)
-        for dst in dst_ranks:
-            # submit the whole stream, then reap: the PG worker executes
-            # in submission order, and keeping its queue non-empty lets it
-            # drain the socket continuously instead of idling one
-            # thread-wakeup round trip per leaf
-            works = [self._pg.send(header, dst, tag=_META_TAG)]
-            for i, arr in enumerate(arrays):
-                if arr is not None:
-                    works.append(
-                        self._pg.send(
-                            arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
+        # Armed per-transfer deadline: a receiver that dies mid-stream
+        # leaves sends wedged on full socket buffers; expiry aborts the PG,
+        # failing every queued op fast instead of wedging healing.
+        with context_timeout(self._pg.abort, timeout):
+            for dst in dst_ranks:
+                # submit the whole stream, then reap: the PG worker executes
+                # in submission order, and keeping its queue non-empty lets it
+                # drain the socket continuously instead of idling one
+                # thread-wakeup round trip per leaf
+                works = [self._pg.send(header, dst, tag=_META_TAG)]
+                for i, arr in enumerate(arrays):
+                    if arr is not None:
+                        works.append(
+                            self._pg.send(
+                                arr.reshape(-1).view(np.uint8), dst, tag=_TENSOR_TAG + i
+                            )
                         )
-                    )
-            for w in works:
-                w.wait(timeout=timeout)
-            _metrics.CHECKPOINT_BYTES.labels(
-                transport="pg", direction="send"
-            ).inc(nbytes)
+                for w in works:
+                    w.wait(timeout=timeout)
+                _metrics.CHECKPOINT_BYTES.labels(
+                    transport="pg", direction="send"
+                ).inc(nbytes)
         _metrics.CHECKPOINT_DURATION.labels(
             transport="pg", direction="send"
         ).observe(time.perf_counter() - t0)
@@ -95,7 +106,17 @@ class PGTransport(CheckpointTransport[Any]):
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
+        _faults.check("transport.recv", step=step)
         t0 = time.perf_counter()
+        # Armed per-transfer deadline (see send_checkpoint): expiry aborts
+        # the PG so a dead/stalled sender cannot wedge healing — the
+        # receiving replica latches the error and re-heals next quorum.
+        with context_timeout(self._pg.abort, timeout):
+            return self._recv_checkpoint(src_rank, step, timeout, t0)
+
+    def _recv_checkpoint(
+        self, src_rank: int, step: int, timeout: float, t0: float
+    ) -> Any:
         header_bytes = self._pg.recv(src_rank, tag=_META_TAG).wait(timeout=timeout)
         header = pickle.loads(header_bytes.tobytes())
         if header["step"] != step:

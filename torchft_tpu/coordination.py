@@ -25,6 +25,8 @@ from datetime import timedelta
 from typing import Any, Dict, List, Optional
 
 from torchft_tpu import _native
+from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils.retry import RetryPolicy
 
 __all__ = [
     "LighthouseServer",
@@ -163,12 +165,38 @@ class RpcError(RuntimeError):
     pass
 
 
-class _RpcClient:
-    """Persistent framed-JSON connection; reconnects with backoff on failure."""
+# Connect retry: the same curve the old ad-hoc loop used (100ms base,
+# x1.5, 10s cap) plus full jitter so replicas re-dialing a restarted
+# server do not dogpile it in lockstep.  Retryable: any OSError (refused,
+# unreachable, per-attempt socket timeout) until the deadline budget —
+# the budget, not the attempt count, bounds the wait.
+_CONNECT_POLICY = RetryPolicy(
+    name="rpc.connect",
+    base_delay=0.1,
+    multiplier=1.5,
+    max_delay=10.0,
+    retryable=(OSError,),
+)
 
-    def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
+
+class _RpcClient:
+    """Persistent framed-JSON connection; reconnects with backoff on failure.
+
+    ``fault_site``: optional chaos injection site consulted inside each
+    call's send/recv attempt (utils/faults.py) — an injected ``drop`` takes
+    exactly the broken-connection code path, an injected ``raise`` escapes
+    like any non-connection error.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: float = 10.0,
+        fault_site: "Optional[str]" = None,
+    ) -> None:
         self._addr = addr
         self._connect_timeout = connect_timeout
+        self._fault_site = fault_site
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -177,34 +205,50 @@ class _RpcClient:
 
     def _connect(self, deadline: float) -> socket.socket:
         host, port = self._host_port()
-        backoff = 0.1
-        last_err: Exception = TimeoutError("connect: no attempt made")
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"timeout connecting to {self._addr}: {last_err}"
-                )
-            try:
-                sock = socket.create_connection(
-                    (host, port), timeout=min(remaining, 5.0)
-                )
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                return sock
-            except OSError as e:
-                last_err = e
-                time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
-                backoff = min(backoff * 1.5, 10.0)
+
+        def attempt(budget: "Optional[float]") -> socket.socket:
+            sock = socket.create_connection(
+                (host, port), timeout=min(budget if budget else 5.0, 5.0)
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+
+        try:
+            return _CONNECT_POLICY.run(
+                attempt,
+                timeout=max(deadline - time.monotonic(), 0.0),
+                op="rpc.connect",
+            )
+        except TimeoutError as e:
+            raise TimeoutError(
+                f"timeout connecting to {self._addr}: {e.__cause__ or e}"
+            ) from e
 
     def call(
-        self, method: str, params: Dict[str, Any], timeout: "float | timedelta"
+        self,
+        method: str,
+        params: Dict[str, Any],
+        timeout: "float | timedelta",
+        idempotent: bool = True,
     ) -> Dict[str, Any]:
+        """One RPC round trip.
+
+        ``idempotent``: when True (default) a call that dies on a broken
+        connection is re-sent ONCE after reconnecting (e.g. the server
+        restarted between calls on this pooled connection).  A re-send can
+        double-deliver a request whose first copy was applied before the
+        connection died, so non-idempotent methods — ``should_commit``
+        votes, whose double delivery could corrupt the commit barrier —
+        must pass False and surface the ConnectionError to their caller
+        instead.
+        """
         timeout_s = (
             timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
         )
         deadline = time.monotonic() + timeout_s
+        attempts = 2 if idempotent else 1
         with self._lock:
-            for attempt in range(2):
+            for attempt in range(attempts):
                 if self._sock is None:
                     self._sock = self._connect(
                         min(deadline, time.monotonic() + self._connect_timeout)
@@ -217,6 +261,8 @@ class _RpcClient:
                     }
                 ).encode()
                 try:
+                    if self._fault_site is not None:
+                        _faults.check(self._fault_site)
                     self._sock.settimeout(max(deadline - time.monotonic(), 0.001))
                     self._sock.sendall(struct.pack(">I", len(payload)) + payload)
                     reply = self._recv_frame(deadline)
@@ -227,7 +273,7 @@ class _RpcClient:
                         raise TimeoutError(
                             f"rpc {method} to {self._addr} timed out: {e}"
                         ) from e
-                    if attempt == 1:
+                    if attempt == attempts - 1:
                         # Connection-level failure, not a deadline: report it
                         # as such so callers can tell a crashed server from a
                         # protocol wait expiring.
@@ -430,7 +476,7 @@ class LighthouseClient:
             if isinstance(connect_timeout, timedelta)
             else connect_timeout
         )
-        self._client = _RpcClient(addr, ct)
+        self._client = _RpcClient(addr, ct, fault_site="lighthouse.rpc")
 
     def quorum(
         self,
@@ -530,11 +576,18 @@ class ManagerClient:
         timeout: "float | timedelta",
     ) -> bool:
         """Vote on committing ``step``; blocks until all group ranks vote and
-        returns the AND across them (reference src/manager.rs:423-479)."""
+        returns the AND across them (reference src/manager.rs:423-479).
+
+        Non-idempotent on the wire: a blind re-send after a broken
+        connection could deliver this rank's vote twice (e.g. across a
+        server restart) and release the barrier with a stale tally, so a
+        connection failure surfaces to the Manager — which votes False and
+        lets the protocol's normal abstain path handle it."""
         result = self._client.call(
             "should_commit",
             {"group_rank": group_rank, "step": step, "should_commit": should_commit},
             timeout,
+            idempotent=False,
         )
         return result["should_commit"]
 
@@ -569,6 +622,10 @@ class StoreClient:
         self, key: str, timeout: "float | timedelta" = 10.0, wait: bool = True
     ) -> str:
         """Read ``key``; with ``wait`` blocks until it is set or timeout."""
+        if wait:
+            # the blocking rendezvous wait PG configure / manager discovery
+            # park on — the chaos layer's store-barrier injection site
+            _faults.check("store.barrier")
         result = self._client.call("get", {"key": key, "wait": wait}, timeout)
         return result["value"]
 

@@ -35,6 +35,7 @@ import optax
 
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.work import Work
+from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -100,6 +101,13 @@ class LocalSGD:
 
     def sync(self) -> None:
         """Average parameters across the quorum (reference :112-173)."""
+        # chaos site: a raise here is a replica crash at the semi-sync
+        # boundary — the worst moment, mid-divergence from the backup
+        _faults.check(
+            "local_sgd.sync",
+            replica=self._manager.replica_id(),
+            step=self._manager.current_step(),
+        )
         self._local_step = 0
         self._manager.start_quorum()
         params = self._get_params()
@@ -362,6 +370,13 @@ class DiLoCo:
         self._local_step += 1
 
         if self._local_step == self._cycle - self._fragment_sync_delay:
+            # chaos site: replica crash at the fragment-sync boundary (the
+            # DiLoCo analog of LocalSGD.sync's injection point)
+            _faults.check(
+                "local_sgd.sync",
+                replica=self._manager.replica_id(),
+                step=self._manager.current_step(),
+            )
             self._manager.start_quorum()
             fragment = self._current_fragment()
             logger.info("preparing fragment=%d step=%d", fragment, self._local_step)

@@ -17,7 +17,12 @@ JAX-first adaptations:
 Env knobs (parity with reference manager.py:76-89):
 ``TORCHFT_LIGHTHOUSE``, ``TORCHFT_MANAGER_PORT``, ``TORCHFT_TIMEOUT_SEC``,
 ``TORCHFT_QUORUM_TIMEOUT_SEC``, ``TORCHFT_CONNECT_TIMEOUT_SEC``,
-``TORCHFT_QUORUM_RETRIES``.
+``TORCHFT_QUORUM_RETRIES`` (quorum RPC attempts on connection failure,
+with exponential backoff + full jitter via ``utils.retry.RetryPolicy``
+inside the quorum timeout budget — no longer a bare loop count).
+Chaos: ``TORCHFT_FAULTS`` / ``TORCHFT_FAULTS_SEED`` (utils/faults.py)
+inject failures at ``manager.quorum`` / ``manager.heal`` /
+``pg.allreduce`` (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -41,9 +46,11 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, StoreServer
 from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_SUM
 from torchft_tpu.parallel.work import Work, completed_work
+from torchft_tpu.utils import faults as faults
 from torchft_tpu.utils import metrics as metrics
 from torchft_tpu.utils import tracing as tracing
 from torchft_tpu.utils.logging import ReplicaLogger, log_event
+from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
 
 logger = logging.getLogger(__name__)
@@ -135,6 +142,20 @@ class Manager:
         self._replica_world_size_mode = world_size_mode
         self._init_sync = init_sync
         self._max_retries = max_retries
+        # Real backoff semantics for quorum_retries (previously only a bare
+        # loop count inside the native server): connection-level failures of
+        # the quorum RPC retry with exponential backoff + full jitter, all
+        # inside the quorum timeout budget.  TimeoutError is NOT retried —
+        # the budget expiring IS the failure — and RpcError is not either
+        # (the server already applied its own lighthouse retries).
+        self._quorum_policy = RetryPolicy(
+            name="manager.quorum",
+            max_attempts=max(quorum_retries, 0) + 1,
+            base_delay=0.25,
+            multiplier=2.0,
+            max_delay=5.0,
+            retryable=(ConnectionError,),
+        )
 
         self._group_rank = (
             group_rank if group_rank is not None else int(os.environ.get("RANK", 0))
@@ -235,23 +256,36 @@ class Manager:
         # — probe the endpoint and re-read until a live server answers
         # (bounded by connect_timeout), instead of wiring this Manager to
         # a corpse for its whole lifetime.
-        deadline = time.monotonic() + self._connect_timeout
-        while True:
-            addr = store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout)
-            if self._manager_server is not None or self._endpoint_alive(addr):
-                # read the id AFTER the probe succeeds: rank 0 publishes
-                # replica_id before manager_addr, so a live addr implies
-                # the matching incarnation's id is already visible
-                self._replica_id = store.get(
-                    REPLICA_ID_KEY, timeout=self._connect_timeout
+        def _probe(budget: "Optional[float]") -> str:
+            probe_timeout = (
+                self._connect_timeout if budget is None else max(budget, 0.001)
+            )
+            addr = store.get(MANAGER_ADDR_KEY, timeout=probe_timeout)
+            if self._manager_server is None and not self._endpoint_alive(addr):
+                raise ConnectionError(
+                    f"manager server at {addr} (from store) not accepting "
+                    f"connections yet"
                 )
-                break
-            if time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"manager server at {addr} (from store) unreachable "
-                    f"within connect_timeout={self._connect_timeout}s"
-                )
-            time.sleep(0.25)
+            return addr
+
+        try:
+            addr = RetryPolicy(
+                name="manager.store_probe",
+                base_delay=0.25,
+                multiplier=1.0,
+                max_delay=0.25,
+                jitter=False,
+                retryable=(ConnectionError,),
+            ).run(_probe, timeout=self._connect_timeout)
+        except TimeoutError as e:
+            raise TimeoutError(
+                f"manager server (from store) unreachable within "
+                f"connect_timeout={self._connect_timeout}s: {e.__cause__ or e}"
+            ) from e
+        # read the id AFTER the probe succeeds: rank 0 publishes replica_id
+        # before manager_addr, so a live addr implies the matching
+        # incarnation's id is already visible
+        self._replica_id = store.get(REPLICA_ID_KEY, timeout=self._connect_timeout)
         self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
         store.close()
 
@@ -259,22 +293,31 @@ class Manager:
         # Opt-in per-manager scrape endpoint (TORCHFT_METRICS_PORT);
         # process-wide singleton, so multi-manager tests don't fight.
         metrics.maybe_serve_from_env()
+        # Metric labels use the STABLE replica id (the prefix before the
+        # ':<uuid>' incarnation suffix): every restart would otherwise mint
+        # a fresh label value, growing the process-wide registry without
+        # bound across crash-and-heal cycles and resetting each series'
+        # counters (breaking rate() continuity).  Events/logs keep the full
+        # incarnation id — they are records, not series.
+        self._metric_replica_id = (
+            self._replica_id.split(":", 1)[0] or self._replica_id
+        )
         # Bound metric children cached per replica: the labels() lookup is
         # ~9 us and _record_phase sits on the step hot path — caching keeps
         # the telemetry cost per phase at the observe() itself (~1 us).
         self._phase_hist: Dict[str, Any] = {}
         self._m_allreduces = metrics.ALLREDUCES.labels(
-            replica_id=self._replica_id
+            replica_id=self._metric_replica_id
         )
         self._m_commits = {
             result: metrics.COMMITS.labels(
-                replica_id=self._replica_id, result=result
+                replica_id=self._metric_replica_id, result=result
             )
             for result in ("success", "failure")
         }
-        self._m_step = metrics.STEP.labels(replica_id=self._replica_id)
+        self._m_step = metrics.STEP.labels(replica_id=self._metric_replica_id)
         self._m_participants = metrics.PARTICIPANTS.labels(
-            replica_id=self._replica_id
+            replica_id=self._metric_replica_id
         )
 
     @staticmethod
@@ -383,14 +426,26 @@ class Manager:
         try:
             t_rpc = time.perf_counter()
             with jax.profiler.TraceAnnotation("torchft::manager::_client::_quorum"):
-                quorum = self._client._quorum(
-                    group_rank=self._group_rank,
-                    step=self._step,
-                    checkpoint_metadata=self._checkpoint_transport.metadata(),
-                    shrink_only=shrink_only,
-                    timeout=quorum_timeout,
-                    init_sync=self._init_sync,
-                    commit_failures=self._commit_failures,
+
+                def _quorum_rpc(budget: "Optional[float]") -> Any:
+                    # chaos site INSIDE the retry policy: an injected drop
+                    # (ConnectionError) exercises the quorum_retries backoff
+                    # path; an injected raise escapes to report_error
+                    faults.check(
+                        "manager.quorum", replica=self._replica_id, step=self._step
+                    )
+                    return self._client._quorum(
+                        group_rank=self._group_rank,
+                        step=self._step,
+                        checkpoint_metadata=self._checkpoint_transport.metadata(),
+                        shrink_only=shrink_only,
+                        timeout=budget if budget is not None else quorum_timeout,
+                        init_sync=self._init_sync,
+                        commit_failures=self._commit_failures,
+                    )
+
+                quorum = self._quorum_policy.run(
+                    _quorum_rpc, timeout=quorum_timeout, op="manager.quorum"
                 )
             self._record_phase("quorum_rpc", time.perf_counter() - t_rpc)
         except Exception as e:  # noqa: BLE001 - captured into the protocol
@@ -423,7 +478,7 @@ class Manager:
                 self._participating_replica_rank = None
 
         if quorum.quorum_id != self._quorum_id:
-            metrics.QUORUM_CHANGES.labels(replica_id=self._replica_id).inc()
+            metrics.QUORUM_CHANGES.labels(replica_id=self._metric_replica_id).inc()
             log_event(
                 "quorum",
                 "quorum changed",
@@ -470,6 +525,9 @@ class Manager:
 
         try:
             if quorum.recover_dst_replica_ranks:
+                faults.check(
+                    "manager.heal", replica=self._replica_id, step=quorum.max_step
+                )
                 self._logger.info(
                     f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                 )
@@ -485,7 +543,7 @@ class Manager:
                     )
                 self._record_phase("heal_send", time.perf_counter() - t_send)
                 metrics.HEALS.labels(
-                    replica_id=self._replica_id, direction="send"
+                    replica_id=self._metric_replica_id, direction="send"
                 ).inc()
                 log_event(
                     "heal",
@@ -500,6 +558,9 @@ class Manager:
                 )
 
             if quorum.heal:
+                faults.check(
+                    "manager.heal", replica=self._replica_id, step=quorum.max_step
+                )
                 self._healing = True
                 t_recv = time.perf_counter()
                 self._logger.info(
@@ -532,7 +593,7 @@ class Manager:
                 self._step = quorum.max_step
                 self._record_phase("heal_recv", time.perf_counter() - t_recv)
                 metrics.HEALS.labels(
-                    replica_id=self._replica_id, direction="recv"
+                    replica_id=self._metric_replica_id, direction="recv"
                 ).inc()
                 log_event(
                     "heal",
@@ -629,6 +690,9 @@ class Manager:
 
         self._m_allreduces.inc()
         try:
+            faults.check(
+                "pg.allreduce", replica=self._replica_id, step=self._step
+            )
             t_submit = time.perf_counter()
             if should_quantize:
                 from torchft_tpu.ops.collectives import allreduce_quantized
@@ -687,7 +751,7 @@ class Manager:
         """Latch an async error; the current step will not be committed
         (reference manager.py:469-482)."""
         self._errored = e
-        metrics.ERRORS.labels(replica_id=self._replica_id).inc()
+        metrics.ERRORS.labels(replica_id=self._metric_replica_id).inc()
         log_event(
             "error",
             str(e),
@@ -725,12 +789,23 @@ class Manager:
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
         t_commit = time.perf_counter()
-        should_commit = self._client.should_commit(
-            self._group_rank,
-            self._step,
-            local_should_commit,
-            timeout=_to_sec(timeout, self._timeout),
-        )
+        try:
+            should_commit = self._client.should_commit(
+                self._group_rank,
+                self._step,
+                local_should_commit,
+                timeout=_to_sec(timeout, self._timeout),
+            )
+        except ConnectionError as e:
+            # The vote RPC is non-idempotent (no blind resend — a double-
+            # delivered vote could release the barrier with a stale tally),
+            # so a broken connection surfaces here.  Abstain: latch the
+            # error and treat the step as uncommitted — if the group did
+            # commit without us, our step falls behind and the next quorum
+            # heals us, the same path as any other failed step.
+            self._logger.exception(f"should_commit rpc failed, abstaining: {e}")
+            self.report_error(e)
+            should_commit = False
         self._record_phase("commit", time.perf_counter() - t_commit)
         self._m_commits["success" if should_commit else "failure"].inc()
         self._m_participants.set(self.num_participants())
@@ -810,7 +885,7 @@ class Manager:
             # benign race: concurrent creators both resolve to the same
             # underlying child (labels() is keyed), last write wins
             child = metrics.QUORUM_DURATION.labels(
-                replica_id=self._replica_id, phase=name
+                replica_id=self._metric_replica_id, phase=name
             )
             self._phase_hist[name] = child
         child.observe(dt)

@@ -48,6 +48,7 @@ import numpy as np
 
 from torchft_tpu.coordination import StoreClient
 from torchft_tpu.parallel.work import Work, completed_work, failed_work
+from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -396,6 +397,9 @@ class ProcessGroupTCP(ProcessGroup):
     def configure(
         self, store_addr: str, replica_id: str, rank: int, world_size: int
     ) -> None:
+        # chaos site: a reconfigure failure here surfaces to the Manager's
+        # configure try-block, which latches it and re-forms next quorum
+        _faults.check("pg.reconfigure", replica=replica_id)
         self._teardown()
         deadline = time.monotonic() + self._timeout
 
@@ -1615,6 +1619,7 @@ class ProcessGroupBaby(ProcessGroup):
     def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
         import multiprocessing as mp
 
+        _faults.check("pg.reconfigure", replica=replica_id)
         self._kill_worker()
         self._errored_exc = None
         self._rank = rank

@@ -1,3 +1,10 @@
+from torchft_tpu.utils.faults import (
+    FAULTS,
+    FaultRegistry,
+    FaultRule,
+    InjectedConnectionDrop,
+    InjectedFault,
+)
 from torchft_tpu.utils.futures import (
     context_timeout,
     future_timeout,
@@ -15,15 +22,22 @@ from torchft_tpu.utils.metrics import (
     histogram,
     parse_text_exposition,
 )
+from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
 
 __all__ = [
     "Counter",
+    "FAULTS",
+    "FaultRegistry",
+    "FaultRule",
     "Gauge",
     "Histogram",
+    "InjectedConnectionDrop",
+    "InjectedFault",
     "MetricsHTTPServer",
     "REGISTRY",
     "RWLock",
+    "RetryPolicy",
     "context_timeout",
     "counter",
     "future_timeout",

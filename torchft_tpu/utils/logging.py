@@ -45,6 +45,8 @@ _LOGGERS = {
     # reconfiguration (mirror _SEVERITY in utils/otel.py when extending)
     "heal": logging.getLogger("torchft_heals"),
     "reconfigure": logging.getLogger("torchft_reconfigures"),
+    # chaos layer: every injected fault (utils/faults.py)
+    "fault": logging.getLogger("torchft_faults"),
 }
 
 _lock = threading.Lock()

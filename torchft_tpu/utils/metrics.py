@@ -907,3 +907,18 @@ DILOCO_WIRE_BYTES = gauge(
     "actual when available, else payload bytes)",
     ("fragment",),
 )
+FAULTS_INJECTED = counter(
+    "torchft_faults_injected_total",
+    "Chaos faults injected by site and action (utils/faults.py registry)",
+    ("site", "action"),
+)
+RETRIES = counter(
+    "torchft_retries_total",
+    "RetryPolicy retries by operation (utils/retry.py)",
+    ("op",),
+)
+RETRY_BACKOFF = histogram(
+    "torchft_retry_backoff_seconds",
+    "Backoff slept before each retry attempt, by operation",
+    ("op",),
+)

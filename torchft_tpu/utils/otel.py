@@ -58,6 +58,9 @@ _SEVERITY = {
     "abort": (17, "ERROR"),
     "heal": (9, "INFO"),
     "reconfigure": (9, "INFO"),
+    # injected chaos faults are deliberate, but a collector should still
+    # be able to alert on them leaking into a production deployment
+    "fault": (13, "WARN"),
 }
 
 
