@@ -18,7 +18,7 @@ Measurements, one JSON line:
    IDENTICAL ring allreduce — one driven through the Manager protocol
    (per-step quorum RPC + commit vote + error tracking), one bare
    ProcessGroupTCP configured once.  overhead = ft/bare - 1.  The
-   per-phase breakdown comes from ``Manager.pop_phase_times()``
+   per-phase breakdown comes from ``Manager.phase_times()`` deltas
    (quorum_wait / host_sync / ring / commit).  Harness shape mirrors the
    reference's transport benches (reference:
    torchft/checkpointing/pg_transport_bench.py:24-95).
@@ -75,6 +75,15 @@ RECOVERY_CYCLES = 3  # independent kill/rejoin cycles; median is the headline
 
 OVERHEAD_WARMUP = 5
 OVERHEAD_STEPS = 30
+
+
+def _phase_delta(manager, prev: "Dict[str, float]"):
+    """Per-step phase delta from the NON-destructive ``phase_times()``
+    snapshot (``pop_phase_times`` is deprecated — a destructive drain
+    corrupts any concurrent scraper).  Returns ``(delta, new_snapshot)``;
+    thread the snapshot through the loop."""
+    cur = manager.phase_times()
+    return {k: v - prev.get(k, 0.0) for k, v in cur.items()}, cur
 
 
 def log(msg: str) -> None:
@@ -174,7 +183,7 @@ class Replica:
                         self.bench.t_healthy = time.perf_counter()
                         # phases accumulated since this (fresh) Manager was
                         # built == exactly the recovery step's protocol work
-                        self.bench.healed_phases = manager.pop_phase_times()
+                        self.bench.healed_phases = manager.phase_times()
                         log(f"replica {self.replica_id}: healthy commit at "
                             f"step {manager.current_step()} after heal "
                             f"(quorum+heal+step {time.perf_counter() - t0:.3f}s)")
@@ -260,7 +269,7 @@ class RecoveryBench:
 
         # Phase breakdown of kill -> healthy commit.  teardown + manager
         # re-init happen before the healed Manager exists; the rest comes
-        # from its pop_phase_times().  quorum_rpc / pg_configure /
+        # from its phase_times().  quorum_rpc / pg_configure /
         # heal_recv run on the async-quorum thread and are what the
         # caller-side quorum_wait was waiting FOR (they overlap it, not
         # add to it); ring + commit are the healed step's collective and
@@ -420,6 +429,7 @@ def _ft_replica(
     try:
         times: "List[float]" = []
         acc: "Dict[str, float]" = {}
+        phase_snap: "Dict[str, float]" = {}
         barrier.wait(timeout=30)
         cpu0 = time.process_time()
         cpu_marked = False
@@ -444,7 +454,7 @@ def _ft_replica(
             if manager.should_commit():
                 state["params"] -= 0.1 * avg["g"]
                 times.append(time.perf_counter() - t0)
-                phase = manager.pop_phase_times()
+                phase, phase_snap = _phase_delta(manager, phase_snap)
                 if step >= warmup:
                     for k, v in phase.items():
                         acc[k] = acc.get(k, 0.0) + v
@@ -535,7 +545,7 @@ def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
 
     The two twins run identical numpy compute and the identical ring
     allreduce; the FT twin adds exactly the Manager protocol phases, which
-    ``pop_phase_times`` measures per step at perf_counter precision:
+    ``phase_times`` deltas measure per step at perf_counter precision:
     ``quorum_wait`` + ``commit`` + ``host_sync`` (``ring`` is common to
     both twins and excluded).  Headline ``overhead_pct`` = added protocol
     ms / bare step ms.
@@ -1142,7 +1152,7 @@ def _ft_around_model_step(
     on-device proxy leaf + commit vote) and prices the protocol against
     the bare fused-dispatch step time measured by the difference method.
 
-    Measurement is the phase-sum estimator (``pop_phase_times``), not a
+    Measurement is the phase-sum estimator (``phase_times`` deltas), not a
     twin wall-clock ratio — the loop's wall time is tunnel-RTT-bound
     (~200 ms/dispatch under the driver) and means nothing.  The headline
     ``model_overhead_pct`` counts quorum_wait + commit + host_sync: the
@@ -1173,6 +1183,7 @@ def _ft_around_model_step(
     )
     manager = None
     acc: "Dict[str, float]" = {}
+    phase_snap: "Dict[str, float]" = {}
     ring_ms: "List[float]" = []
     try:
         manager = Manager(
@@ -1202,7 +1213,7 @@ def _ft_around_model_step(
             work.wait(timeout=30)
             committed = manager.should_commit()
             assert committed, "world-1 FT step failed to commit"
-            phase = manager.pop_phase_times()
+            phase, phase_snap = _phase_delta(manager, phase_snap)
             if step >= warmup:
                 ring_ms.append(phase.get("ring", 0.0) * 1e3)
                 for k, v in phase.items():
@@ -1390,8 +1401,8 @@ def bench_model() -> "Dict[str, Any]":
 def main() -> None:
     # Opt-in live scrape surface for long runs: TORCHFT_METRICS_PORT serves
     # the telemetry registry (phase histograms, abort/heal counters) this
-    # bench's Managers populate — watchable mid-run without touching the
-    # destructive pop_phase_times() accumulator the estimators drain.
+    # bench's Managers populate — watchable mid-run alongside the
+    # non-destructive phase_times() snapshots the estimators diff.
     from torchft_tpu.utils import metrics as _metrics
 
     _metrics.maybe_serve_from_env()

@@ -158,6 +158,21 @@ int tft_lighthouse_set_metrics_provider(int64_t h,
   return 0;
 }
 
+// Record a replica group's training progress on its manager server; the
+// heartbeat loop piggybacks it on lighthouse heartbeats (straggler
+// telemetry — see ManagerServer::report_progress).
+int tft_manager_report_progress(int64_t h, int64_t step,
+                                const char* inflight_op) {
+  tft::RpcServer* s = find_server(h);
+  auto* manager = dynamic_cast<tft::ManagerServer*>(s);
+  if (manager == nullptr) {
+    g_last_error = "bad manager handle";
+    return -1;
+  }
+  manager->report_progress(step, inflight_op ? inflight_op : "");
+  return 0;
+}
+
 // Pure quorum-result math, exposed for unit tests: input/output JSON.
 char* tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
                                  const char* quorum_json, int init_sync) {

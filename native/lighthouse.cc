@@ -5,6 +5,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cstdio>
 #include <set>
 #include <sstream>
 
@@ -15,6 +16,19 @@ int64_t wall_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(
              std::chrono::system_clock::now().time_since_epoch())
       .count();
+}
+
+// Prometheus label-value escaping (backslash, quote, newline).
+std::string escape_label(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
 }
 }  // namespace
 
@@ -231,15 +245,9 @@ Json LighthouseServer::handle(const std::string& method, const Json& params,
                               int64_t timeout_ms) {
   if (method == "quorum") return rpc_quorum(params, timeout_ms);
   if (method == "heartbeat") return rpc_heartbeat(params);
-  if (method == "status") {
-    std::lock_guard<std::mutex> g(mu_);
-    Json out = Json::object();
-    out["quorum_id"] = quorum_id_;
-    out["reason"] = last_reason_;
-    out["num_participants"] = static_cast<int64_t>(participants_.size());
-    if (prev_quorum_.has_value()) out["prev_quorum"] = prev_quorum_->to_json();
-    return out;
-  }
+  // One status document for the RPC and GET /status.json: the dashboard
+  // schema IS the programmatic schema (tests assert they round-trip).
+  if (method == "status") return status_json();
   throw std::runtime_error("lighthouse: unknown method " + method);
 }
 
@@ -265,8 +273,10 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
           "superseded by a newer incarnation of this replica");
     }
   }
-  // Implicit heartbeat + registration.
+  // Implicit heartbeat + registration (+ progress: the member's step is
+  // the freshest progress signal the straggler table can get).
   heartbeats_[requester.replica_id] = now;
+  note_progress_locked(requester.replica_id, requester.step, 0, "quorum", now);
   int64_t my_token = ++next_reg_token_;
   participants_[requester.replica_id] = {requester, now, my_token};
   // Fast-restart supersession: replica ids carry a ":uuid" incarnation
@@ -301,6 +311,7 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
             prefix_of(it->first) == new_prefix) {
           evicted_at_ms_[it->first] = now;
           participants_.erase(it->first);
+          progress_.erase(it->first);
           it = heartbeats_.erase(it);
         } else {
           ++it;
@@ -406,8 +417,79 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
     out["superseded"] = true;
     return out;
   }
-  heartbeats_[rid] = now_ms();
+  int64_t now = now_ms();
+  heartbeats_[rid] = now;
+  // Progress piggyback (optional params; a bare heartbeat stays valid):
+  // step/last_step_wall_ms/inflight_op feed per-replica step-lag and
+  // straggler-score telemetry.
+  int64_t step = params.get("step").as_int(-1);
+  if (step >= 0) {
+    note_progress_locked(rid, step, params.get("last_step_wall_ms").as_int(0),
+                         params.get("inflight_op").as_string(), now);
+  }
   return out;
+}
+
+void LighthouseServer::note_progress_locked(const std::string& rid,
+                                            int64_t step,
+                                            int64_t last_step_wall_ms,
+                                            const std::string& inflight_op,
+                                            int64_t now) {
+  if (step < 0) return;
+  ReplicaProgress& p = progress_[rid];
+  if (step > p.step) {
+    // Stamped on OBSERVED advance with the lighthouse clock: straggler
+    // ages stay meaningful without cross-host clock sync.
+    p.step = step;
+    p.step_changed_at_ms = now;
+  } else if (p.step_changed_at_ms == 0) {
+    p.step_changed_at_ms = now;  // first report at step 0
+  }
+  if (last_step_wall_ms > 0) p.last_step_wall_ms = last_step_wall_ms;
+  p.inflight_op = inflight_op;
+}
+
+std::vector<LighthouseServer::StragglerInfo>
+LighthouseServer::compute_stragglers_locked(int64_t now) {
+  // Rows: every replica the lighthouse still tracks (a heartbeats_ entry;
+  // superseded incarnations are pruned) that has reported progress.  A
+  // replica with a stale heartbeat stays in the table until eviction —
+  // the dead replica's growing lag/score is exactly the signal the
+  // operator needs BEFORE the quorum shrinks around it.
+  std::vector<StragglerInfo> rows;
+  int64_t max_step = 0;
+  for (const auto& [rid, p] : progress_) {
+    if (!heartbeats_.count(rid)) continue;
+    max_step = std::max(max_step, p.step);
+  }
+  std::vector<int64_t> fresh_ages;
+  for (const auto& [rid, p] : progress_) {
+    auto hb = heartbeats_.find(rid);
+    if (hb == heartbeats_.end()) continue;
+    StragglerInfo row;
+    row.replica_id = rid;
+    row.step = p.step;
+    row.step_lag = max_step - p.step;
+    row.progress_age_ms = std::max<int64_t>(now - p.step_changed_at_ms, 0);
+    row.last_step_wall_ms = p.last_step_wall_ms;
+    row.inflight_op = p.inflight_op;
+    row.stale = (now - hb->second) >= opt_.heartbeat_timeout_ms;
+    if (!row.stale) fresh_ages.push_back(row.progress_age_ms);
+    rows.push_back(std::move(row));
+  }
+  // Score = progress age normalized by the median age of replicas with a
+  // fresh heartbeat (~1 = typical cadence; a wedged or dead replica's
+  // score grows without bound).  Median over the fresh cohort so one dead
+  // replica cannot drag the baseline up and hide itself.
+  std::sort(fresh_ages.begin(), fresh_ages.end());
+  double median = fresh_ages.empty()
+                      ? 1.0
+                      : static_cast<double>(
+                            fresh_ages[fresh_ages.size() / 2]);
+  if (median < 1.0) median = 1.0;
+  for (auto& row : rows)
+    row.score = static_cast<double>(row.progress_age_ms) / median;
+  return rows;
 }
 
 void LighthouseServer::handle_http(int fd, const std::string& request_head) {
@@ -501,6 +583,26 @@ std::string LighthouseServer::render_metrics() {
           "heartbeat\n"
        << "# TYPE torchft_lighthouse_heartbeats_live gauge\n"
        << "torchft_lighthouse_heartbeats_live " << fresh << "\n";
+    // Straggler telemetry: per-replica step lag and score, computed from
+    // the progress piggybacked on heartbeat/quorum RPCs.  A dead replica
+    // keeps exporting a growing lag until it is superseded/evicted — the
+    // alerting window BEFORE the quorum shrinks around it.
+    auto stragglers = compute_stragglers_locked(now);
+    os << "# HELP torchft_replica_step_lag Steps behind the most advanced "
+          "tracked replica\n"
+       << "# TYPE torchft_replica_step_lag gauge\n";
+    for (const auto& s : stragglers)
+      os << "torchft_replica_step_lag{replica=\""
+         << escape_label(s.replica_id) << "\"} " << s.step_lag << "\n";
+    os << "# HELP torchft_straggler_score Progress age over the median "
+          "fresh-replica age (~1 = typical; large = straggling/dead)\n"
+       << "# TYPE torchft_straggler_score gauge\n";
+    for (const auto& s : stragglers) {
+      char buf[64];
+      snprintf(buf, sizeof(buf), "%.6g", s.score);
+      os << "torchft_straggler_score{replica=\""
+         << escape_label(s.replica_id) << "\"} " << buf << "\n";
+    }
   }
   {
     std::lock_guard<std::mutex> g(provider_mu_);
@@ -521,12 +623,15 @@ std::string LighthouseServer::render_metrics() {
   return os.str();
 }
 
-std::string LighthouseServer::render_status_json() {
+std::string LighthouseServer::render_status_json() { return status_json().dump(); }
+
+Json LighthouseServer::status_json() {
   std::lock_guard<std::mutex> g(mu_);
   int64_t now = now_ms();
   Json out = Json::object();
   out["quorum_id"] = quorum_id_;
   out["status"] = last_reason_;
+  out["reason"] = last_reason_;  // legacy status-RPC field name
   out["num_participants"] = static_cast<int64_t>(participants_.size());
   // live recompute, like the HTML page (reference lighthouse.rs:419)
   std::string live_reason;
@@ -541,6 +646,24 @@ std::string LighthouseServer::render_status_json() {
     hbs.push_back(h);
   }
   out["heartbeats"] = hbs;
+  // Straggler telemetry (same rows as /metrics and the dashboard table).
+  Json stragglers = Json::array();
+  int64_t max_step = 0;
+  for (const auto& s : compute_stragglers_locked(now)) {
+    Json row = Json::object();
+    row["replica_id"] = s.replica_id;
+    row["step"] = s.step;
+    row["step_lag"] = s.step_lag;
+    row["progress_age_ms"] = s.progress_age_ms;
+    row["last_step_wall_ms"] = s.last_step_wall_ms;
+    row["straggler_score"] = s.score;
+    row["inflight_op"] = s.inflight_op;
+    row["stale"] = s.stale;
+    stragglers.push_back(row);
+    max_step = std::max(max_step, s.step);
+  }
+  out["stragglers"] = stragglers;
+  out["max_step"] = max_step;
   if (prev_quorum_.has_value()) {
     Json q = Json::object();
     q["quorum_id"] = prev_quorum_->quorum_id;
@@ -551,19 +674,17 @@ std::string LighthouseServer::render_status_json() {
       max_step = std::max(max_step, p.step);
     Json parts = Json::array();
     for (const auto& p : prev_quorum_->participants) {
-      Json m = Json::object();
-      m["replica_id"] = p.replica_id;
-      m["address"] = p.address;
-      m["store_address"] = p.store_address;
-      m["step"] = p.step;
-      m["world_size"] = p.world_size;
+      // full member fields (the pre-unification status RPC served
+      // QuorumMember::to_json — consumers may rely on any of them) plus
+      // the dashboard's derived "recovering" flag
+      Json m = p.to_json();
       m["recovering"] = p.step < max_step;
       parts.push_back(m);
     }
     q["participants"] = parts;
     out["prev_quorum"] = q;
   }
-  return out.dump();
+  return out;
 }
 
 std::string LighthouseServer::render_status_html() {
@@ -613,6 +734,26 @@ std::string LighthouseServer::render_status_html() {
          << "/kill\"><button>kill</button></form></td></tr>";
     }
     os << "</table>";
+  }
+  {
+    auto stragglers = compute_stragglers_locked(now);
+    if (!stragglers.empty()) {
+      os << "<h2>straggler telemetry</h2>"
+         << "<table><tr><th>replica</th><th>step</th><th>step lag</th>"
+         << "<th>progress age (ms)</th><th>score</th><th>in-flight op</th>"
+         << "<th>heartbeat</th></tr>";
+      for (const auto& s : stragglers) {
+        char score[64];
+        snprintf(score, sizeof(score), "%.2f", s.score);
+        os << "<tr class=\"" << (s.stale ? "recovering" : "healthy")
+           << "\"><td>" << s.replica_id << "</td><td>" << s.step
+           << "</td><td>" << s.step_lag << "</td><td>" << s.progress_age_ms
+           << "</td><td>" << score << "</td><td>"
+           << (s.inflight_op.empty() ? "-" : s.inflight_op) << "</td><td>"
+           << (s.stale ? "stale" : "fresh") << "</td></tr>";
+      }
+      os << "</table>";
+    }
   }
   os << "<h2>pending participants (" << participants_.size() << ")</h2><ul>";
   for (const auto& [rid, det] : participants_)

@@ -118,12 +118,50 @@ class LighthouseServer : public RpcServer {
   std::string render_status_json();
   std::string render_metrics();
 
+  // Per-replica progress piggybacked on heartbeat/quorum RPCs — the
+  // straggler-telemetry substrate.  step_changed_at_ms is LIGHTHOUSE
+  // clock (stamped when a strictly larger step is first observed), so
+  // straggler math never depends on cross-host clock sync;
+  // last_step_wall_ms is the sender-clock stamp, reported for display.
+  struct ReplicaProgress {
+    int64_t step = -1;
+    int64_t step_changed_at_ms = 0;
+    int64_t last_step_wall_ms = 0;
+    std::string inflight_op;
+  };
+
+  // One straggler-table row (computed, not stored).
+  struct StragglerInfo {
+    std::string replica_id;
+    int64_t step = 0;
+    int64_t step_lag = 0;          // max tracked step - this step
+    int64_t progress_age_ms = 0;   // since last observed step advance
+    int64_t last_step_wall_ms = 0; // sender-clock stamp, as reported
+    double score = 0.0;            // age / median live age (~1 = typical)
+    std::string inflight_op;
+    bool stale = false;            // heartbeat past timeout
+  };
+
+ private:
+  // Record progress for rid (caller holds mu_).
+  void note_progress_locked(const std::string& rid, int64_t step,
+                            int64_t last_step_wall_ms,
+                            const std::string& inflight_op, int64_t now);
+  // Straggler table over replicas with a heartbeat entry AND progress
+  // (caller holds mu_).
+  std::vector<StragglerInfo> compute_stragglers_locked(int64_t now);
+  // The one status document served by the status RPC and /status.json
+  // (locks mu_ internally).
+  Json status_json();
+
   LighthouseOpt opt_;
 
   std::mutex mu_;
   std::condition_variable quorum_cv_;
   std::map<std::string, ParticipantDetails> participants_;
   std::map<std::string, int64_t> heartbeats_;
+  // replica_id -> progress (pruned with heartbeats_ on supersession).
+  std::map<std::string, ReplicaProgress> progress_;
   // Fast-restart supersession bookkeeping: id -> eviction wall time (ms).
   // Presence is the supersession stamp: an evicted incarnation can never
   // re-register, heartbeat, or evict its successor (one-directional — the

@@ -141,11 +141,35 @@ void ManagerServer::wake_blocked() {
   cv_.notify_all();
 }
 
+void ManagerServer::report_progress(int64_t step,
+                                    const std::string& inflight_op) {
+  std::lock_guard<std::mutex> g(mu_);
+  if (step != progress_step_) {
+    progress_step_ = step;
+    progress_wall_ms_ = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            std::chrono::system_clock::now()
+                                .time_since_epoch())
+                            .count();
+  }
+  progress_op_ = inflight_op;
+}
+
 void ManagerServer::heartbeat_loop() {
   RpcClient client(opt_.lighthouse_addr);
   while (!stopping_.load()) {
     Json params = Json::object();
     params["replica_id"] = opt_.replica_id;
+    // Piggyback training progress (straggler telemetry): once the Python
+    // Manager has reported a step, every heartbeat carries it so the
+    // lighthouse can compute per-replica step lag without extra RPCs.
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (progress_step_ >= 0) {
+        params["step"] = progress_step_;
+        params["last_step_wall_ms"] = progress_wall_ms_;
+        params["inflight_op"] = progress_op_;
+      }
+    }
     try {
       Json reply = client.call("heartbeat", params, opt_.connect_timeout_ms);
       if (reply.get("superseded").as_bool()) {

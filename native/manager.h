@@ -74,6 +74,12 @@ class ManagerServer : public RpcServer {
   void start_serving();
   void stop();
 
+  // Straggler telemetry: record this replica group's training progress;
+  // the heartbeat loop piggybacks it (step, last_step_wall_ms,
+  // inflight_op) on every lighthouse heartbeat.  Called by the Python
+  // Manager at quorum entry and after each commit.
+  void report_progress(int64_t step, const std::string& inflight_op);
+
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
@@ -100,6 +106,11 @@ class ManagerServer : public RpcServer {
   std::set<int64_t> commit_failures_;
   int64_t commit_round_seq_ = 0;
   bool commit_decision_ = false;
+
+  // progress state piggybacked on heartbeats (guarded by mu_)
+  int64_t progress_step_ = -1;
+  int64_t progress_wall_ms_ = 0;  // wall clock when step last advanced
+  std::string progress_op_;
 
   std::thread heartbeat_thread_;
   // Lighthouse quorum calls run on detached threads (bounded by the request

@@ -1,0 +1,424 @@
+"""torchft-diagnose tests: selftest wiring, culprit attribution units,
+and the tier-1 chaos smoke (kill one of two DDP replicas mid-step; every
+survivor dumps flight state on abort; diagnose names the killed replica
+and the failed phase; the lighthouse exports nonzero step lag for the
+dead replica before eviction)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu import diagnose
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+from torchft_tpu.utils import faults
+from torchft_tpu.utils import flightrecorder as fr
+from torchft_tpu.utils.faults import FaultRule, InjectedFault
+from torchft_tpu.utils.metrics import parse_text_exposition
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
+
+
+# ---------------------------------------------------------------------------
+# selftest wiring (satellite: the CLI can never silently rot)
+# ---------------------------------------------------------------------------
+
+
+class TestSelftest:
+    def test_selftest_passes(self):
+        assert diagnose.selftest(verbose=False)
+
+    def test_cli_selftest_exit_code(self, capsys):
+        assert diagnose.main(["--selftest"]) == 0
+        assert "selftest OK" in capsys.readouterr().out
+
+    def test_cli_no_input_is_usage_error(self, capsys):
+        assert diagnose.main([]) == 2
+
+    def test_cli_unreadable_input(self, capsys):
+        assert diagnose.main(["/nonexistent/flight.jsonl"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# attribution units
+# ---------------------------------------------------------------------------
+
+
+class TestAttribution:
+    def test_silent_death_culprit_and_text_render(self, tmp_path):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            a, b = diagnose._synthetic_dumps(td)
+            entries, warnings = diagnose.load_records([a, b])
+            report = diagnose.analyze(entries)
+            text = diagnose.render_text(entries, report, warnings)
+        assert report["culprit"]["replica_id"] == "replica_b:u2"
+        assert report["culprit"]["signal"] == "silent_death"
+        assert report["failure"]["phase"] == "allreduce"
+        assert report["failure"]["step"] == 3
+        assert "LIKELY CULPRIT: replica_b:u2" in text
+        assert "FAILED PHASE: allreduce" in text
+
+    def test_injected_fault_wins_attribution(self, tmp_path):
+        dump = tmp_path / "d.jsonl"
+        s = 1_000_000_000  # 1s in ns
+        t0 = 1_000 * s
+        recs = [
+            {"flight": "rec", "op": "quorum_rpc", "status": "ok",
+             "start_ns": t0, "end_ns": t0 + s, "replica_id": "a", "step": 2},
+            {"flight": "rec", "op": "fault", "status": "fault",
+             "start_ns": t0 + 2 * s, "end_ns": t0 + 2 * s, "replica_id": "b",
+             "step": 2, "fault": "train.step:raise", "site": "train.step",
+             "action": "raise"},
+            {"flight": "rec", "op": "allreduce", "status": "error",
+             "start_ns": t0 + 3 * s, "end_ns": t0 + 10 * s,
+             "replica_id": "a", "step": 2, "reason": "peer closed"},
+        ]
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        assert report["culprit"]["replica_id"] == "b"
+        assert report["culprit"]["signal"] == "injected_fault"
+        assert report["faults"][0]["fault"] == "train.step:raise"
+
+    def test_recovered_fault_does_not_mask_real_death(self, tmp_path):
+        """A fault the system survived (its replica kept producing records
+        to the end) must NOT win attribution over a later silent death of
+        a different replica."""
+        dump = tmp_path / "d.jsonl"
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        recs = [
+            # replica a absorbs an injected transport fault at step 1...
+            {"flight": "rec", "op": "fault", "status": "fault",
+             "start_ns": t0, "end_ns": t0, "replica_id": "a", "step": 1,
+             "fault": "transport.recv:raise", "site": "transport.recv",
+             "action": "raise"},
+        ]
+        # ...and both replicas keep training; b silently dies at step 8
+        for step in range(1, 10):
+            for rid in ("a", "b"):
+                if rid == "b" and step >= 8:
+                    continue
+                base = t0 + step * s
+                recs.append(
+                    {"flight": "rec", "op": "ring", "status": "ok",
+                     "start_ns": base, "end_ns": base + 1000,
+                     "replica_id": rid, "step": step}
+                )
+        recs.append(
+            {"flight": "rec", "op": "allreduce", "status": "error",
+             "start_ns": t0 + 8 * s, "end_ns": t0 + 18 * s,
+             "replica_id": "a", "step": 8, "reason": "deadline"}
+        )
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        assert report["culprit"]["replica_id"] == "b", report["culprit"]
+        assert report["culprit"]["signal"] == "silent_death"
+
+    def test_healthy_run_yields_no_culprit(self, tmp_path):
+        """Staggered shutdown of a clean run (no error/abort/fault
+        anywhere) must NOT produce a culprit, even when one replica's
+        last record is seconds after the other's."""
+        dump = tmp_path / "d.jsonl"
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        recs = []
+        for step in range(5):
+            for rid in ("a:u0", "b:u1"):
+                base = t0 + step * s
+                recs.append(
+                    {"flight": "rec", "op": "ring", "status": "ok",
+                     "start_ns": base, "end_ns": base + 1000,
+                     "replica_id": rid, "step": step}
+                )
+        # a's shutdown-time dump logs one extra record much later
+        recs.append(
+            {"flight": "rec", "op": "commit", "status": "ok",
+             "start_ns": t0 + 8 * s, "end_ns": t0 + 8 * s,
+             "replica_id": "a:u0", "step": 4}
+        )
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        assert report["culprit"] is None, report["culprit"]
+        assert report["failure"] is None
+
+    def test_recovered_fault_phantom_id_not_blamed(self, tmp_path):
+        """A bare-id fault record (the faults layer stamps no incarnation
+        suffix) must not mint a phantom 'dead' replica: a run where the
+        faulted replica restarted and kept training stays culprit-free."""
+        dump = tmp_path / "d.jsonl"
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        recs = [
+            {"flight": "rec", "op": "fault", "status": "fault",
+             "start_ns": t0 + s, "end_ns": t0 + s, "replica_id": "b",
+             "step": 1, "fault": "train.step:raise", "site": "train.step",
+             "action": "raise"},
+        ]
+        for step in range(5):
+            for rid in ("a:u0", "b:u1"):
+                base = t0 + step * s
+                recs.append(
+                    {"flight": "rec", "op": "ring", "status": "ok",
+                     "start_ns": base, "end_ns": base + 1000,
+                     "replica_id": rid, "step": step}
+                )
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        # no phantom 'b' liveness entry, no verdict on a recovered run
+        assert all(":" in rid for rid in report["replicas"]), report["replicas"]
+        assert report["culprit"] is None, report["culprit"]
+
+    def test_one_sided_evidence_points_at_peer_not_reporter(self, tmp_path):
+        """Only the survivor's dump collected (the victim was SIGKILLed —
+        no dump): the tool must NOT blame the replica that reported the
+        failure; it points at the peer rank from the failing transfer."""
+        dump = tmp_path / "d.jsonl"
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        recs = [
+            {"flight": "rec", "op": "quorum_rpc", "status": "ok",
+             "start_ns": t0, "end_ns": t0 + s, "replica_id": "a:u1",
+             "step": 4, "quorum_id": 2},
+            {"flight": "rec", "op": "allreduce", "status": "error",
+             "start_ns": t0 + 2 * s, "end_ns": t0 + 12 * s,
+             "replica_id": "a:u1", "rank": 0, "world": 2, "recv_peer": 1,
+             "reason": "collective failed: timeout"},
+        ]
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        assert report["culprit"] is not None
+        assert report["culprit"]["signal"] == "peer_without_evidence"
+        assert "rank 1" in report["culprit"]["replica_id"]
+        assert not report["culprit"]["replica_id"].startswith("a:")
+
+    def test_retry_storm_flagged(self, tmp_path):
+        dump = tmp_path / "d.jsonl"
+        t0 = 1_000_000_000_000
+        recs = [
+            {"flight": "rec", "op": "retry", "status": "retry",
+             "start_ns": t0 + i, "end_ns": t0 + i, "replica_id": "a",
+             "retry_op": "rpc.connect", "attempt": i}
+            for i in range(5)
+        ]
+        dump.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(dump)])
+        report = diagnose.analyze(entries)
+        assert report["retry_storms"] == [
+            {"replica_id": "a", "op": "rpc.connect", "retries": 5}
+        ]
+        assert report["culprit"]["signal"] == "retry_storm"
+
+    def test_events_merge_and_dedupe(self, tmp_path):
+        """TORCHFT_EVENTS_FILE records merge into the same timeline, and a
+        record dumped twice (two ring snapshots) appears once."""
+        dump = tmp_path / "d.jsonl"
+        rec = {"flight": "rec", "op": "allreduce", "status": "error",
+               "start_ns": 5, "end_ns": 9, "replica_id": "a", "step": 1}
+        dump.write_text(json.dumps(rec) + "\n" + json.dumps(rec) + "\n")
+        events = tmp_path / "ev.jsonl"
+        events.write_text(json.dumps(
+            {"ts": 1.0, "kind": "quorum", "message": "quorum changed",
+             "replica_id": "a", "step": 1, "quorum_id": 3}
+        ) + "\n")
+        entries, warnings = diagnose.load_records(
+            [str(dump)], [str(events)]
+        )
+        assert not warnings
+        assert len(entries) == 2  # deduped flight rec + one event
+        sources = {e["source"] for e in entries}
+        assert sources == {"flight", "event"}
+
+    def test_json_output(self, tmp_path, capsys):
+        dump = tmp_path / "d.jsonl"
+        dump.write_text(json.dumps(
+            {"flight": "rec", "op": "ring", "status": "ok",
+             "start_ns": 1, "end_ns": 2, "replica_id": "a", "step": 0}
+        ) + "\n")
+        assert diagnose.main([str(dump), "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["entries"] == 1
+        assert payload["timeline"][0]["op"] == "ring"
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke (acceptance criteria end to end)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestDiagnoseChaosSmoke:
+    def test_kill_mid_step_dump_diagnose_and_step_lag(
+        self, tmp_path, monkeypatch
+    ):
+        """Kill one of two DDP replicas mid-step (after quorum, before its
+        collective — the worst moment for its peer): the survivor's wedged
+        collective fails and dumps flight state, torchft-diagnose names
+        the killed replica and the failed phase, and the lighthouse
+        exports nonzero torchft_replica_step_lag for the dead replica
+        (its progress entry outlives its heartbeat until supersession)."""
+        TOTAL, KILL_AT = 6, 2
+        flight_file = tmp_path / "flight.jsonl"
+        monkeypatch.setenv("TORCHFT_FLIGHT_FILE", str(flight_file))
+        fr.RECORDER.clear()
+        faults.FAULTS.configure(
+            [FaultRule(site="train.step", replica="replica_1", step=KILL_AT)],
+            seed=11,
+        )
+
+        # min_replicas=1 so the survivor can form a singleton quorum after
+        # the permanent kill.  Warm-up heartbeats for two placeholder ids
+        # arm the split-brain guard, holding the FIRST quorum open until
+        # both real managers have joined (the placeholders expire after
+        # heartbeat_timeout_ms and never participate).
+        lighthouse = LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=1000
+        )
+        from torchft_tpu.coordination import LighthouseClient
+
+        warm = LighthouseClient(lighthouse.address())
+        warm.heartbeat("warm_a")
+        warm.heartbeat("warm_b")
+        warm.close()
+        results = {}
+        errors = {}
+
+        def run(rid: int) -> None:
+            params = {"w": np.zeros(4, dtype=np.float32)}
+
+            def load_state_dict(sd):
+                params["w"] = np.array(sd["w"])
+
+            def state_dict():
+                return {"w": params["w"].copy()}
+
+            pg = ProcessGroupTCP(timeout=10.0)
+            manager = Manager(
+                pg=pg,
+                min_replica_size=1,
+                load_state_dict=load_state_dict,
+                state_dict=state_dict,
+                lighthouse_addr=lighthouse.address(),
+                replica_id=f"replica_{rid}",
+                group_rank=0,
+                group_world_size=1,
+                use_async_quorum=False,  # quorum forms BEFORE the kill site
+                timeout=20.0,
+                quorum_timeout=20.0,
+            )
+            try:
+                while manager.current_step() < TOTAL:
+                    step = manager.current_step()
+                    manager.start_quorum()
+                    # kill site sits between quorum formation and the
+                    # collective: the peer is left blocked mid-ring
+                    faults.check(
+                        "train.step", replica=f"replica_{rid}", step=step
+                    )
+                    grads = {
+                        "w": np.full(4, float(step + 1), dtype=np.float32)
+                        * (1.0 + 0.5 * rid)
+                    }
+                    avg = manager.allreduce(grads).wait(timeout=30)
+                    if manager.should_commit():
+                        params["w"] = params["w"] - 0.1 * avg["w"]
+                results[rid] = {
+                    "state": state_dict(), "step": manager.current_step()
+                }
+            except InjectedFault:
+                # "process death": the OS would close every socket — abort
+                # does exactly that (and dumps this replica's flight ring)
+                pg.abort()
+                results[rid] = {"killed_at": manager.current_step()}
+            except BaseException as e:  # noqa: BLE001
+                errors[rid] = e
+            finally:
+                manager.shutdown()
+
+        threads = [
+            threading.Thread(target=run, args=(r,), daemon=True)
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "replica hung"
+        assert not errors, errors
+        assert results[0].get("step") == TOTAL, results
+        assert results[1].get("killed_at") == KILL_AT, results
+
+        # --- every surviving process dumped on abort -------------------
+        lines = [
+            json.loads(l) for l in flight_file.read_text().splitlines()
+        ]
+        metas = [l for l in lines if l.get("flight") == "meta"]
+        assert any(m["trigger"] == "pg_abort" for m in metas), metas
+        recs = [l for l in lines if l.get("flight") == "rec"]
+        # survivor's failed collective is in the dump with error status
+        assert any(
+            r["status"] == "error"
+            and str(r.get("replica_id", "")).startswith("replica_0")
+            for r in recs
+        ), "survivor's collective failure not captured"
+
+        # --- diagnose names the killed replica and the failed phase ----
+        entries, _warnings = diagnose.load_records([str(flight_file)])
+        report = diagnose.analyze(entries)
+        assert report["culprit"] is not None, report
+        assert report["culprit"]["replica_id"].startswith("replica_1"), report[
+            "culprit"
+        ]
+        assert report["failure"] is not None
+        assert report["failure"]["phase"] in ("allreduce", "manager.error", "abort")
+        # the CLI agrees (exit 0, culprit in the rendered text)
+        assert diagnose.main([str(flight_file)]) == 0
+
+        # --- lighthouse exports nonzero step lag for the dead replica --
+        body = (
+            urllib.request.urlopen(
+                f"http://{lighthouse.address()}/metrics", timeout=5
+            )
+            .read()
+            .decode()
+        )
+        fams = parse_text_exposition(body)
+        lags = fams["torchft_replica_step_lag"]["samples"]
+        dead_lag = [
+            v
+            for (name, labels), v in lags.items()
+            if name == "torchft_replica_step_lag"
+            and dict(labels).get("replica", "").startswith("replica_1")
+        ]
+        assert dead_lag and dead_lag[0] > 0, lags
+        survivor_lag = [
+            v
+            for (name, labels), v in lags.items()
+            if name == "torchft_replica_step_lag"
+            and dict(labels).get("replica", "").startswith("replica_0")
+        ]
+        assert survivor_lag and survivor_lag[0] == 0, lags
+        # straggler score for the dead replica dwarfs the survivor's
+        scores = fams["torchft_straggler_score"]["samples"]
+        dead_score = [
+            v
+            for (name, labels), v in scores.items()
+            if dict(labels).get("replica", "").startswith("replica_1")
+        ]
+        assert dead_score and dead_score[0] >= 1.0, scores
+        lighthouse.shutdown()

@@ -1,0 +1,205 @@
+"""Flight recorder unit tests: ring semantics, in-flight ops, dumps,
+env knobs, and the hot-path overhead budget (acceptance: ~2 us/record,
+the same bar as the metrics layer's observe)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from torchft_tpu.utils import flightrecorder as fr
+
+
+class TestRing:
+    def test_record_and_snapshot_order(self):
+        rec = fr.FlightRecorder(capacity=8)
+        for i in range(5):
+            rec.record("op", step=i)
+        snap = rec.snapshot()
+        assert [r["step"] for r in snap] == [0, 1, 2, 3, 4]
+        assert all(r["status"] == "ok" for r in snap)
+        assert all(r["end_ns"] >= r["start_ns"] for r in snap)
+
+    def test_ring_wraps_keeping_newest(self):
+        rec = fr.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("op", step=i)
+        snap = rec.snapshot()
+        assert [r["step"] for r in snap] == [6, 7, 8, 9]
+        assert rec.total_recorded() == 10
+
+    def test_env_ring_capacity(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FLIGHT_RING", "3")
+        rec = fr.FlightRecorder()
+        for i in range(5):
+            rec.record("op", step=i)
+        assert [r["step"] for r in rec.snapshot()] == [2, 3, 4]
+        monkeypatch.setenv("TORCHFT_FLIGHT_RING", "bogus")
+        assert fr.FlightRecorder()._cap == 512  # falls back to the default
+
+    def test_clear(self):
+        rec = fr.FlightRecorder(capacity=4)
+        rec.record("op")
+        rec.start("open_op")
+        rec.clear()
+        assert rec.snapshot() == []
+
+
+class TestFlightOp:
+    def test_inflight_visible_then_completed(self):
+        rec = fr.FlightRecorder(capacity=8)
+        op = rec.start("allreduce", rank=0, world=2, replica_id="r0")
+        snap = rec.snapshot()
+        assert len(snap) == 1 and snap[0]["status"] == "inflight"
+        op.update(recv_peer=1, recv_tag=100)
+        op.add_bytes(4096)
+        op.add_bytes(4096)
+        done = op.finish("error", reason="peer closed")
+        assert done["bytes_done"] == 8192
+        assert done["recv_peer"] == 1
+        snap = rec.snapshot()
+        assert len(snap) == 1 and snap[0]["status"] == "error"
+        assert snap[0]["end_ns"] >= snap[0]["start_ns"]
+
+    def test_double_finish_is_noop(self):
+        rec = fr.FlightRecorder(capacity=8)
+        op = rec.start("x")
+        first = op.finish("ok")
+        second = op.finish("error")  # ignored
+        assert second["status"] == "ok" == first["status"]
+        assert len(rec.snapshot()) == 1
+
+    def test_track_context_manager(self):
+        fr.RECORDER.clear()
+        with fr.track("op.ok", step=1) as flight:
+            flight.add_bytes(10)
+        with pytest.raises(ValueError):
+            with fr.track("op.bad", step=2):
+                raise ValueError("boom")
+        by_op = {r["op"]: r for r in fr.snapshot() if r["op"].startswith("op.")}
+        assert by_op["op.ok"]["status"] == "ok"
+        assert by_op["op.ok"]["bytes_done"] == 10
+        assert by_op["op.bad"]["status"] == "error"
+        assert "boom" in by_op["op.bad"]["error"]
+
+    def test_update_after_finish_ignored(self):
+        rec = fr.FlightRecorder(capacity=8)
+        op = rec.start("x")
+        op.finish("ok")
+        op.update(peer=9)
+        op.add_bytes(10)
+        assert "peer" not in rec.snapshot()[0]
+        assert "bytes_done" not in rec.snapshot()[0]
+
+
+class TestDump:
+    def test_dump_without_sink_is_noop(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_FLIGHT_FILE", raising=False)
+        rec = fr.FlightRecorder(capacity=4)
+        rec.record("op")
+        assert rec.dump("why") is None
+
+    def test_dump_appends_meta_and_records(self, tmp_path, monkeypatch):
+        path = tmp_path / "flight.jsonl"
+        monkeypatch.setenv("TORCHFT_FLIGHT_FILE", str(path))
+        rec = fr.FlightRecorder(capacity=8)
+        rec.record("allreduce", status="error", step=3, replica_id="r1")
+        open_op = rec.start("recv", replica_id="r1", src=0)
+        assert rec.dump("collective failed", trigger="pg_abort") == str(path)
+        # second dump appends (crash-durability: each trigger snapshots)
+        assert rec.dump("again") == str(path)
+        open_op.finish("ok")
+
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        metas = [l for l in lines if l["flight"] == "meta"]
+        recs = [l for l in lines if l["flight"] == "rec"]
+        assert len(metas) == 2
+        assert metas[0]["reason"] == "collective failed"
+        assert metas[0]["trigger"] == "pg_abort"
+        assert metas[0]["pid"] == os.getpid()
+        # both dumps carried the error record AND the in-flight op
+        assert sum(1 for r in recs if r["status"] == "error") == 2
+        assert sum(1 for r in recs if r["status"] == "inflight") == 2
+
+    def test_dump_rotates_at_max_bytes(self, tmp_path, monkeypatch):
+        path = tmp_path / "flight.jsonl"
+        monkeypatch.setenv("TORCHFT_FLIGHT_MAX_BYTES", "4096")
+        rec = fr.FlightRecorder(capacity=64)
+        for i in range(64):
+            rec.record("op", step=i, payload="x" * 64)
+        for _ in range(4):  # each dump ~64 records * ~130B > 4 KiB
+            rec.dump("why", path=str(path))
+        rotated = tmp_path / "flight.jsonl.1"
+        assert rotated.exists(), "no rotation happened"
+        # the live file was rotated, not truncated mid-line: both parse
+        for p in (path, rotated):
+            for line in p.read_text().splitlines():
+                json.loads(line)
+
+    def test_dump_counts_metric(self, tmp_path, monkeypatch):
+        from torchft_tpu.utils import metrics
+
+        path = tmp_path / "flight.jsonl"
+        before = metrics.FLIGHT_DUMPS.labels(trigger="manual").get()
+        rec = fr.FlightRecorder(capacity=4)
+        rec.record("op")
+        rec.dump("why", path=str(path))
+        assert metrics.FLIGHT_DUMPS.labels(trigger="manual").get() == before + 1
+        # no sink -> no metric movement
+        monkeypatch.delenv("TORCHFT_FLIGHT_FILE", raising=False)
+        rec.dump("why")
+        assert metrics.FLIGHT_DUMPS.labels(trigger="manual").get() == before + 1
+
+
+class TestSignalHook:
+    def test_sigterm_dumps_in_subprocess(self, tmp_path):
+        """A SIGTERM'd process (how schedulers kill replicas) must leave
+        its flight ring on disk before dying with the signal."""
+        path = tmp_path / "flight.jsonl"
+        script = textwrap.dedent(
+            f"""
+            import os, signal, sys, time
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            os.environ["TORCHFT_FLIGHT_FILE"] = {str(path)!r}
+            from torchft_tpu.utils import flightrecorder as fr
+            fr.record("train.step", step=7, replica_id="victim")
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(10)  # unreachable: SIGTERM must terminate us
+            """
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script], timeout=60, capture_output=True
+        )
+        # died by SIGTERM (default disposition re-delivered after the dump)
+        assert proc.returncode == -signal.SIGTERM, proc.stderr.decode()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert any(
+            l["flight"] == "meta" and l["trigger"] == "signal" for l in lines
+        )
+        assert any(
+            l["flight"] == "rec" and l.get("step") == 7 for l in lines
+        )
+
+
+class TestHotPathBudget:
+    def test_record_overhead_under_budget(self):
+        """Acceptance bar: <= ~2 us per record() on the hot path.  Best of
+        several batches so a loaded 1-core CI host doesn't flake the
+        measurement; the implementation is one dict build + one lock +
+        one slot assignment (~0.5-1 us typical)."""
+        rec = fr.FlightRecorder(capacity=512)
+        n = 20_000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for i in range(n):
+                rec.record(
+                    "ring", step=i, quorum_id=1, replica_id="replica_0"
+                )
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best <= 2.5e-6, f"record() hot path {best*1e6:.2f} us/record"

@@ -259,6 +259,70 @@ class TestLighthouse:
             assert status["prev_quorum"]["participants"][0]["replica_id"] == "s"
             client.close()
 
+    def test_status_schema_roundtrip(self):
+        """Lighthouse.status() and GET /status.json serve the SAME
+        document: participant, heartbeat-age, and the new straggler
+        fields all round-trip through both surfaces."""
+        import json as _json
+
+        with LighthouseServer(
+            min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=60000
+        ) as server:
+            _concurrent_quorums(
+                server.address(),
+                [
+                    {"replica_id": "lead", "step": 9, "store_address": "st:9"},
+                    {"replica_id": "lag", "step": 4, "store_address": "st:4"},
+                ],
+            )
+            client = LighthouseClient(server.address())
+            # progress piggyback on a plain heartbeat updates the table too
+            reply = client.heartbeat("lag", step=5, inflight_op="heal_recv")
+            assert reply == {}  # not superseded
+            rpc_status = client.status()
+            client.close()
+            http_status = _json.loads(
+                urllib.request.urlopen(
+                    f"http://{server.address()}/status.json", timeout=5
+                ).read().decode()
+            )
+
+        for status in (rpc_status, http_status):
+            # participant fields
+            by_id = {
+                p["replica_id"]: p
+                for p in status["prev_quorum"]["participants"]
+            }
+            assert by_id["lag"]["store_address"] == "st:4"
+            assert by_id["lag"]["recovering"] is True
+            # heartbeat ages
+            hbs = {h["replica_id"]: h for h in status["heartbeats"]}
+            assert {"lead", "lag"} <= set(hbs)
+            assert all(
+                h["age_ms"] >= 0 and h["stale"] is False for h in hbs.values()
+            )
+            # straggler fields (new): step, step_lag, age, score, op, stale
+            stragglers = {
+                s["replica_id"]: s for s in status["stragglers"]
+            }
+            assert {"lead", "lag"} <= set(stragglers)
+            assert stragglers["lead"]["step"] == 9
+            assert stragglers["lead"]["step_lag"] == 0
+            assert stragglers["lag"]["step"] == 5  # heartbeat advanced it
+            assert stragglers["lag"]["step_lag"] == 4
+            assert stragglers["lag"]["inflight_op"] == "heal_recv"
+            assert stragglers["lag"]["progress_age_ms"] >= 0
+            # sender-clock stamp round-trips when reported
+            assert "last_step_wall_ms" in stragglers["lag"]
+            # full QuorumMember fields survive the status unification
+            assert "shrink_only" in by_id["lag"]
+            assert "commit_failures" in by_id["lag"]
+            assert stragglers["lag"]["straggler_score"] >= 0.0
+            assert stragglers["lag"]["stale"] is False
+            assert status["max_step"] == 9
+            # legacy field kept for the status RPC's original schema
+            assert "reason" in status and "num_participants" in status
+
     def test_dashboard_recovering_badge_and_heartbeats(self):
         """Dashboard parity with reference templates/status.html:17-43 +
         src/lighthouse.rs:415-452: a member behind max_step renders with

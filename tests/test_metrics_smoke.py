@@ -14,6 +14,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+import pytest
 
 import torchft_tpu.utils.metrics as metrics
 import torchft_tpu.utils.tracing as tracing
@@ -85,8 +86,11 @@ def test_metrics_scrape_smoke():
     snap1 = manager.phase_times()
     snap2 = manager.phase_times()
     assert snap1 == snap2 and "commit" in snap1
-    # the destructive drain still works for bench.py
-    assert manager.pop_phase_times() == snap1
+    # the destructive drain still works for back-compat, but now warns
+    # (satellite: pop_phase_times deprecation — new code reads
+    # phase_times() or the quorum-duration histogram)
+    with pytest.warns(DeprecationWarning):
+        assert manager.pop_phase_times() == snap1
     assert manager.phase_times() == {}
 
 

@@ -26,6 +26,16 @@ class TestPackaging:
             main(["--help"])
         assert e.value.code == 0
 
+    def test_diagnose_console_entry_callable(self):
+        # torchft-diagnose rides the same [project.scripts] wiring
+        text = open(os.path.join(REPO, "pyproject.toml")).read()
+        assert "torchft_tpu.diagnose:main" in text
+        from torchft_tpu.diagnose import main
+
+        with pytest.raises(SystemExit) as e:
+            main(["--help"])
+        assert e.value.code == 0
+
     def test_native_lib_search_order(self, monkeypatch):
         from torchft_tpu import _native
 

@@ -230,6 +230,22 @@ class TestEventExporters:
             ring.export({"i": i})
         assert [e["i"] for e in ring.events()] == [6, 7, 8, 9]
 
+    def test_event_ring_size_env(self, monkeypatch):
+        # satellite: TORCHFT_EVENTS_RING sizes the default ring (read at
+        # import; the resolver itself is what's testable post-import)
+        from torchft_tpu.utils import logging as tlog
+
+        monkeypatch.setenv("TORCHFT_EVENTS_RING", "7")
+        assert tlog._event_ring_size() == 7
+        monkeypatch.setenv("TORCHFT_EVENTS_RING", "not-a-number")
+        assert tlog._event_ring_size() == 256  # degrades to the default
+        monkeypatch.setenv("TORCHFT_EVENTS_RING", "0")
+        assert tlog._event_ring_size() == 1  # clamped
+        monkeypatch.delenv("TORCHFT_EVENTS_RING")
+        assert tlog._event_ring_size() == 256
+        # the module singleton was built through the same resolver
+        assert tlog._ring._events.maxlen == tlog._EVENT_RING_SIZE
+
     def test_abort_kind_accepted(self):
         from torchft_tpu.utils.logging import log_event, recent_events
 

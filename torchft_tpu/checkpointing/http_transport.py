@@ -25,6 +25,7 @@ from typing import Any, Callable, List, Optional
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
@@ -119,6 +120,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", str(total))
                 self.end_headers()
                 t0 = time.perf_counter()
+                t0_ns = time.time_ns()
                 writer(self.wfile)
                 _metrics.CHECKPOINT_BYTES.labels(
                     transport="http", direction="send"
@@ -126,6 +128,10 @@ class _Handler(BaseHTTPRequestHandler):
                 _metrics.CHECKPOINT_DURATION.labels(
                     transport="http", direction="send"
                 ).observe(time.perf_counter() - t0)
+                _flightrec.record(
+                    "checkpoint.http.send", start_ns=t0_ns, step=step,
+                    bytes=total, resource=what,
+                )
         except TimeoutError:
             self.send_error(503, "checkpoint busy")
         except BrokenPipeError:
@@ -182,16 +188,31 @@ class HTTPTransport(CheckpointTransport[Any]):
         import numpy as np
         import jax
 
+        t0_ns = time.time_ns()
         host_sd = jax.tree_util.tree_map(
             lambda x: np.asarray(x) if hasattr(x, "__array__") else x, state_dict
         )
         with self._staged_lock.w_lock(timeout=timeout):
             self._staged = (step, host_sd, max(self._num_chunks, 1))
+        _flightrec.record(
+            "checkpoint.http.stage", start_ns=t0_ns, step=step,
+            dst_ranks=list(dst_ranks),
+        )
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         _faults.check("transport.recv", step=step)
+        # in-flight op for the whole heal fetch: a healer wedged mid-fetch
+        # shows up in the flight dump with src/step context
+        with _flightrec.track(
+            "checkpoint.http.recv", step=step, src_rank=src_rank,
+        ):
+            return self._recv_checkpoint(src_rank, metadata, step, timeout)
+
+    def _recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
         deadline = time.monotonic() + timeout
         t_recv = time.perf_counter()

@@ -21,6 +21,7 @@ import numpy as np
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.parallel.process_group import ProcessGroup
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.futures import context_timeout
 
@@ -78,14 +79,17 @@ class PGTransport(CheckpointTransport[Any]):
         t0 = time.perf_counter()
         nbytes = header.nbytes + sum(a.nbytes for a in arrays if a is not None)
         # Armed per-transfer deadline: a receiver that dies mid-stream
-        # leaves sends wedged on full socket buffers; expiry aborts the PG,
-        # failing every queued op fast instead of wedging healing.
-        with context_timeout(self._pg.abort, timeout):
+        # leaves sends wedged on full socket buffers; expiry aborts the
+        # PG, failing every queued op fast instead of wedging healing.
+        with _flightrec.track(
+            "checkpoint.pg.send", step=step, dst_ranks=list(dst_ranks),
+            bytes=nbytes,
+        ), context_timeout(self._pg.abort, timeout):
             for dst in dst_ranks:
-                # submit the whole stream, then reap: the PG worker executes
-                # in submission order, and keeping its queue non-empty lets it
-                # drain the socket continuously instead of idling one
-                # thread-wakeup round trip per leaf
+                # submit the whole stream, then reap: the PG worker
+                # executes in submission order, and keeping its queue
+                # non-empty lets it drain the socket continuously instead
+                # of idling one thread-wakeup round trip per leaf
                 works = [self._pg.send(header, dst, tag=_META_TAG)]
                 for i, arr in enumerate(arrays):
                     if arr is not None:
@@ -111,7 +115,9 @@ class PGTransport(CheckpointTransport[Any]):
         # Armed per-transfer deadline (see send_checkpoint): expiry aborts
         # the PG so a dead/stalled sender cannot wedge healing — the
         # receiving replica latches the error and re-heals next quorum.
-        with context_timeout(self._pg.abort, timeout):
+        with _flightrec.track(
+            "checkpoint.pg.recv", step=step, src_rank=src_rank,
+        ), context_timeout(self._pg.abort, timeout):
             return self._recv_checkpoint(src_rank, step, timeout, t0)
 
     def _recv_checkpoint(

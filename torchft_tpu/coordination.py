@@ -461,6 +461,17 @@ class ManagerServer(_NativeServer):
         )
         super().__init__(handle)
 
+    def report_progress(self, step: int, inflight_op: str = "") -> None:
+        """Record this replica group's training progress; the native
+        heartbeat loop piggybacks it (``step``, ``last_step_wall_ms``,
+        ``inflight_op``) on every lighthouse heartbeat so the lighthouse
+        can compute per-replica step lag and straggler scores."""
+        if self._handle is None:
+            return
+        _native.get_lib().tft_manager_report_progress(
+            self._handle, int(step), inflight_op.encode()
+        )
+
 
 # ---------------------------------------------------------------------------
 # clients
@@ -515,9 +526,35 @@ class LighthouseClient:
         result = self._client.call("quorum", {"member": member.to_dict()}, timeout)
         return Quorum.from_dict(result["quorum"])
 
-    def heartbeat(self, replica_id: str, timeout: "float | timedelta" = 5.0) -> None:
-        """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms."""
-        self._client.call("heartbeat", {"replica_id": replica_id}, timeout)
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: "float | timedelta" = 5.0,
+        step: "Optional[int]" = None,
+        last_step_wall_ms: "Optional[int]" = None,
+        inflight_op: "Optional[str]" = None,
+    ) -> Dict[str, Any]:
+        """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms.
+
+        Optional progress piggyback (straggler telemetry): ``step`` is the
+        replica's committed step, ``last_step_wall_ms`` the sender-clock
+        wall time (ms) the step last advanced, ``inflight_op`` what the
+        replica is currently doing.  The lighthouse folds these into
+        per-replica step lag and straggler scores (``/status.json``
+        ``stragglers``, ``/metrics`` ``torchft_replica_step_lag`` /
+        ``torchft_straggler_score``).  Returns the server reply (e.g.
+        ``{"superseded": true}`` for an evicted incarnation)."""
+        # chaos site: the straggler-telemetry path must itself be
+        # chaos-testable (docs/robustness.md site table)
+        _faults.check("lighthouse.heartbeat", replica=replica_id)
+        params: "Dict[str, Any]" = {"replica_id": replica_id}
+        if step is not None:
+            params["step"] = int(step)
+        if last_step_wall_ms is not None:
+            params["last_step_wall_ms"] = int(last_step_wall_ms)
+        if inflight_op is not None:
+            params["inflight_op"] = inflight_op
+        return self._client.call("heartbeat", params, timeout)
 
     def status(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
         """Quorum/participant/heartbeat snapshot (the dashboard's data)."""

@@ -1,0 +1,648 @@
+"""torchft-diagnose: cross-replica post-mortem from flight dumps + events.
+
+``python -m torchft_tpu.diagnose dump1.jsonl dump2.jsonl [--events ev.jsonl]``
+merges N replicas' flight-recorder dumps (``TORCHFT_FLIGHT_FILE``,
+utils/flightrecorder.py) and structured-event logs
+(``TORCHFT_EVENTS_FILE``, utils/logging.py) into **one cross-replica
+timeline keyed by (step, quorum_id)**, then flags the likely culprit of a
+degraded run:
+
+1. **injected faults** — a chaos-killed replica carries a fault-tagged
+   flight record (``utils/faults.py`` stamps every injection);
+2. **silent death** — the replica whose records stop earliest while its
+   peers kept going (the classic "which replica stalled the quorum"
+   question both PCCL-style reports treat as first-class);
+3. **last to enter the failed phase** — among replicas that DID reach the
+   step where the first error fired, the one missing (or last to enter)
+   that phase;
+4. **retry storms** — bursts of ``retry`` records flagged per operation.
+
+Output is a human timeline + verdict (default) or ``--json`` for machines.
+``--selftest`` generates a synthetic two-replica dump pair in a temp dir
+and checks culprit attribution end to end — wired into the test suite so
+the CLI can never silently rot (tests/test_diagnose.py).
+
+Exit codes: 0 = analysis produced (or selftest passed), 1 = selftest
+failed / no input parseable, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["load_records", "analyze", "render_text", "selftest", "main"]
+
+# record statuses that mean "something went wrong here"
+_ERROR_STATUSES = ("error", "abort")
+# event kinds that mean the same in the TORCHFT_EVENTS_FILE stream
+_ERROR_KINDS = ("error", "abort")
+# at least this many retry records for one op counts as a storm
+RETRY_STORM_THRESHOLD = 3
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def _normalize_flight(rec: "Dict[str, Any]") -> "Dict[str, Any]":
+    """One flight record -> timeline entry."""
+    return {
+        "source": "flight",
+        "t_ns": int(rec.get("end_ns") or rec.get("start_ns") or 0),
+        "start_ns": int(rec.get("start_ns") or 0),
+        "replica_id": str(rec.get("replica_id", "") or ""),
+        "op": str(rec.get("op", "?")),
+        "status": str(rec.get("status", "ok")),
+        "step": rec.get("step"),
+        "quorum_id": rec.get("quorum_id"),
+        "fields": {
+            k: v
+            for k, v in rec.items()
+            if k
+            not in ("flight", "op", "status", "start_ns", "end_ns", "replica_id",
+                    "step", "quorum_id")
+        },
+    }
+
+
+def _normalize_event(ev: "Dict[str, Any]") -> "Dict[str, Any]":
+    """One structured event (utils/logging.py JSONL) -> timeline entry."""
+    return {
+        "source": "event",
+        "t_ns": int(float(ev.get("ts", 0.0)) * 1e9),
+        "start_ns": int(float(ev.get("ts", 0.0)) * 1e9),
+        "replica_id": str(ev.get("replica_id", "") or ""),
+        "op": str(ev.get("kind", "?")),
+        "status": "error" if ev.get("kind") in _ERROR_KINDS else "ok",
+        "step": ev.get("step"),
+        "quorum_id": ev.get("quorum_id"),
+        "fields": {
+            k: v
+            for k, v in ev.items()
+            if k not in ("ts", "kind", "replica_id", "step", "quorum_id")
+        },
+    }
+
+
+def load_records(
+    paths: "List[str]", event_paths: "Optional[List[str]]" = None
+) -> "Tuple[List[Dict[str, Any]], List[str]]":
+    """Parse dump + event JSONL files into deduplicated timeline entries.
+
+    A flight file accumulates one full ring snapshot per dump trigger, so
+    the same record can appear many times across (and within) files —
+    dedupe on (replica_id, op, start_ns, status).  Returns (entries sorted
+    by time, warnings)."""
+    entries: "List[Dict[str, Any]]" = []
+    warnings: "List[str]" = []
+    seen: set = set()
+
+    def add(entry: "Dict[str, Any]") -> None:
+        key = (
+            entry["replica_id"], entry["op"], entry["start_ns"],
+            entry["status"], entry["source"],
+        )
+        if key in seen:
+            return
+        seen.add(key)
+        entries.append(entry)
+
+    def parse_file(path: str, events_only: bool) -> None:
+        try:
+            fh = open(path, "r", encoding="utf-8")
+        except OSError as e:
+            warnings.append(f"{path}: unreadable ({e})")
+            return
+        bad = 0
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if not isinstance(obj, dict):
+                    bad += 1
+                    continue
+                if obj.get("flight") == "meta":
+                    continue  # dump headers are bookkeeping, not evidence
+                if obj.get("flight") == "rec" and not events_only:
+                    add(_normalize_flight(obj))
+                elif "kind" in obj:
+                    add(_normalize_event(obj))
+                else:
+                    bad += 1
+        if bad:
+            warnings.append(f"{path}: skipped {bad} unparseable line(s)")
+
+    for p in paths:
+        parse_file(p, events_only=False)
+    for p in event_paths or []:
+        parse_file(p, events_only=True)
+    entries.sort(key=lambda e: e["t_ns"])
+    return entries, warnings
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+
+def analyze(entries: "List[Dict[str, Any]]") -> "Dict[str, Any]":
+    """Cross-replica culprit attribution over a merged timeline."""
+    # Backfill steps per replica: PG-level records (collectives, aborts)
+    # carry no step — the worker thread doesn't know it — but the same
+    # replica's quorum phases do, so inherit the latest preceding one.
+    # This is what lets "who entered the failed phase at step N" work.
+    last_step: "Dict[str, int]" = {}
+    for e in entries:  # time-sorted by load_records
+        rid = e["replica_id"]
+        if isinstance(e.get("step"), int):
+            last_step[rid] = e["step"]
+        elif rid in last_step:
+            e["step"] = last_step[rid]
+            e["step_inferred"] = True
+
+    replicas: "Dict[str, Dict[str, Any]]" = {}
+    for e in entries:
+        rid = e["replica_id"]
+        if not rid:
+            continue
+        if e["op"] == "fault" or e["status"] == "fault":
+            # Fault records are stamped with the BARE replica id (no
+            # ":uuid" incarnation suffix) — folding them into the
+            # liveness table would mint a phantom replica whose records
+            # "stop" at the injection and shadow the real incarnation.
+            # The injected_fault branch handles them prefix-aware.
+            continue
+        info = replicas.setdefault(
+            rid, {"first_ns": e["t_ns"], "last_ns": e["t_ns"], "max_step": -1,
+                  "records": 0, "errors": 0}
+        )
+        info["records"] += 1
+        info["last_ns"] = max(info["last_ns"], e["t_ns"])
+        info["first_ns"] = min(info["first_ns"], e["t_ns"])
+        if isinstance(e.get("step"), int):
+            info["max_step"] = max(info["max_step"], e["step"])
+        if e["status"] in _ERROR_STATUSES:
+            info["errors"] += 1
+
+    faults = [
+        e for e in entries
+        if e["op"] == "fault" or e["status"] == "fault"
+        or (e["source"] == "event" and e["op"] == "fault")
+    ]
+    errors = [e for e in entries if e["status"] in _ERROR_STATUSES]
+
+    # retry storms: many retries of one op is a failure signature of its own
+    retry_counts: "Dict[Tuple[str, str], int]" = defaultdict(int)
+    for e in entries:
+        if e["op"] == "retry":
+            retry_counts[(e["replica_id"], str(e["fields"].get("retry_op", "?")))] += 1
+    storms = [
+        {"replica_id": rid, "op": op, "retries": n}
+        for (rid, op), n in sorted(retry_counts.items())
+        if n >= RETRY_STORM_THRESHOLD
+    ]
+
+    # The failure point: the FIRST hard error in the merged timeline —
+    # later errors are usually cascade.  Deliberate aborts (status
+    # "abort": teardown, watchdogs, a dying replica closing its own PG)
+    # only qualify when no hard error exists.
+    failure: "Optional[Dict[str, Any]]" = None
+    if errors:
+        hard = [e for e in errors if e["status"] == "error"]
+        first = (hard or errors)[0]
+        step = first.get("step")
+        quorum_id = first.get("quorum_id")
+        if step is None:
+            # PG-level records carry no step (the worker thread doesn't
+            # know it); backfill from the reporter's nearest earlier
+            # record that does — e.g. its quorum phases for that round.
+            for e in reversed(entries):
+                if (
+                    e["t_ns"] <= first["t_ns"]
+                    and e["replica_id"] == first["replica_id"]
+                    and isinstance(e.get("step"), int)
+                ):
+                    step = e["step"]
+                    if quorum_id is None:
+                        quorum_id = e.get("quorum_id")
+                    break
+        failure = {
+            "phase": first["op"],
+            "step": step,
+            "quorum_id": quorum_id,
+            "t_ns": first["t_ns"],
+            "reported_by": first["replica_id"],
+            "detail": first["fields"].get("reason")
+            or first["fields"].get("error")
+            or first["fields"].get("message", ""),
+        }
+
+    culprit: "Optional[Dict[str, Any]]" = None
+    # 1) injected fault wins — but only when the chaos layer stamped a
+    #    REPLICA and that replica actually stopped.  A fault the system
+    #    recovered from (a retried heal, an absorbed connection drop) or
+    #    one without replica context (transports supply step only) is
+    #    context, not the culprit — blaming it would mask a later real
+    #    death.
+    kill_faults = [
+        f for f in faults
+        if f["replica_id"]
+        and str(
+            f["fields"].get("action", f["fields"].get("fault", ""))
+        ).find("delay") < 0
+    ]
+    if kill_faults and replicas:
+        # Prefix-aware: the faults layer stamps the BARE replica id while
+        # protocol records carry the ":uuid" incarnation suffix — compare
+        # per logical replica, and report the full incarnation id.
+        def _base(rid: str) -> str:
+            return rid.split(":", 1)[0]
+
+        last_by_base: "Dict[str, Tuple[int, str]]" = {}
+        for rid, info in replicas.items():
+            b = _base(rid)
+            if b not in last_by_base or info["last_ns"] > last_by_base[b][0]:
+                last_by_base[b] = (info["last_ns"], rid)
+        global_last = max(info["last_ns"] for info in replicas.values())
+        for f in reversed(kill_faults):
+            fb = _base(f["replica_id"])
+            my_last, full_id = last_by_base.get(fb, (0, f["replica_id"]))
+            dead = (global_last - my_last) / 1e9 > 0.05
+            if dead or len(last_by_base) == 1:
+                culprit = {
+                    "replica_id": full_id,
+                    "reason": (
+                        f"injected fault "
+                        f"{f['fields'].get('fault') or f['fields'].get('site', '?')}"
+                        f" at step {f.get('step')}"
+                    ),
+                    "signal": "injected_fault",
+                }
+                break
+    # 2) silent death: a replica whose records stop earliest while peers
+    #    kept producing evidence afterwards.  Only with a failure
+    #    signature on the table — staggered shutdown of a HEALTHY run
+    #    also leaves unequal last-record times, and a post-mortem tool
+    #    that names culprits on clean runs trains operators to ignore it.
+    if (
+        culprit is None
+        and len(replicas) >= 2
+        and (failure is not None or kill_faults)
+    ):
+        by_last = sorted(replicas.items(), key=lambda kv: kv[1]["last_ns"])
+        (dead_id, dead), (_, next_one) = by_last[0], by_last[1]
+        gap_s = (next_one["last_ns"] - dead["last_ns"]) / 1e9
+        if gap_s > 0.05:
+            culprit = {
+                "replica_id": dead_id,
+                "reason": (
+                    f"records stop at step {dead['max_step']} "
+                    f"({gap_s:.2f}s before the next replica's last record)"
+                    + (
+                        f"; peers failed in phase {failure['phase']} after"
+                        if failure is not None
+                        else ""
+                    )
+                ),
+                "signal": "silent_death",
+            }
+    # 3) last to enter the failed phase: among replicas with records at
+    #    the failure step, the one that never entered (or entered last).
+    if culprit is None and failure is not None and failure.get("step") is not None:
+        step = failure["step"]
+        entered: "Dict[str, int]" = {}
+        for e in entries:
+            if e.get("step") == step and e["op"] == failure["phase"] and e["replica_id"]:
+                entered.setdefault(e["replica_id"], e["start_ns"])
+        # Prefix-aware: fault records use the bare replica id while
+        # protocol records use the ":uuid" incarnation id — a logical
+        # replica whose incarnation entered is not missing.
+        entered_bases = {rid.split(":", 1)[0] for rid in entered}
+        missing = [
+            rid
+            for rid in replicas
+            if rid not in entered
+            and rid.split(":", 1)[0] not in entered_bases
+        ]
+        # earliest-stopped first (most suspicious); among same-base ids
+        # report the full incarnation id
+        missing.sort(key=lambda r: replicas[r]["last_ns"])
+        if missing:
+            base0 = missing[0].split(":", 1)[0]
+            candidates = [
+                r for r in missing if r.split(":", 1)[0] == base0
+            ]
+            culprit = {
+                "replica_id": max(candidates, key=len),
+                "reason": (
+                    f"never entered failed phase {failure['phase']} "
+                    f"at step {step}"
+                ),
+                "signal": "missing_phase",
+            }
+        elif len(entered) >= 2:
+            # Only meaningful with peers to compare against: with a single
+            # entrant (e.g. only the survivor's dump was collected) this
+            # would confidently blame the replica that REPORTED the
+            # failure.
+            last_rid = max(entered, key=lambda r: entered[r])
+            culprit = {
+                "replica_id": last_rid,
+                "reason": (
+                    f"last replica to enter failed phase "
+                    f"{failure['phase']} at step {step}"
+                ),
+                "signal": "last_entry",
+            }
+    # 3b) one-sided evidence: only the reporter's records exist (the peer
+    #     was SIGKILLed/OOM-killed and never dumped) but its failure names
+    #     a peer rank — point at that peer rather than staying silent or
+    #     blaming the survivor.
+    if culprit is None and failure is not None and len(replicas) == 1:
+        fail_fields = next(
+            (
+                e["fields"]
+                for e in entries
+                if e["t_ns"] == failure["t_ns"]
+                and e["status"] in _ERROR_STATUSES
+            ),
+            {},
+        )
+        peer = fail_fields.get("recv_peer", fail_fields.get("send_peer"))
+        if peer is not None:
+            culprit = {
+                "replica_id": f"replica rank {peer} (no records collected)",
+                "reason": (
+                    f"{failure['reported_by']} failed in "
+                    f"{failure['phase']} talking to rank {peer}; that peer "
+                    f"left no flight records (killed without a dump?)"
+                ),
+                "signal": "peer_without_evidence",
+            }
+    # 4) retry storms as a last resort.
+    if culprit is None and storms:
+        worst = max(storms, key=lambda s: s["retries"])
+        culprit = {
+            "replica_id": worst["replica_id"] or "(unknown)",
+            "reason": f"retry storm: {worst['retries']}x {worst['op']}",
+            "signal": "retry_storm",
+        }
+
+    return {
+        "replicas": replicas,
+        "failure": failure,
+        "culprit": culprit,
+        "faults": [
+            {
+                "replica_id": f["replica_id"],
+                "step": f.get("step"),
+                "fault": f["fields"].get("fault")
+                or f"{f['fields'].get('site', '?')}:{f['fields'].get('action', '?')}",
+                "t_ns": f["t_ns"],
+            }
+            for f in faults
+        ],
+        "retry_storms": storms,
+        "entries": len(entries),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_t(t_ns: int, t0_ns: int) -> str:
+    return f"+{(t_ns - t0_ns) / 1e9:9.3f}s"
+
+
+def render_text(
+    entries: "List[Dict[str, Any]]",
+    report: "Dict[str, Any]",
+    warnings: "List[str]",
+    max_rows: int = 200,
+) -> str:
+    out: "List[str]" = []
+    culprit = report["culprit"]
+    out.append("torchft-diagnose")
+    out.append("=" * 60)
+    if culprit:
+        out.append(
+            f"LIKELY CULPRIT: {culprit['replica_id']}  "
+            f"[{culprit['signal']}]"
+        )
+        out.append(f"  {culprit['reason']}")
+    else:
+        out.append("LIKELY CULPRIT: none identified (no failure signature)")
+    failure = report["failure"]
+    if failure:
+        out.append(
+            f"FAILED PHASE: {failure['phase']} at step={failure['step']} "
+            f"quorum_id={failure['quorum_id']} "
+            f"(first reported by {failure['reported_by'] or '?'})"
+        )
+        if failure["detail"]:
+            out.append(f"  detail: {failure['detail']}")
+    for storm in report["retry_storms"]:
+        out.append(
+            f"RETRY STORM: {storm['retries']}x {storm['op']} "
+            f"on {storm['replica_id'] or '?'}"
+        )
+    out.append("")
+    out.append("replicas:")
+    for rid, info in sorted(report["replicas"].items()):
+        out.append(
+            f"  {rid:32s} max_step={info['max_step']:<5d} "
+            f"records={info['records']:<5d} errors={info['errors']}"
+        )
+    if warnings:
+        out.append("")
+        for w in warnings:
+            out.append(f"warning: {w}")
+    out.append("")
+    out.append(f"timeline ({min(len(entries), max_rows)} of {len(entries)} entries):")
+    t0 = entries[0]["t_ns"] if entries else 0
+    shown = entries if len(entries) <= max_rows else entries[-max_rows:]
+    for e in shown:
+        step = e.get("step")
+        q = e.get("quorum_id")
+        ctx = f"step={step}" if step is not None else ""
+        if q is not None:
+            ctx += f" q={q}"
+        marker = "!" if e["status"] in _ERROR_STATUSES else (
+            "~" if e["status"] == "fault" else " ")
+        out.append(
+            f" {marker} {_fmt_t(e['t_ns'], t0)} {e['replica_id'][:28]:28s} "
+            f"{e['op']:24s} {e['status']:8s} {ctx}"
+        )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_dumps(tmpdir: str) -> "Tuple[str, str]":
+    """Two replicas: replica_b silently dies at step 3; replica_a's
+    allreduce then fails.  Written in the exact flight-dump format."""
+    t0 = time.time_ns()
+    s = 1_000_000_000  # 1s in ns
+
+    def rec(**kw: Any) -> "Dict[str, Any]":
+        return {"flight": "rec", **kw}
+
+    a_records: "List[Dict[str, Any]]" = []
+    b_records: "List[Dict[str, Any]]" = []
+    for step in range(4):
+        for rid, records in (("replica_a:u1", a_records), ("replica_b:u2", b_records)):
+            if rid.startswith("replica_b") and step >= 3:
+                continue  # b died before step 3's collective
+            base = t0 + step * s + (0 if rid.startswith("replica_a") else 10_000_000)
+            records.append(
+                rec(op="quorum_rpc", status="ok", start_ns=base,
+                    end_ns=base + 5_000_000, replica_id=rid, step=step,
+                    quorum_id=1, kind="phase")
+            )
+            records.append(
+                rec(op="allreduce", status="ok", start_ns=base + 6_000_000,
+                    end_ns=base + 9_000_000, replica_id=rid, step=step,
+                    quorum_id=1, kind="collective", rank=0, world=2)
+            )
+    # b entered step 3's quorum then vanished
+    b_base = t0 + 3 * s
+    b_records.append(
+        rec(op="quorum_rpc", status="ok", start_ns=b_base,
+            end_ns=b_base + 5_000_000, replica_id="replica_b:u2", step=3,
+            quorum_id=1, kind="phase")
+    )
+    # a's step-3 collective fails ~10s later (peer gone, deadline expired)
+    a_fail = t0 + 13 * s
+    a_records.append(
+        rec(op="allreduce", status="error", start_ns=t0 + 3 * s,
+            end_ns=a_fail, replica_id="replica_a:u1", step=3, quorum_id=1,
+            kind="collective", rank=0, world=2,
+            reason="collective failed: ConnectionError('peer closed connection')")
+    )
+
+    def write(name: str, records: "List[Dict[str, Any]]") -> str:
+        path = os.path.join(tmpdir, name)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({
+                "flight": "meta", "reason": "selftest", "trigger": "manual",
+                "ts": t0 / 1e9, "pid": 0, "records": len(records),
+            }) + "\n")
+            for r in records:
+                fh.write(json.dumps(r) + "\n")
+        return path
+
+    return write("replica_a.jsonl", a_records), write("replica_b.jsonl", b_records)
+
+
+def selftest(verbose: bool = True) -> bool:
+    """Synthetic two-replica dump pair through the full pipeline; the
+    culprit must be the silently-dead replica_b and the failed phase the
+    surviving replica's collective."""
+    with tempfile.TemporaryDirectory() as tmpdir:
+        dump_a, dump_b = _synthetic_dumps(tmpdir)
+        entries, warnings = load_records([dump_a, dump_b])
+        report = analyze(entries)
+    ok = True
+
+    def check(cond: bool, what: str) -> None:
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"selftest FAIL: {what}", file=sys.stderr)
+
+    check(len(entries) > 0, "no entries parsed")
+    check(not warnings, f"unexpected warnings: {warnings}")
+    check(report["culprit"] is not None, "no culprit identified")
+    if report["culprit"]:
+        check(
+            report["culprit"]["replica_id"].startswith("replica_b"),
+            f"culprit {report['culprit']} is not replica_b",
+        )
+    check(
+        report["failure"] is not None
+        and report["failure"]["phase"] == "allreduce"
+        and report["failure"]["step"] == 3,
+        f"failure {report['failure']} is not allreduce@3",
+    )
+    if ok and verbose:
+        print("selftest OK: culprit=replica_b, failed phase=allreduce@3")
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: "Optional[List[str]]" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="torchft-diagnose",
+        description=(
+            "Merge torchft flight dumps (TORCHFT_FLIGHT_FILE) and event "
+            "logs (TORCHFT_EVENTS_FILE) into a cross-replica timeline and "
+            "flag the likely culprit."
+        ),
+    )
+    parser.add_argument("dumps", nargs="*", help="flight dump JSONL file(s)")
+    parser.add_argument(
+        "--events", action="append", default=[],
+        help="TORCHFT_EVENTS_FILE JSONL log(s) to merge (repeatable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable JSON report"
+    )
+    parser.add_argument(
+        "--max-rows", type=int, default=200,
+        help="timeline rows shown in text output (default 200)",
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="synthetic two-replica attribution check (CI hook)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        return 0 if selftest() else 1
+    if not args.dumps and not args.events:
+        parser.print_usage(sys.stderr)
+        print("torchft-diagnose: no input files", file=sys.stderr)
+        return 2
+
+    entries, warnings = load_records(list(args.dumps), list(args.events))
+    if not entries:
+        for w in warnings:
+            print(f"warning: {w}", file=sys.stderr)
+        print("torchft-diagnose: no parseable records", file=sys.stderr)
+        return 1
+    report = analyze(entries)
+    if args.json:
+        payload = dict(report)
+        payload["warnings"] = warnings
+        payload["timeline"] = entries
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(render_text(entries, report, warnings, max_rows=args.max_rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ import optax
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.work import Work
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -108,11 +109,21 @@ class LocalSGD:
             replica=self._manager.replica_id(),
             step=self._manager.current_step(),
         )
+        with _flightrec.track(
+            "local_sgd.sync",
+            replica_id=self._manager.replica_id(),
+            step=self._manager.current_step(),
+        ) as flight:
+            self._sync(flight)
+
+    def _sync(self, flight: "_flightrec.FlightOp") -> None:
         self._local_step = 0
         self._manager.start_quorum()
         params = self._get_params()
         avg = self._manager.allreduce(params).wait(timeout=self._manager._timeout)
-        if self._manager.should_commit():
+        committed = self._manager.should_commit()
+        flight.update(committed=committed)
+        if committed:
             # Guard the mutation: an async quorum thread may be snapshotting
             # the state dict for a healing peer (reference :112-124).
             self._manager.disallow_state_dict_read()
@@ -225,6 +236,20 @@ class _Fragment:
         (reference :423-476)."""
         assert self._allreduce_work, "perform_sync before prepare_sync"
         t_sync = time.perf_counter()
+        with _flightrec.track(
+            "local_sgd.fragment_sync",
+            fragment=self._fragment_id,
+            replica_id=self._manager.replica_id(),
+            step=self._manager.current_step(),
+        ) as flight:
+            result = self._perform_sync()
+            flight.update(committed=result)
+        _metrics.DILOCO_SYNC_SECONDS.labels(fragment=str(self._fragment_id)).set(
+            time.perf_counter() - t_sync
+        )
+        return result
+
+    def _perform_sync(self) -> bool:
         work = self._allreduce_work.pop()
         avg_pseudograds = work.wait(timeout=self._manager._timeout)
         wire_bytes = getattr(work, "wire_bytes", None)
@@ -273,9 +298,6 @@ class _Fragment:
             )
             self._write_fragment(merged)
         self._local_parameters = None
-        _metrics.DILOCO_SYNC_SECONDS.labels(fragment=str(self._fragment_id)).set(
-            time.perf_counter() - t_sync
-        )
         return should_commit
 
 

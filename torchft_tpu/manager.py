@@ -34,6 +34,7 @@ import socket
 import threading
 import time
 import uuid
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from enum import Enum
@@ -47,6 +48,7 @@ from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, 
 from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_SUM
 from torchft_tpu.parallel.work import Work, completed_work
 from torchft_tpu.utils import faults as faults
+from torchft_tpu.utils import flightrecorder as flightrec
 from torchft_tpu.utils import metrics as metrics
 from torchft_tpu.utils import tracing as tracing
 from torchft_tpu.utils.logging import ReplicaLogger, log_event
@@ -390,6 +392,11 @@ class Manager:
 
         self._errored = None
         self._healing = False
+        # Straggler telemetry: piggyback (step, in-flight op) on the native
+        # manager's lighthouse heartbeats, so the lighthouse can compute
+        # per-replica step lag and straggler scores while this replica is
+        # inside the quorum protocol.
+        self._report_progress("quorum")
 
         tracer = tracing.get_tracer()
         self._round_trace = (
@@ -761,6 +768,20 @@ class Manager:
             quorum_id=self._quorum_id,
             step=self._step,
         )
+        # Flight recorder: the latched error plus a crash-durable dump of
+        # the ring around it — an unhandled manager error is a dump
+        # trigger (utils/flightrecorder.py); no-op without
+        # TORCHFT_FLIGHT_FILE.
+        flightrec.record(
+            "manager.error",
+            status="error",
+            error=str(e),
+            replica_id=self._replica_id,
+            rank=self._group_rank,
+            quorum_id=self._quorum_id,
+            step=self._step,
+        )
+        flightrec.dump(f"manager error: {e!r}", trigger="manager_error")
 
     def errored(self) -> "Optional[Exception]":
         return self._errored
@@ -843,6 +864,10 @@ class Manager:
                 self._logger.exception(msg)
                 raise RuntimeError(msg)
         self._m_step.set(self._step)
+        # step (possibly) advanced: refresh the heartbeat-piggybacked
+        # progress so lighthouse step-lag tracking follows commits, not
+        # just quorum entries
+        self._report_progress("")
 
         # Close the quorum round's root span (children were emitted per
         # phase from _record_phase); trace joins to the structured events
@@ -880,6 +905,18 @@ class Manager:
         Called from the caller thread AND the async quorum thread."""
         with self._phase_lock:
             self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dt
+        # flight record per phase: the quorum protocol's footprint in the
+        # postmortem timeline (~6 records/step; record() is ~1 us)
+        end_ns = time.time_ns()
+        flightrec.record(
+            name,
+            kind="phase",
+            start_ns=end_ns - int(dt * 1e9),
+            end_ns=end_ns,
+            replica_id=self._replica_id,
+            quorum_id=self._quorum_id,
+            step=self._step,
+        )
         child = self._phase_hist.get(name)
         if child is None:
             # benign race: concurrent creators both resolve to the same
@@ -942,9 +979,29 @@ class Manager:
 
         Resets the accumulator.
         """
+        warnings.warn(
+            "Manager.pop_phase_times() is deprecated (destructive single-"
+            "consumer drain): read phase_times() or the "
+            "torchft_quorum_duration_seconds histogram instead",
+            DeprecationWarning,
+            stacklevel=2,
+        )
         with self._phase_lock:
             out, self._phase_acc = self._phase_acc, {}
         return out
+
+    def _report_progress(self, inflight_op: str) -> None:
+        """Push (step, in-flight op) to the group's native ManagerServer so
+        its lighthouse heartbeats carry per-replica progress (rank 0 only —
+        the heartbeat is per replica group).  Best-effort: progress
+        telemetry never fails a step."""
+        server = self._manager_server
+        if server is None:
+            return
+        try:
+            server.report_progress(self._step, inflight_op)
+        except Exception:  # noqa: BLE001 - telemetry must not fail the step
+            logger.debug("progress report failed", exc_info=True)
 
     def current_step(self) -> int:
         return self._step

@@ -49,6 +49,7 @@ import numpy as np
 from torchft_tpu.coordination import StoreClient
 from torchft_tpu.parallel.work import Work, completed_work, failed_work
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -372,13 +373,16 @@ class ProcessGroupTCP(ProcessGroup):
         self._bucket: "Optional[_TokenBucket]" = (
             _TokenBucket(bandwidth_gbps * 1e9) if bandwidth_gbps else None
         )
-        # In-flight op record for the abort flight recorder.  Guarded by
-        # _flight_lock: written by the worker + sender threads, dumped by
-        # abort() from any thread (an unguarded dict copy can raise
-        # "changed size during iteration" on exactly the contended aborts
-        # the recorder exists for).
-        self._flight: "Optional[Dict[str, Any]]" = None
-        self._flight_lock = threading.Lock()
+        # In-flight op handle in the process-wide flight recorder
+        # (utils/flightrecorder.py; subsumes the old ad-hoc ``_flight``
+        # dict).  The FlightOp serializes its own updates (worker + sender
+        # threads write); _flight_swap_lock guards the TAKE of the handle
+        # so the worker's success path and a concurrent abort() cannot
+        # both finish the same op (the loser would mislabel a completed
+        # collective as aborted).
+        self._flight_op: "Optional[_flightrec.FlightOp]" = None
+        self._flight_swap_lock = threading.Lock()
+        self._replica_id = ""
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
         self._sender: "Optional[concurrent_futures.ThreadPoolExecutor]" = None
@@ -400,6 +404,8 @@ class ProcessGroupTCP(ProcessGroup):
         # chaos site: a reconfigure failure here surfaces to the Manager's
         # configure try-block, which latches it and re-forms next quorum
         _faults.check("pg.reconfigure", replica=replica_id)
+        self._replica_id = replica_id
+        t_cfg_ns = time.time_ns()
         self._teardown()
         deadline = time.monotonic() + self._timeout
 
@@ -415,6 +421,10 @@ class ProcessGroupTCP(ProcessGroup):
             self._peers = {}
             self._start_worker(gen)
             _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
+            _flightrec.record(
+                "pg.configure", start_ns=t_cfg_ns, replica_id=replica_id,
+                rank=rank, world=world_size,
+            )
             return
 
         addr, _, prefix = store_addr.partition("/")
@@ -464,7 +474,16 @@ class ProcessGroupTCP(ProcessGroup):
             self._peers = peers
             self._start_worker(gen)
             _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
-        except Exception:
+            _flightrec.record(
+                "pg.configure", start_ns=t_cfg_ns, replica_id=replica_id,
+                rank=rank, world=world_size,
+            )
+        except Exception as e:
+            _flightrec.record(
+                "pg.configure", status="error", start_ns=t_cfg_ns,
+                replica_id=replica_id, rank=rank, world=world_size,
+                error=repr(e),
+            )
             self._teardown()
             raise
         finally:
@@ -525,7 +544,14 @@ class ProcessGroupTCP(ProcessGroup):
                 item[2].set_exception(_PGAborted("process group torn down"))
 
     def abort(self) -> None:
-        self._dump_flight("process group aborted")
+        self._dump_flight("process group aborted", dump=False)
+        _flightrec.record(
+            "pg.abort", status="abort", replica_id=self._replica_id,
+            rank=self._rank, world=self._world,
+        )
+        # one dump per abort, whether or not an op was in flight: the ring
+        # around the abort IS the postmortem evidence
+        _flightrec.dump("process group aborted", trigger="pg_abort")
         _metrics.PG_ABORTS.labels(transport="tcp").inc()
         with self._lock:
             self._aborted = True
@@ -576,18 +602,21 @@ class ProcessGroupTCP(ProcessGroup):
                     errored or _PGAborted("process group reconfigured")
                 )
                 continue
-            with self._flight_lock:
-                self._flight = {
-                    "op": op,
-                    "generation": item_gen,
-                    "rank": self._rank,
-                    "world": self._world,
-                    "started_at": time.time(),
-                }
+            self._flight_op = _flightrec.start(
+                op,
+                kind="collective",
+                generation=item_gen,
+                rank=self._rank,
+                world=self._world,
+                replica_id=self._replica_id,
+            )
             try:
-                fut.set_result(fn())
-                with self._flight_lock:
-                    self._flight = None
+                result = fn()
+                with self._flight_swap_lock:
+                    flight_op, self._flight_op = self._flight_op, None
+                if flight_op is not None:
+                    flight_op.finish("ok")
+                fut.set_result(result)
             except Exception as e:  # noqa: BLE001 - latch every op failure
                 # Flight-recorder dump BEFORE latching: when a wedged
                 # collective dies (deadline, peer reset), the op-level state
@@ -595,7 +624,7 @@ class ProcessGroupTCP(ProcessGroup):
                 # evidence the postmortem needs (reference dumps the NCCL
                 # flight recorder on abort for the same reason,
                 # torchft/process_group.py:89-108,830-838).
-                self._dump_flight(f"collective failed: {e!r}")
+                self._dump_flight(f"collective failed: {e!r}", error=repr(e))
                 with self._lock:
                     if self._errored is None:
                         self._errored = e
@@ -606,38 +635,46 @@ class ProcessGroupTCP(ProcessGroup):
     def _flight_io(self, **kw: Any) -> None:
         """Merge current transfer state (direction, peer, tag, bytes) into
         the in-flight op record (worker or sender thread)."""
-        with self._flight_lock:
-            if self._flight is not None:
-                self._flight.update(kw)
+        op = self._flight_op
+        if op is not None:
+            op.update(**kw)
 
     def _flight_progress(self, nbytes: int) -> None:
-        with self._flight_lock:
-            f = self._flight
-            if f is not None:
-                f["bytes_done"] = f.get("bytes_done", 0) + nbytes
+        op = self._flight_op
+        if op is not None:
+            op.add_bytes(nbytes)
 
-    def _dump_flight(self, reason: str) -> None:
-        """Write the in-flight op table to the structured event pipeline
-        (JSONL sink when TORCHFT_EVENTS_FILE is set)."""
-        with self._flight_lock:
-            f = self._flight
-            self._flight = None
-            if f is None:
-                return
-            f = dict(f)
-        from torchft_tpu.utils.logging import log_event
-
+    def _dump_flight(self, reason: str, dump: bool = True, **extra: Any) -> None:
+        """Finish the in-flight op as failed: the completed record lands in
+        the process flight ring, a legacy ``abort`` event goes to the
+        structured pipeline (JSONL sink when TORCHFT_EVENTS_FILE is set),
+        and — unless the caller dumps separately — the whole ring is
+        dumped to TORCHFT_FLIGHT_FILE."""
+        with self._flight_swap_lock:
+            flight_op, self._flight_op = self._flight_op, None
+        if flight_op is None:
+            return
         # Best-effort: the recorder must never mask the collective error.
         try:
+            rec = flight_op.finish("error", reason=reason, **extra)
+            from torchft_tpu.utils.logging import log_event
+
+            f = {
+                k: v
+                for k, v in rec.items()
+                if k not in ("status", "start_ns", "end_ns", "kind")
+            }
             deadline = f.pop("deadline_mono", None)
             if deadline is not None:
                 f["deadline_remaining_s"] = round(
                     deadline - time.monotonic(), 3
                 )
-            started = f.pop("started_at", None)
-            if started is not None:
-                f["in_flight_s"] = round(time.time() - started, 3)
+            f["in_flight_s"] = round(
+                (rec["end_ns"] - rec["start_ns"]) / 1e9, 3
+            )
             log_event("abort", reason, **f)
+            if dump:
+                _flightrec.dump(reason, trigger="pg_abort")
         except Exception:  # noqa: BLE001 - recorder must never mask the error
             logger.exception("flight-recorder dump failed")
 
@@ -1608,6 +1645,7 @@ class ProcessGroupBaby(ProcessGroup):
         self._world = -1
         self._errored_exc: Optional[Exception] = None
         self._next_op_id = 0
+        self._baby_replica_id = ""
         self._gen = 0  # bumped per configure; guards against stale readers
         self._pending: Dict[int, Future] = {}
         self._pending_shm: "Dict[int, List[Any]]" = {}
@@ -1622,6 +1660,7 @@ class ProcessGroupBaby(ProcessGroup):
         _faults.check("pg.reconfigure", replica=replica_id)
         self._kill_worker()
         self._errored_exc = None
+        self._baby_replica_id = replica_id
         self._rank = rank
         self._world = world_size
 
@@ -1828,6 +1867,12 @@ class ProcessGroupBaby(ProcessGroup):
 
     def abort(self) -> None:
         _metrics.PG_ABORTS.labels(transport="baby").inc()
+        _flightrec.record(
+            "pg.abort", status="abort", transport="baby",
+            replica_id=self._baby_replica_id, rank=self._rank,
+            world=self._world,
+        )
+        _flightrec.dump("baby process group aborted", trigger="pg_abort")
         self._kill_worker()  # latches _PGAborted via _fail_all
 
     def errored(self) -> Optional[Exception]:

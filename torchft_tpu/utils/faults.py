@@ -16,6 +16,10 @@ site                  fires in
 ====================  =====================================================
 ``lighthouse.rpc``    ``LighthouseClient`` framed-JSON calls
                       (coordination.py)
+``lighthouse.heartbeat``  ``LighthouseClient.heartbeat`` — the Python
+                      heartbeat/progress-piggyback client (tests and
+                      custom FT algorithms; the native manager's C++
+                      heartbeat loop does not consult this registry)
 ``manager.quorum``    ``Manager._async_quorum`` before the quorum RPC
 ``manager.heal``      ``Manager._async_quorum`` heal send/recv branches
 ``pg.reconfigure``    ``ProcessGroupTCP.configure`` /
@@ -94,6 +98,7 @@ __all__ = [
 # unknown names instead of silently never firing.
 KNOWN_SITES: "Tuple[str, ...]" = (
     "lighthouse.rpc",
+    "lighthouse.heartbeat",
     "manager.quorum",
     "manager.heal",
     "pg.reconfigure",
@@ -309,6 +314,23 @@ class FaultRegistry:
             )
         except Exception:  # noqa: BLE001
             logger.exception("fault event emit failed")
+        try:
+            from torchft_tpu.utils import flightrecorder as _fr
+
+            # fault-tagged flight record: torchft-diagnose attributes a
+            # chaos-killed replica from exactly this tag
+            extra = {} if step is None else {"step": step}
+            _fr.record(
+                "fault",
+                status="fault",
+                fault=f"{site}:{rule.action}",
+                site=site,
+                action=rule.action,
+                replica_id=replica or "",
+                **extra,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("fault flight record failed")
 
 
 #: The process-wide registry every production site consults.

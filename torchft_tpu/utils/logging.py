@@ -34,7 +34,16 @@ import time
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Deque, Dict, List, Optional, TextIO
 
-_EVENT_RING_SIZE = 256
+def _event_ring_size() -> int:
+    """Ring capacity from ``TORCHFT_EVENTS_RING`` (default 256).  Read at
+    import (the ring is a module singleton) — set the env before the first
+    ``import torchft_tpu`` to size it."""
+    from torchft_tpu.utils.flightrecorder import env_int
+
+    return env_int("TORCHFT_EVENTS_RING", 256)
+
+
+_EVENT_RING_SIZE = _event_ring_size()
 
 _LOGGERS = {
     "quorum": logging.getLogger("torchft_quorums"),

@@ -922,3 +922,9 @@ RETRY_BACKOFF = histogram(
     "Backoff slept before each retry attempt, by operation",
     ("op",),
 )
+FLIGHT_DUMPS = counter(
+    "torchft_flight_dumps_total",
+    "Flight-recorder dumps written, by trigger "
+    "(pg_abort/manager_error/signal/manual; utils/flightrecorder.py)",
+    ("trigger",),
+)

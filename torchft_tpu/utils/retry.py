@@ -120,6 +120,7 @@ class RetryPolicy:
         ``on_retry(exc, attempt_number, delay)`` observes each retry.
         """
         from torchft_tpu.utils import metrics as _metrics
+        from torchft_tpu.utils import flightrecorder as _flightrec
 
         op = op or self.name
         budget = self.total_timeout if timeout is None else timeout
@@ -159,6 +160,17 @@ class RetryPolicy:
                     delay = min(delay, max(deadline - time.monotonic(), 0.0))
                 _metrics.RETRIES.labels(op=op).inc()
                 _metrics.RETRY_BACKOFF.labels(op=op).observe(delay)
+                # flight record per retry: torchft-diagnose flags retry
+                # storms (many of these in a short window) as a culprit
+                # signal
+                _flightrec.record(
+                    "retry",
+                    status="retry",
+                    retry_op=op,
+                    attempt=attempt,
+                    backoff_s=round(delay, 4),
+                    error=repr(e),
+                )
                 if on_retry is not None:
                     on_retry(e, attempt, delay)
                 if delay > 0:
