@@ -157,7 +157,7 @@ class LighthouseServer : public RpcServer {
   LighthouseOpt opt_;
 
   std::mutex mu_;
-  std::condition_variable quorum_cv_;
+  CondVar quorum_cv_;
   std::map<std::string, ParticipantDetails> participants_;
   std::map<std::string, int64_t> heartbeats_;
   // replica_id -> progress (pruned with heartbeats_ on supersession).

@@ -94,7 +94,7 @@ class ManagerServer : public RpcServer {
   ManagerOpt opt_;
 
   std::mutex mu_;
-  std::condition_variable cv_;
+  CondVar cv_;
   // quorum round state
   std::map<int64_t, std::string> checkpoint_metadata_;  // rank -> metadata
   std::set<int64_t> quorum_participants_;

@@ -361,12 +361,16 @@ void RpcServer::start() {
 void RpcServer::shutdown() {
   bool expected = false;
   if (!stopping_.compare_exchange_strong(expected, true)) return;
+  // Wake the blocked accept() (Linux: returns EINVAL after SHUT_RDWR on a
+  // listener), JOIN, and only then close/clear the fd: closing first would
+  // race the accept thread's read of listen_fd_ — and worse, free the fd
+  // number for reuse while accept() still holds it.
+  if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  if (accept_thread_.joinable()) accept_thread_.join();
   if (listen_fd_ >= 0) {
-    ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
     listen_fd_ = -1;
   }
-  if (accept_thread_.joinable()) accept_thread_.join();
   {
     // Force blocked reads to return (peer-closed) so threads can exit. The
     // owning connection thread still does the close(), so the fd number

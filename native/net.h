@@ -7,7 +7,12 @@
 // a protocol thread.
 #pragma once
 
+#include <pthread.h>
+
 #include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <mutex>
@@ -22,6 +27,75 @@ namespace tft {
 
 // Milliseconds since an arbitrary monotonic epoch.
 int64_t now_ms();
+
+// Drop-in condition variable pinned to sanitizer-intercepted primitives.
+//
+// libstdc++ 10 on glibc >= 2.30 implements
+// std::condition_variable::wait_for/wait_until via pthread_cond_clockwait,
+// which gcc 10's ThreadSanitizer does NOT intercept: the wait's internal
+// mutex unlock/relock is invisible to TSan, which then reports a bogus
+// "double lock of a mutex" and — with the mutex's happens-before state
+// corrupted — a cascade of false data races on every guarded field.  The
+// SANITIZE=thread build (docs/static_analysis.md) is a tier gate, so the
+// coordination servers use this wrapper instead: pthread_cond_timedwait
+// on a CLOCK_MONOTONIC condattr (both intercepted since forever), with
+// identical semantics for this codebase's uses — steady_clock deadlines,
+// no spurious-wakeup guarantees beyond the standard's.
+class CondVar {
+ public:
+  CondVar() {
+    pthread_condattr_t attr;
+    pthread_condattr_init(&attr);
+    pthread_condattr_setclock(&attr, CLOCK_MONOTONIC);
+    pthread_cond_init(&cv_, &attr);
+    pthread_condattr_destroy(&attr);
+  }
+  ~CondVar() { pthread_cond_destroy(&cv_); }
+  CondVar(const CondVar&) = delete;
+  CondVar& operator=(const CondVar&) = delete;
+
+  void notify_one() { pthread_cond_signal(&cv_); }
+  void notify_all() { pthread_cond_broadcast(&cv_); }
+
+  void wait(std::unique_lock<std::mutex>& lk) {
+    pthread_cond_wait(&cv_, lk.mutex()->native_handle());
+  }
+
+  std::cv_status wait_until(std::unique_lock<std::mutex>& lk,
+                            std::chrono::steady_clock::time_point tp) {
+    // steady_clock is CLOCK_MONOTONIC on Linux — same epoch as the
+    // condattr clock above, so the time_point converts directly.
+    int64_t ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                     tp.time_since_epoch())
+                     .count();
+    if (ns < 0) ns = 0;
+    struct timespec ts;
+    ts.tv_sec = static_cast<time_t>(ns / 1000000000);
+    ts.tv_nsec = static_cast<long>(ns % 1000000000);
+    int rc = pthread_cond_timedwait(&cv_, lk.mutex()->native_handle(), &ts);
+    return rc == ETIMEDOUT ? std::cv_status::timeout
+                           : std::cv_status::no_timeout;
+  }
+
+  template <class Rep, class Period>
+  std::cv_status wait_for(std::unique_lock<std::mutex>& lk,
+                          const std::chrono::duration<Rep, Period>& d) {
+    return wait_until(lk, std::chrono::steady_clock::now() + d);
+  }
+
+  template <class Rep, class Period, class Pred>
+  bool wait_for(std::unique_lock<std::mutex>& lk,
+                const std::chrono::duration<Rep, Period>& d, Pred pred) {
+    auto deadline = std::chrono::steady_clock::now() + d;
+    while (!pred()) {
+      if (wait_until(lk, deadline) == std::cv_status::timeout) return pred();
+    }
+    return true;
+  }
+
+ private:
+  pthread_cond_t cv_;
+};
 
 // ---- framed message I/O --------------------------------------------------
 // Wire format: 4-byte big-endian length, then that many bytes of UTF-8 JSON.

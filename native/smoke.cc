@@ -1,0 +1,129 @@
+// Sanitizer smoke driver: two replica groups drive live quorum + commit
+// rounds through a real Lighthouse, concurrently, then everything shuts
+// down cleanly.
+//
+// Built by `make SANITIZE=thread smoke` (or address) as a standalone
+// executable so the sanitizer runtime owns the process from startup —
+// dlopen'ing an instrumented .so into an uninstrumented Python would
+// leave TSan blind to the interpreter's threads.  Exercised paths: the
+// accept-loop + per-connection threads (net.cc), the lighthouse tick
+// thread + quorum barrier (lighthouse.cc), both managers' heartbeat
+// threads and detached quorum threads racing report_progress and the
+// commit barrier (manager.cc), and full shutdown teardown.
+//
+// Exit 0 and a final "SMOKE OK" line mean the protocol ran; ThreadSanitizer
+// reports (if any) go to stderr and flip the exit code via
+// TSAN_OPTIONS=exitcode / halt_on_error set by the test harness
+// (tests/test_native_sanitize.py).
+
+#include <cstdio>
+#include <string>
+#include <thread>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "net.h"
+#include "store.h"
+
+namespace {
+
+constexpr int kRounds = 3;
+constexpr int64_t kRpcTimeoutMs = 15000;
+
+int drive_round(const std::string& manager_addr, int round) {
+  tft::Json params = tft::Json::object();
+  params["group_rank"] = static_cast<int64_t>(0);
+  params["init_sync"] = true;
+  params["checkpoint_metadata"] = std::string("smoke-meta");
+  params["step"] = static_cast<int64_t>(round);
+  params["shrink_only"] = false;
+  params["commit_failures"] = static_cast<int64_t>(0);
+
+  tft::Json result;
+  std::string err;
+  if (!tft::call_rpc(manager_addr, "quorum", params, kRpcTimeoutMs, &result,
+                     &err)) {
+    fprintf(stderr, "smoke: quorum rpc to %s failed: %s\n",
+            manager_addr.c_str(), err.c_str());
+    return 1;
+  }
+  if (result.get("replica_world_size").as_int() != 2) {
+    fprintf(stderr, "smoke: expected replica_world_size=2, got %lld\n",
+            static_cast<long long>(result.get("replica_world_size").as_int()));
+    return 1;
+  }
+
+  tft::Json commit = tft::Json::object();
+  commit["group_rank"] = static_cast<int64_t>(0);
+  commit["should_commit"] = true;
+  if (!tft::call_rpc(manager_addr, "should_commit", commit, kRpcTimeoutMs,
+                     &result, &err)) {
+    fprintf(stderr, "smoke: should_commit rpc to %s failed: %s\n",
+            manager_addr.c_str(), err.c_str());
+    return 1;
+  }
+  if (!result.get("should_commit").as_bool()) {
+    fprintf(stderr, "smoke: unanimous true votes decided false\n");
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  tft::LighthouseOpt lopt;
+  lopt.bind_host = "127.0.0.1";
+  lopt.min_replicas = 2;
+  lopt.join_timeout_ms = 2000;
+  lopt.quorum_tick_ms = 10;
+  lopt.heartbeat_timeout_ms = 5000;
+  tft::LighthouseServer lighthouse(lopt);
+  lighthouse.start_serving();
+
+  tft::StoreServer store("127.0.0.1", 0);
+  store.start();
+
+  auto make_opt = [&](const std::string& id) {
+    tft::ManagerOpt mopt;
+    mopt.replica_id = id;
+    mopt.lighthouse_addr = lighthouse.address();
+    mopt.bind_host = "127.0.0.1";
+    mopt.store_address = store.address();
+    mopt.world_size = 1;
+    mopt.heartbeat_interval_ms = 20;  // hot heartbeats: more thread traffic
+    mopt.connect_timeout_ms = 5000;
+    mopt.quorum_retries = 1;
+    return mopt;
+  };
+  tft::ManagerServer m0(make_opt("replica_0"));
+  tft::ManagerServer m1(make_opt("replica_1"));
+  m0.start_serving();
+  m1.start_serving();
+
+  int failures = 0;
+  for (int round = 0; round < kRounds; ++round) {
+    // progress reports race the heartbeat thread's reads — on purpose
+    m0.report_progress(round, "quorum");
+    m1.report_progress(round, "quorum");
+    int f0 = 0, f1 = 0;
+    std::thread t0([&] { f0 = drive_round(m0.address(), round); });
+    std::thread t1([&] { f1 = drive_round(m1.address(), round); });
+    t0.join();
+    t1.join();
+    failures += f0 + f1;
+    if (failures) break;
+  }
+
+  m0.stop();
+  m1.stop();
+  lighthouse.stop();
+  store.shutdown();
+
+  if (failures) {
+    printf("SMOKE FAIL\n");
+    return 1;
+  }
+  printf("SMOKE OK\n");
+  return 0;
+}

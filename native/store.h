@@ -27,7 +27,7 @@ class StoreServer : public RpcServer {
 
  private:
   std::mutex mu_;
-  std::condition_variable cv_;
+  CondVar cv_;
   std::map<std::string, std::string> kv_;
 };
 

@@ -12,6 +12,11 @@ import sys
 # var alone does not win, so also override via jax.config before any backend
 # initialization.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Tier-1 runs with the runtime lock-order detector armed (must be set
+# before the first torchft_tpu import, which creates the instrumented
+# locks).  Export TORCHFT_LOCKCHECK=0 to opt out locally.
+os.environ.setdefault("TORCHFT_LOCKCHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -191,8 +191,20 @@ class TestHotPathBudget:
         """Acceptance bar: <= ~2 us per record() on the hot path.  Best of
         several batches so a loaded 1-core CI host doesn't flake the
         measurement; the implementation is one dict build + one lock +
-        one slot assignment (~0.5-1 us typical)."""
-        rec = fr.FlightRecorder(capacity=512)
+        one slot assignment (~0.5-1 us typical).
+
+        The bar is for the production configuration: the tier-1 harness
+        runs with TORCHFT_LOCKCHECK=1 (conftest), whose instrumented
+        locks deliberately trade ~3 us for order checking, so this
+        recorder is built with the detector off."""
+        from torchft_tpu.utils import lockcheck
+
+        was = lockcheck.enabled()
+        lockcheck.set_enabled(False)
+        try:
+            rec = fr.FlightRecorder(capacity=512)
+        finally:
+            lockcheck.set_enabled(was)
         n = 20_000
         best = float("inf")
         for _ in range(5):

@@ -1,0 +1,169 @@
+"""tft-lint tier-1 gate: the whole suite runs clean over torchft_tpu/,
+every pass's selftest passes, and a seeded violation of EACH pass is
+caught (the suite must distrust itself before CI trusts it)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from torchft_tpu.analysis import PASSES, Project, run_passes
+from torchft_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "torchft_tpu")
+
+
+class TestSuiteIsClean:
+    def test_tree_lints_clean_with_empty_baselines(self, capsys):
+        """The acceptance bar: `python -m torchft_tpu.analysis torchft_tpu/`
+        exits 0 — every project invariant holds on the shipped tree, with
+        nothing grandfathered."""
+        rc = lint_main([PKG])
+        out = capsys.readouterr().out
+        assert rc == 0, f"tft-lint found violations:\n{out}"
+        assert "0 finding(s)" in out
+        # nothing hides behind the baselines either
+        assert "baselined" not in out
+
+    def test_baseline_files_ship_empty(self):
+        bdir = os.path.join(PKG, "analysis", "baselines")
+        for p in PASSES:
+            path = os.path.join(bdir, f"{p.id}.txt")
+            assert os.path.isfile(path), f"missing baseline file for {p.id}"
+            lines = [
+                ln
+                for ln in open(path, encoding="utf-8").read().splitlines()
+                if ln.strip() and not ln.lstrip().startswith("#")
+            ]
+            assert lines == [], f"{p.id} baseline is not empty: {lines}"
+
+    def test_module_entrypoint_subprocess(self):
+        """The exact CI invocation, end to end."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchft_tpu.analysis", "torchft_tpu/"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestSelftests:
+    @pytest.mark.parametrize("lint_pass", PASSES, ids=lambda p: p.id)
+    def test_pass_selftest(self, lint_pass):
+        lint_pass.selftest()  # raises SelftestError on miss
+
+    def test_selftest_cli(self, capsys):
+        assert lint_main(["--selftest"]) == 0
+
+
+# One seeded violation per pass: source planted in a synthetic project
+# tree; the named pass must flag it and the CLI must exit 1.
+_SEEDED = {
+    "lock-discipline": {
+        "pkg/bad.py": textwrap.dedent(
+            """
+            import time, threading
+            _lock = threading.Lock()
+            def f():
+                with _lock:
+                    time.sleep(1)
+            """
+        ),
+    },
+    "env-hygiene": {
+        "pkg/bad.py": 'import os\nX = os.environ.get("TORCHFT_SNEAKY", "")\n',
+    },
+    "metrics-sync": {
+        "pkg/bad.py": (
+            "from torchft_tpu.utils.metrics import counter\n"
+            'M = counter("myapp_rogue_total", "wrong namespace")\n'
+        ),
+    },
+    "retry-ban": {
+        "pkg/bad.py": textwrap.dedent(
+            """
+            import time
+            def fetch():
+                while True:
+                    try:
+                        return do()
+                    except ConnectionError:
+                        time.sleep(1)
+            """
+        ),
+    },
+    "fault-coverage": {
+        "pkg/utils/faults.py": 'KNOWN_SITES = ("pg.allreduce",)\n',
+        "pkg/bad.py": (
+            "from torchft_tpu.utils import faults\n"
+            'faults.check("pg.allreduce")\n'
+            'faults.check("pg.not_a_site")\n'
+        ),
+    },
+}
+
+
+def _plant(tmp_path, files):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "observability.md").write_text("")
+    (tmp_path / "docs" / "robustness.md").write_text("`pg.allreduce`\n")
+    paths = []
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        paths.append(str(path))
+    return paths
+
+
+class TestSeededViolations:
+    @pytest.mark.parametrize("pass_id", sorted(_SEEDED), ids=str)
+    def test_seeded_violation_is_caught(self, tmp_path, pass_id):
+        paths = _plant(tmp_path, _SEEDED[pass_id])
+        project = Project(str(tmp_path), paths)
+        lint_pass = next(p for p in PASSES if p.id == pass_id)
+        results = run_passes([lint_pass], project, baseline_dir=str(tmp_path / "nobase"))
+        findings = [f for r in results for f in r.findings]
+        assert findings, f"{pass_id} missed its seeded violation"
+        assert any(f.pass_id == pass_id for f in findings)
+
+    def test_cli_exits_nonzero_on_seeded_violation(self, tmp_path, capsys):
+        paths = _plant(tmp_path, _SEEDED["retry-ban"])
+        rc = lint_main([*paths, "--passes", "retry-ban", "--baseline-dir", str(tmp_path / "nb")])
+        assert rc == 1
+        assert "sleep-in-loop" in capsys.readouterr().out
+
+
+class TestBaselineWorkflow:
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        """Grandfathering: --write-baseline makes a dirty tree pass, and
+        the fingerprints are line-number-free (stable under edits above)."""
+        paths = _plant(tmp_path, _SEEDED["retry-ban"])
+        bdir = str(tmp_path / "baselines")
+        assert lint_main([*paths, "--passes", "retry-ban", "--baseline-dir", bdir, "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert lint_main([*paths, "--passes", "retry-ban", "--baseline-dir", bdir]) == 0
+        out = capsys.readouterr().out
+        assert "1 baselined" in out
+        # shifting the finding down two lines must not churn the baseline
+        bad = tmp_path / "pkg" / "bad.py"
+        bad.write_text("# moved\n# down\n" + bad.read_text())
+        assert lint_main([*paths, "--passes", "retry-ban", "--baseline-dir", bdir]) == 0
+
+    def test_rewrite_baseline_keeps_grandfathered_findings(self, tmp_path, capsys):
+        """--write-baseline twice in a row must be idempotent: the second
+        write grandfathers the FULL finding set, not just the (already
+        filtered, hence empty) fresh ones."""
+        paths = _plant(tmp_path, _SEEDED["retry-ban"])
+        bdir = str(tmp_path / "baselines")
+        base = [*paths, "--passes", "retry-ban", "--baseline-dir", bdir]
+        assert lint_main([*base, "--write-baseline"]) == 0
+        assert lint_main([*base, "--write-baseline"]) == 0  # re-run: no erase
+        capsys.readouterr()
+        assert lint_main(base) == 0
+        assert "1 baselined" in capsys.readouterr().out

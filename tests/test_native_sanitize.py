@@ -1,0 +1,77 @@
+"""Native sanitizer builds: the Makefile's SANITIZE= modes and the TSan
+lighthouse+manager quorum smoke (slow-marked — a TSan rebuild+run is
+tens of seconds).
+
+The smoke is a standalone C++ executable (native/smoke.cc) rather than a
+dlopen'd .so: the sanitizer runtime must own the process from startup to
+interpose on every thread.  See docs/static_analysis.md "native
+sanitizer builds"."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def _make(*args, timeout=600):
+    return subprocess.run(
+        ["make", "-C", NATIVE, *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+class TestMakefileModes:
+    def test_bad_sanitize_value_is_rejected(self):
+        proc = _make("SANITIZE=bogus", timeout=60)
+        assert proc.returncode != 0
+        assert "SANITIZE must be" in proc.stderr + proc.stdout
+
+    def test_production_flags_carry_werror(self):
+        """The -Wno-unused-parameter escape hatch is gone: the tree owns
+        -Wall -Wextra -Werror."""
+        text = open(os.path.join(NATIVE, "Makefile")).read()
+        assert "-Werror" in text
+        assert "-Wno-unused-parameter" not in text
+
+
+@pytest.mark.slow
+class TestTsanQuorumSmoke:
+    def test_tsan_build_and_quorum_smoke(self):
+        """Acceptance bar: `make -C native SANITIZE=thread` builds, and
+        the quorum smoke (2 replica groups x 3 live quorum+commit rounds
+        through a real lighthouse) runs with ZERO ThreadSanitizer
+        reports."""
+        proc = _make("SANITIZE=thread", "smoke")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        binary = os.path.join(NATIVE, "build-tsan", "quorum_smoke")
+        assert os.path.exists(binary)
+        run = subprocess.run(
+            [binary],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env={**os.environ, "TSAN_OPTIONS": "halt_on_error=0 exitcode=66"},
+        )
+        assert "SMOKE OK" in run.stdout, run.stdout + run.stderr
+        assert "WARNING: ThreadSanitizer" not in run.stderr, run.stderr
+        assert run.returncode == 0, f"exit={run.returncode}\n{run.stderr}"
+
+    def test_sanitized_objects_stay_out_of_production_dir(self):
+        """SANITIZE builds land in build-tsan/ — the production .so that
+        _native.py loads in-place must never silently become an
+        instrumented one."""
+        if not os.path.isdir(os.path.join(NATIVE, "build-tsan")):
+            # selective run on a clean checkout: the sibling test (or a
+            # manual `make SANITIZE=thread`) produces the TSan tree
+            pytest.skip("no TSan build present; run the smoke test first")
+        # the production lib path is untouched by the sanitize build
+        prod = os.path.join(NATIVE, "libtorchft_tpu_native.so")
+        if os.path.exists(prod):
+            with open(prod, "rb") as fh:
+                blob = fh.read()
+            assert b"__tsan_init" not in blob

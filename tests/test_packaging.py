@@ -36,6 +36,18 @@ class TestPackaging:
             main(["--help"])
         assert e.value.code == 0
 
+    def test_tft_lint_console_entry_callable(self):
+        # tft-lint (torchft_tpu/analysis/) ships as a console script too
+        text = open(os.path.join(REPO, "pyproject.toml")).read()
+        assert 'tft-lint = "torchft_tpu.analysis.cli:main"' in text
+        from torchft_tpu.analysis.cli import main
+
+        with pytest.raises(SystemExit) as e:
+            main(["--help"])
+        assert e.value.code == 0
+        # the baseline data files ship in the wheel
+        assert "analysis/baselines/*.txt" in text
+
     def test_native_lib_search_order(self, monkeypatch):
         from torchft_tpu import _native
 

@@ -46,6 +46,11 @@ def _stale(lib_path: str) -> bool:
     try:
         built = os.path.getmtime(lib_path)
         for name in os.listdir(_NATIVE_DIR):
+            if name == "smoke.cc":
+                # sanitizer smoke driver: not linked into the .so, so a
+                # newer copy must not make the lib look perpetually stale
+                # (make would no-op and never advance the .so mtime)
+                continue
             if name.endswith((".cc", ".h")) or name == "Makefile":
                 if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > built:
                     return True
@@ -66,7 +71,9 @@ def _find_lib() -> str:
     3. the packaged ``.so`` next to this module — wheel installs (staged
        by setup.py's build_py hook; no source tree present there).
     """
-    env = os.environ.get("TORCHFT_NATIVE_LIB")
+    from torchft_tpu.utils.env import env_str
+
+    env = env_str("TORCHFT_NATIVE_LIB")
     if env:
         if not os.path.exists(env):
             raise FileNotFoundError(f"TORCHFT_NATIVE_LIB={env} does not exist")

@@ -1,0 +1,60 @@
+"""tft-lint: project-invariant static analysis for torchft_tpu.
+
+PRs 1-3 built a web of cross-cutting invariants — telemetry names and
+docs tables in sync, one retry policy, non-blocking signal paths, every
+fault site registered — that nothing enforced.  This package is the
+enforcement: stdlib-``ast`` passes encoding *this project's* rules (not
+generic style), run as ``python -m torchft_tpu.analysis torchft_tpu/``
+or the ``tft-lint`` console script, and wired into tier-1 via
+tests/test_lint.py so a violation fails CI.
+
+Passes (each with an embedded ``--selftest`` and a checked-in baseline
+file for grandfathered findings — all empty):
+
+========================  ==================================================
+``lock-discipline``       no blocking calls while holding a lock; no
+                          blocking lock acquisition in signal handlers
+``env-hygiene``           env reads only via utils/env.py helpers,
+                          TORCHFT_*-named, documented
+``metrics-sync``          metric names torchft_*, unique, documented;
+                          event kinds in both _LOGGERS and _SEVERITY
+``retry-ban``             no time.sleep retry loops outside utils/retry.py
+``fault-coverage``        fault sites registered/documented/wired; PG +
+                          transport paths feed the flight recorder
+========================  ==================================================
+
+The runtime complement is ``utils/lockcheck.py`` (TORCHFT_LOCKCHECK=1
+lock-order cycle detection) and the native TSan build
+(``make -C native SANITIZE=thread``) — see docs/static_analysis.md.
+"""
+
+from torchft_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintPass,
+    Project,
+    SelftestError,
+    run_passes,
+)
+from torchft_tpu.analysis.coverage import PASS as _coverage
+from torchft_tpu.analysis.env_hygiene import PASS as _env_hygiene
+from torchft_tpu.analysis.lock_discipline import PASS as _lock_discipline
+from torchft_tpu.analysis.metrics_sync import PASS as _metrics_sync
+from torchft_tpu.analysis.retry_ban import PASS as _retry_ban
+
+#: Every registered pass, in documentation order.
+PASSES = (
+    _lock_discipline,
+    _env_hygiene,
+    _metrics_sync,
+    _retry_ban,
+    _coverage,
+)
+
+__all__ = [
+    "PASSES",
+    "Finding",
+    "LintPass",
+    "Project",
+    "SelftestError",
+    "run_passes",
+]

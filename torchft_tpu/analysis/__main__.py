@@ -1,0 +1,8 @@
+"""``python -m torchft_tpu.analysis`` — the tft-lint entry point."""
+
+import sys
+
+from torchft_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from torchft_tpu.utils.env import env_str
+
 logger = logging.getLogger(__name__)
 
 
@@ -64,7 +66,7 @@ def replica_app_spec(
     else:
         base_env.setdefault(
             "TORCHFT_LIGHTHOUSE",
-            os.environ.get("TORCHFT_LIGHTHOUSE", "localhost:29510"),
+            env_str("TORCHFT_LIGHTHOUSE", "localhost:29510"),
         )
 
     roles = []
@@ -132,7 +134,7 @@ class ReplicaGroupLauncher:
             raise ValueError("replicas must be > 0")
         self._lighthouse = None
         if lighthouse_addr is None:
-            lighthouse_addr = os.environ.get("TORCHFT_LIGHTHOUSE")
+            lighthouse_addr = env_str("TORCHFT_LIGHTHOUSE") or None
         if lighthouse_addr is None:
             # local mode: host a Lighthouse in this supervisor process
             from torchft_tpu.coordination import LighthouseServer

@@ -51,6 +51,7 @@ from torchft_tpu.utils import faults as faults
 from torchft_tpu.utils import flightrecorder as flightrec
 from torchft_tpu.utils import metrics as metrics
 from torchft_tpu.utils import tracing as tracing
+from torchft_tpu.utils.env import env_float, env_int, env_str
 from torchft_tpu.utils.logging import ReplicaLogger, log_event
 from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
@@ -62,10 +63,10 @@ T = TypeVar("T")
 MANAGER_ADDR_KEY = "manager_addr"
 REPLICA_ID_KEY = "replica_id"
 
-TIMEOUT_SEC = float(os.environ.get("TORCHFT_TIMEOUT_SEC", 60.0))
-QUORUM_TIMEOUT_SEC = float(os.environ.get("TORCHFT_QUORUM_TIMEOUT_SEC", 60.0))
-CONNECT_TIMEOUT_SEC = float(os.environ.get("TORCHFT_CONNECT_TIMEOUT_SEC", 10.0))
-QUORUM_RETRIES = int(os.environ.get("TORCHFT_QUORUM_RETRIES", 0))
+TIMEOUT_SEC = env_float("TORCHFT_TIMEOUT_SEC", 60.0)
+QUORUM_TIMEOUT_SEC = env_float("TORCHFT_QUORUM_TIMEOUT_SEC", 60.0)
+CONNECT_TIMEOUT_SEC = env_float("TORCHFT_CONNECT_TIMEOUT_SEC", 10.0)
+QUORUM_RETRIES = env_int("TORCHFT_QUORUM_RETRIES", 0, minimum=0)
 
 
 def _to_sec(t: "float | timedelta | None", default: float) -> float:
@@ -160,12 +161,12 @@ class Manager:
         )
 
         self._group_rank = (
-            group_rank if group_rank is not None else int(os.environ.get("RANK", 0))
+            group_rank if group_rank is not None else env_int("RANK", 0, minimum=0)
         )
         self._group_world_size = (
             group_world_size
             if group_world_size is not None
-            else int(os.environ.get("WORLD_SIZE", 1))
+            else env_int("WORLD_SIZE", 1)
         )
 
         self._load_state_dict_fns: Dict[str, Callable[[Any], None]] = {}
@@ -211,7 +212,7 @@ class Manager:
         self._round_trace: "Optional[tuple[str, str, int]]" = None
 
         # --- coordination wiring (reference manager.py:277-325) -----------
-        lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
+        lighthouse_addr = lighthouse_addr or env_str("TORCHFT_LIGHTHOUSE") or None
         if lighthouse_addr is None:
             raise ValueError(
                 "lighthouse_addr (or TORCHFT_LIGHTHOUSE) is required"
@@ -235,7 +236,7 @@ class Manager:
             # uuid suffix: a fast-restarted replica must not be confused with
             # its dead predecessor in lighthouse state.
             new_replica_id = replica_id + ":" + str(uuid.uuid4())
-            bind_port = port or int(os.environ.get("TORCHFT_MANAGER_PORT", 0))
+            bind_port = port or env_int("TORCHFT_MANAGER_PORT", 0, minimum=0)
             self._manager_server = ManagerServer(
                 replica_id=new_replica_id,
                 lighthouse_addr=lighthouse_addr,
@@ -489,7 +490,7 @@ class Manager:
             log_event(
                 "quorum",
                 "quorum changed",
-                job_id=os.environ.get("JOB_ID", "unknown"),
+                job_id=env_str("JOB_ID", "unknown"),
                 replica_id=self._replica_id,
                 rank=self._group_rank,
                 quorum_id=quorum.quorum_id,
@@ -515,7 +516,7 @@ class Manager:
                 log_event(
                     "reconfigure",
                     "pg reconfigured",
-                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    job_id=env_str("JOB_ID", "unknown"),
                     replica_id=self._replica_id,
                     rank=self._group_rank,
                     quorum_id=quorum.quorum_id,
@@ -555,7 +556,7 @@ class Manager:
                 log_event(
                     "heal",
                     "sent checkpoint to healing peers",
-                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    job_id=env_str("JOB_ID", "unknown"),
                     replica_id=self._replica_id,
                     rank=self._group_rank,
                     quorum_id=quorum.quorum_id,
@@ -605,7 +606,7 @@ class Manager:
                 log_event(
                     "heal",
                     "received checkpoint from peer",
-                    job_id=os.environ.get("JOB_ID", "unknown"),
+                    job_id=env_str("JOB_ID", "unknown"),
                     replica_id=self._replica_id,
                     rank=self._group_rank,
                     quorum_id=quorum.quorum_id,
@@ -762,7 +763,7 @@ class Manager:
         log_event(
             "error",
             str(e),
-            job_id=os.environ.get("JOB_ID", "unknown"),
+            job_id=env_str("JOB_ID", "unknown"),
             replica_id=self._replica_id,
             rank=self._group_rank,
             quorum_id=self._quorum_id,
@@ -837,7 +838,7 @@ class Manager:
         log_event(
             "commit",
             "commit vote",
-            job_id=os.environ.get("JOB_ID", "unknown"),
+            job_id=env_str("JOB_ID", "unknown"),
             replica_id=self._replica_id,
             rank=self._group_rank,
             quorum_id=self._quorum_id,

@@ -30,7 +30,6 @@ flattened (payload dtype per ``wire_dtype``).
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -62,7 +61,9 @@ def resolve_wire(wire_dtype: "str | None") -> str:
     ``TORCHFT_QUANT_WIRE`` env default, else int8 — validated either way.
     The one entry point every collective uses for the env knob."""
     if wire_dtype is None:
-        wire_dtype = os.environ.get("TORCHFT_QUANT_WIRE", WIRE_INT8)
+        from torchft_tpu.utils.env import env_str
+
+        wire_dtype = env_str("TORCHFT_QUANT_WIRE", WIRE_INT8)
     _wire(wire_dtype)
     return wire_dtype
 
@@ -89,7 +90,9 @@ _I8P = ctypes.POINTER(ctypes.c_int8)
 
 def _native_lib():
     # env checked live (not cached) so tests can flip between paths
-    if os.environ.get("TORCHFT_NO_NATIVE_QUANT") == "1":
+    from torchft_tpu.utils.env import env_bool
+
+    if env_bool("TORCHFT_NO_NATIVE_QUANT"):
         return None
     global _native_checked, _native_lib_handle
     if not _native_checked:

@@ -57,7 +57,9 @@ def initialize_multihost(
             # before jax.distributed.initialize, so it does)
             import os
 
-            flags = os.environ.get("XLA_FLAGS", "")
+            from torchft_tpu.utils.env import env_str
+
+            flags = env_str("XLA_FLAGS")
             if "xla_force_host_platform_device_count" not in flags:
                 os.environ["XLA_FLAGS"] = (
                     flags

@@ -31,7 +31,6 @@ JAX mesh composition lives in torchft_tpu/parallel/device_mesh.py).
 from __future__ import annotations
 
 import logging
-import os
 import pickle
 import queue
 import socket
@@ -50,7 +49,9 @@ from torchft_tpu.coordination import StoreClient
 from torchft_tpu.parallel.work import Work, completed_work, failed_work
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import lockcheck as _lockcheck
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.env import env_float
 
 logger = logging.getLogger(__name__)
 
@@ -307,7 +308,7 @@ class _TokenBucket:
         self.burst = float(burst)
         self._tokens = self.burst
         self._t = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.lock("pg.token_bucket")
 
     def consume(self, nbytes: int) -> None:
         with self._lock:
@@ -368,8 +369,8 @@ class ProcessGroupTCP(ProcessGroup):
         # shared links.  None = unshaped; TORCHFT_WIRE_GBPS supplies a
         # default (decimal GB/s, e.g. "0.5").
         if bandwidth_gbps is None:
-            env = os.environ.get("TORCHFT_WIRE_GBPS")
-            bandwidth_gbps = float(env) if env else None
+            env = env_float("TORCHFT_WIRE_GBPS", 0.0)
+            bandwidth_gbps = env if env > 0 else None
         self._bucket: "Optional[_TokenBucket]" = (
             _TokenBucket(bandwidth_gbps * 1e9) if bandwidth_gbps else None
         )
@@ -381,9 +382,9 @@ class ProcessGroupTCP(ProcessGroup):
         # both finish the same op (the loser would mislabel a completed
         # collective as aborted).
         self._flight_op: "Optional[_flightrec.FlightOp]" = None
-        self._flight_swap_lock = threading.Lock()
+        self._flight_swap_lock = _lockcheck.lock("pg.tcp.flight_swap")
         self._replica_id = ""
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.lock("pg.tcp.state")
         self._worker: Optional[threading.Thread] = None
         self._sender: "Optional[concurrent_futures.ThreadPoolExecutor]" = None
         self._queue: "queue.Queue[Optional[Tuple[int, Callable[[], Any], Future]]]" = (
@@ -1557,7 +1558,7 @@ def _baby_worker(
         return
     pipe_conn.send((-1, "configured"))
 
-    send_lock = threading.Lock()
+    send_lock = _lockcheck.lock("pg.baby.pipe_send")
     pool = cf.ThreadPoolExecutor(max_workers=4, thread_name_prefix="baby_op")
 
     def _send(op_id: int, value: Any) -> None:
@@ -1650,7 +1651,7 @@ class ProcessGroupBaby(ProcessGroup):
         self._pending: Dict[int, Future] = {}
         self._pending_shm: "Dict[int, List[Any]]" = {}
         self._max_active_work = max_active_work
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.lock("pg.baby.state")
         self._cond = threading.Condition(self._lock)
         self._reader: Optional[threading.Thread] = None
 

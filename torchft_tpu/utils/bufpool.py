@@ -20,7 +20,6 @@ returned to user code.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Tuple
 
@@ -30,8 +29,9 @@ import numpy as np
 class BufferPool:
     def __init__(self, max_bytes: "int | None" = None) -> None:
         if max_bytes is None:
-            mb = int(os.environ.get("TORCHFT_BUFPOOL_MB", "2048"))
-            max_bytes = mb << 20
+            from torchft_tpu.utils.env import env_int
+
+            max_bytes = env_int("TORCHFT_BUFPOOL_MB", 2048, minimum=0) << 20
         self.max_bytes = max_bytes
         self._free: "Dict[Tuple[int, str], List[np.ndarray]]" = {}
         self._held = 0

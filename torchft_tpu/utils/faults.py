@@ -69,7 +69,6 @@ attribute test — safe on the allreduce hot path.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import threading
 import time
@@ -180,7 +179,9 @@ class FaultRegistry:
     """
 
     def __init__(self, seed: "Optional[int]" = None) -> None:
-        self._lock = threading.Lock()
+        from torchft_tpu.utils import lockcheck
+
+        self._lock = lockcheck.lock("faults.registry")
         self._seed = 0 if seed is None else int(seed)
         self._rules: "List[FaultRule]" = []
         self._rngs: "List[random.Random]" = []
@@ -417,11 +418,16 @@ def configure_from_env(env: "Optional[Dict[str, str]]" = None) -> bool:
     Returns True if a schedule was installed.  Called once at import; a
     malformed spec raises (a chaos run with a silently-empty schedule would
     report a vacuous pass)."""
-    e = os.environ if env is None else env
-    spec = e.get("TORCHFT_FAULTS", "")
+    if env is None:
+        from torchft_tpu.utils.env import env_str
+
+        spec = env_str("TORCHFT_FAULTS")
+        seed_raw = env_str("TORCHFT_FAULTS_SEED")
+    else:
+        spec = env.get("TORCHFT_FAULTS", "")
+        seed_raw = env.get("TORCHFT_FAULTS_SEED")
     if not spec.strip():
         return False
-    seed_raw = e.get("TORCHFT_FAULTS_SEED")
     seed = int(seed_raw) if seed_raw else 0
     FAULTS.configure(parse_spec(spec), seed=seed)
     logger.info(

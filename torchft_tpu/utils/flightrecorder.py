@@ -52,14 +52,16 @@ import json
 import logging
 import os
 import signal
-import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
+
+from torchft_tpu.utils import lockcheck
+from torchft_tpu.utils.env import env_int, env_str
 
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "env_int",
+    "env_int",  # re-export: moved to utils/env.py (PR 4), kept for compat
     "FlightOp",
     "FlightRecorder",
     "RECORDER",
@@ -73,21 +75,6 @@ __all__ = [
 ]
 
 _DEFAULT_RING = 512
-
-
-def env_int(name: str, default: int, minimum: int = 1) -> int:
-    """Parse an integer env knob: warn-and-default on garbage, clamp to
-    ``minimum``.  Shared by the ring-capacity knobs here and in
-    utils/logging.py (``TORCHFT_EVENTS_RING``)."""
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        value = int(raw)
-    except ValueError:
-        logger.warning("invalid %s=%r, using %d", name, raw, default)
-        return default
-    return max(value, minimum)
 
 
 def _ring_capacity() -> int:
@@ -110,7 +97,7 @@ class FlightOp:
     def __init__(self, recorder: "FlightRecorder", fields: "Dict[str, Any]") -> None:
         self._recorder = recorder
         self._fields = fields
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("flightrecorder.flight_op")
         self._done = False
 
     def update(self, **fields: Any) -> None:
@@ -162,9 +149,9 @@ class FlightRecorder:
         self._cap = max(int(cap), 1)
         self._ring: "List[Optional[Dict[str, Any]]]" = [None] * self._cap
         self._idx = 0  # total records ever written (monotone)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("flightrecorder.ring")
         self._open: "Dict[int, FlightOp]" = {}
-        self._dump_lock = threading.Lock()
+        self._dump_lock = lockcheck.lock("flightrecorder.dump")
 
     # -- hot path ----------------------------------------------------------
 
@@ -269,7 +256,7 @@ class FlightRecorder:
         triggered it).  ``blocking=False`` is for signal handlers: every
         lock is acquired with a short timeout so a handler running on a
         thread that already holds one cannot self-deadlock."""
-        target = path or os.environ.get("TORCHFT_FLIGHT_FILE") or None
+        target = path or env_str("TORCHFT_FLIGHT_FILE") or None
         if target is None:
             return None
         records = self.snapshot(blocking=blocking)
@@ -347,7 +334,7 @@ def track(op: str, **fields: Any) -> "Iterator[FlightOp]":
 
 def dump_path() -> "Optional[str]":
     """The configured dump sink, or None (dumps are then no-ops)."""
-    return os.environ.get("TORCHFT_FLIGHT_FILE") or None
+    return env_str("TORCHFT_FLIGHT_FILE") or None
 
 
 # ---------------------------------------------------------------------------
@@ -397,5 +384,5 @@ def install_signal_hooks(signals: "Optional[List[int]]" = None) -> bool:
 # A process that configures a dump sink wants the signal legs armed too:
 # SIGTERM is how schedulers kill replicas, and the dying flight ring is
 # exactly the evidence torchft-diagnose needs.
-if os.environ.get("TORCHFT_FLIGHT_FILE"):
+if env_str("TORCHFT_FLIGHT_FILE"):
     install_signal_hooks()

@@ -32,7 +32,9 @@ logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
-WATCHDOG_TIMEOUT_SEC = float(os.environ.get("TORCHFT_WATCHDOG_TIMEOUT_SEC", 30.0))
+from torchft_tpu.utils.env import env_float
+
+WATCHDOG_TIMEOUT_SEC = env_float("TORCHFT_WATCHDOG_TIMEOUT_SEC", 30.0)
 
 
 def _to_seconds(timeout: "float | timedelta") -> float:

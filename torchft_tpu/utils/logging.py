@@ -38,7 +38,7 @@ def _event_ring_size() -> int:
     """Ring capacity from ``TORCHFT_EVENTS_RING`` (default 256).  Read at
     import (the ring is a module singleton) — set the env before the first
     ``import torchft_tpu`` to size it."""
-    from torchft_tpu.utils.flightrecorder import env_int
+    from torchft_tpu.utils.env import env_int
 
     return env_int("TORCHFT_EVENTS_RING", 256)
 
@@ -197,15 +197,17 @@ def unregister_exporter(exporter: EventExporter) -> None:
 def _env_jsonl_exporter() -> "Optional[JSONLFileExporter]":
     """Resolve the JSONL exporter from ``TORCHFT_EVENTS_FILE`` (re-resolved
     when the env value changes, so tests and launchers can redirect)."""
+    from torchft_tpu.utils.env import env_int, env_str
+
     global _env_exporter, _env_exporter_path
-    path = os.environ.get("TORCHFT_EVENTS_FILE") or None
+    path = env_str("TORCHFT_EVENTS_FILE") or None
     if path != _env_exporter_path:
         if _env_exporter is not None:
             _env_exporter.close()
         _env_exporter = (
             JSONLFileExporter(
                 path,
-                int(os.environ.get("TORCHFT_EVENTS_MAX_BYTES", 16 * 1024 * 1024)),
+                env_int("TORCHFT_EVENTS_MAX_BYTES", 16 * 1024 * 1024, minimum=0),
             )
             if path
             else None

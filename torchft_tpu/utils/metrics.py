@@ -40,13 +40,15 @@ import atexit
 import bisect
 import json
 import logging
-import os
 import re
 import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from torchft_tpu.utils import lockcheck
+from torchft_tpu.utils.env import env_bool, env_float, env_int, env_str
 
 logger = logging.getLogger(__name__)
 
@@ -115,7 +117,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock(f"metrics.{name}")
         self._children: "Dict[Tuple[str, ...], Any]" = {}
         self._default = self._new_state()
         if registry is None:
@@ -335,7 +337,7 @@ class Registry:
     """Named collection of metric families; renders and snapshots them."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("metrics.registry")
         self._metrics: "Dict[str, _Metric]" = {}
 
     def register(self, metric: _Metric) -> _Metric:
@@ -635,14 +637,14 @@ def maybe_serve_from_env() -> "Optional[MetricsHTTPServer]":
     first one wins).  Port conflicts are logged, never raised: a taken
     metrics port must not take down training."""
     global _env_server
-    port = os.environ.get("TORCHFT_METRICS_PORT")
+    port = env_int("TORCHFT_METRICS_PORT", 0, minimum=0)
     if not port:
         return None
     with _env_server_lock:
         if _env_server is not None:
             return _env_server
         try:
-            _env_server = MetricsHTTPServer(int(port))
+            _env_server = MetricsHTTPServer(port)
         except (OSError, ValueError) as e:
             logger.warning(
                 "could not start metrics server on port %s: %s", port, e
@@ -800,27 +802,18 @@ def maybe_export_from_env() -> "Optional[OTLPMetricsExporter]":
     ``OTEL_EXPORTER_OTLP_METRICS_ENDPOINT``, else
     ``OTEL_EXPORTER_OTLP_ENDPOINT``, else the OTLP default)."""
     global _env_metrics_exporter
-    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
+    if not env_bool("TORCHFT_USE_OTEL"):
         return None
     if _env_metrics_exporter is not None:
         return _env_metrics_exporter
     endpoint = (
-        os.environ.get("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT")
-        or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        env_str("OTEL_EXPORTER_OTLP_METRICS_ENDPOINT")
+        or env_str("OTEL_EXPORTER_OTLP_ENDPOINT")
         or "http://localhost:4318"
     )
-    try:
-        interval = float(
-            os.environ.get("TORCHFT_METRICS_EXPORT_INTERVAL_S", 10.0)
-        )
-    except ValueError:
-        # runs at `import torchft_tpu`: a typo'd env var must degrade to
-        # the default, never crash training
-        logger.warning(
-            "invalid TORCHFT_METRICS_EXPORT_INTERVAL_S=%r, using 10s",
-            os.environ.get("TORCHFT_METRICS_EXPORT_INTERVAL_S"),
-        )
-        interval = 10.0
+    # runs at `import torchft_tpu`: a typo'd env var degrades to the
+    # default inside env_float, never crashes training
+    interval = env_float("TORCHFT_METRICS_EXPORT_INTERVAL_S", 10.0)
     _env_metrics_exporter = OTLPMetricsExporter(endpoint, interval_s=interval)
     return _env_metrics_exporter
 
@@ -927,4 +920,16 @@ FLIGHT_DUMPS = counter(
     "Flight-recorder dumps written, by trigger "
     "(pg_abort/manager_error/signal/manual; utils/flightrecorder.py)",
     ("trigger",),
+)
+LOCK_CYCLES = counter(
+    "torchft_lock_cycles_total",
+    "Distinct lock-order cycles (potential deadlocks) observed by the "
+    "TORCHFT_LOCKCHECK runtime detector (utils/lockcheck.py)",
+    ("edge",),
+)
+LOCK_HOLD_OUTLIERS = counter(
+    "torchft_lock_hold_outliers_total",
+    "Lock holds exceeding TORCHFT_LOCKCHECK_HOLD_MS, by lock name "
+    "(utils/lockcheck.py; straggler-origin telemetry)",
+    ("name",),
 )

@@ -37,11 +37,11 @@ from __future__ import annotations
 import atexit
 import json
 import logging
-import os
 import threading
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from torchft_tpu.utils.env import env_bool, env_str
 from torchft_tpu.utils.logging import EventExporter, register_exporter
 
 logger = logging.getLogger(__name__)
@@ -86,7 +86,7 @@ def load_resource_attributes(name: str = "torchft_tpu") -> "Dict[str, Any]":
     ``TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON`` (reference otel.py:50-58:
     the file maps logger name -> attribute dict).  Missing file/key -> {}.
     """
-    path = os.environ.get(TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON)
+    path = env_str(TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON)
     if not path:
         return {}
     try:
@@ -292,11 +292,11 @@ def maybe_install_from_env() -> "Optional[OTLPHTTPExporter]":
     ``http://localhost:4318``."""
     # explicit truthy whitelist: "off"/"no"/typos must NOT install an
     # exporter that spams connection-refused warnings all run
-    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
+    if not env_bool("TORCHFT_USE_OTEL"):
         return None
     endpoint = (
-        os.environ.get("OTEL_EXPORTER_OTLP_LOGS_ENDPOINT")
-        or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        env_str("OTEL_EXPORTER_OTLP_LOGS_ENDPOINT")
+        or env_str("OTEL_EXPORTER_OTLP_ENDPOINT")
         or "http://localhost:4318"
     )
     exporter = OTLPHTTPExporter(endpoint)

@@ -10,10 +10,11 @@ the training loop forever.
 
 from __future__ import annotations
 
-import threading
 import time
 from contextlib import contextmanager
 from typing import Iterator
+
+from torchft_tpu.utils import lockcheck
 
 
 class RWLock:
@@ -22,13 +23,26 @@ class RWLock:
     Writer preference is not enforced; fairness comes from the underlying
     primitive. All acquires raise TimeoutError on expiry rather than blocking
     forever, which is the property the fault-tolerance protocol needs.
+
+    Not reentrant: a thread already holding the read side must not take
+    the write side (upgrade deadlocks by construction), and a reader
+    re-entering ``acquire_read`` while a writer waits can deadlock on
+    primitives with writer preference.  Under ``TORCHFT_LOCKCHECK=1``
+    both mutexes are lockcheck-instrumented: the reader gate as a full
+    order-graph participant, the writer side as a hold-time-only *gate*
+    (community-held, released cross-thread — order analysis would report
+    a false reader<->writer cycle for it; see lockcheck.gate()).
     """
 
     def __init__(self, timeout: float = -1) -> None:
         # Default timeout applied when an acquire doesn't pass its own.
         self._default_timeout = timeout
-        self._reader_lock = threading.Lock()
-        self._writer_lock = threading.Lock()
+        self._reader_lock = lockcheck.lock("rwlock.reader_gate")
+        # community gate: taken by the FIRST reader, released by the LAST
+        # (possibly a different thread) — order-graph analysis is
+        # thread-local and would report a false reader<->writer cycle, so
+        # it gets hold-time-only instrumentation
+        self._writer_lock = lockcheck.gate("rwlock.writer_gate")
         self._readers = 0
 
     def _resolve(self, timeout: float | None) -> float:

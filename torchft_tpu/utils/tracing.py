@@ -145,13 +145,15 @@ def maybe_install_from_env() -> "Optional[Tracer]":
     """Install an OTLP span exporter when ``TORCHFT_USE_OTEL`` is truthy.
     Endpoint: ``OTEL_EXPORTER_OTLP_TRACES_ENDPOINT``, else
     ``OTEL_EXPORTER_OTLP_ENDPOINT``, else the OTLP default."""
-    if os.environ.get("TORCHFT_USE_OTEL", "").lower() not in ("true", "1", "yes"):
+    from torchft_tpu.utils.env import env_bool, env_str
+
+    if not env_bool("TORCHFT_USE_OTEL"):
         return None
     if _tracer is not None:
         return _tracer
     endpoint = (
-        os.environ.get("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT")
-        or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        env_str("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT")
+        or env_str("OTEL_EXPORTER_OTLP_ENDPOINT")
         or "http://localhost:4318"
     )
     return install_tracer(Tracer(OTLPHTTPSpanExporter(endpoint)))
