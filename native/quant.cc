@@ -108,15 +108,22 @@ void tft_dequant_fma(const int8_t* payload, const float* scales,
 
 namespace {
 
-// f32 -> float8_e4m3fn with round-to-nearest-even, for FINITE inputs
-// bounded to [-448 - 1ulp, 448 + 1ulp] (guaranteed by absmax scaling).
-// Bit-exact against ml_dtypes' astype on this domain (asserted in
-// tests/test_pallas_quant.py::TestNativeFp8Codec).
+// f32 -> float8_e4m3fn with round-to-nearest-even.  Bit-exact against
+// ml_dtypes' astype on the FULL f32 domain (asserted in
+// tests/test_pallas_quant.py::TestNativeFp8Codec), including the
+// non-finite corners the "fn" format folds into its NaN code 0x7f:
+// NaN, +-inf, and overflow past the 464 midpoint (RNE in the continuous
+// code space treats 0x7f as the 480 slot, so 464 rounds even to 0x7e
+// = max finite 448 while 465 rounds to 0x7f = NaN — matching ml_dtypes
+// exactly).  A NaN pseudograd element therefore round-trips as NaN on
+// the wire instead of being laundered into finite +-448 (ADVICE r5):
+// downstream NaN detection stays intact on both codec paths.
 inline uint8_t f32_to_e4m3(float f) {
   uint32_t b;
   std::memcpy(&b, &f, 4);
   const uint8_t sign = static_cast<uint8_t>((b >> 24) & 0x80u);
   const uint32_t abs = b & 0x7fffffffu;
+  if (abs >= 0x7f800000u) return sign | 0x7fu;  // inf / NaN -> NaN code
   if (abs < 0x3c800000u) {
     // |x| < 2^-6 (min normal): subnormal grid k * 2^-9, k in [0, 8] —
     // k == 8 lands exactly on the min normal's code (the encoding is
@@ -126,10 +133,12 @@ inline uint8_t f32_to_e4m3(float f) {
     return sign | static_cast<uint8_t>(nearbyintf(a * 512.0f));
   }
   // normal: RNE on the top 3 mantissa bits, re-bias 127 -> 7.  Mantissa
-  // carry flows into the exponent field naturally (continuous encoding).
+  // carry flows into the exponent field naturally (continuous encoding);
+  // values whose rounded code passes 0x7f saturate at the NaN code, the
+  // "fn" overflow rule.
   const uint32_t rounded = abs + 0x7ffffu + ((abs >> 20) & 1u);
   uint32_t e4 = (rounded >> 20) - ((127u - 7u) << 3);
-  if (e4 > 0x7eu) e4 = 0x7eu;  // 1-ulp excursion above 448 -> max finite
+  if (e4 > 0x7fu) e4 = 0x7fu;  // overflow past the top bucket -> NaN code
   return sign | static_cast<uint8_t>(e4);
 }
 
@@ -140,6 +149,16 @@ extern "C" {
 // Per-row absmax fp8_e4m3fn quantize (qmax 448): in[rows*cols] f32 ->
 // scales[rows] f32 + payload[rows*cols] fp8 bytes.  Same degenerate-row
 // rule as int8 (scale 1.0, zero payload).
+//
+// The non-degenerate (hot) encode loop is BRANCHLESS so gcc vectorizes
+// it (the scalar f32_to_e4m3's sub/normal branch blocked that; measured
+// ~2x less encode time per element at 2048 cols).  The domain makes
+// this safe: absmax-scaled values are either finite with |x| <=
+// 448*(1+2^-23) — where plain RNE in code space never passes the max
+// finite code 0x7e — or NaN (an inf element times inv==0), which the
+// one extra blend folds to the NaN code 0x7f exactly like the scalar
+// encoder.  Bit-exactness of both legs vs ml_dtypes is asserted in
+// tests/test_pallas_quant.py::TestNativeFp8Codec.
 void tft_quant_fp8(const float* in, int64_t rows, int64_t cols,
                    float* scales, uint8_t* payload) {
   const float qmax = 448.0f;
@@ -160,13 +179,40 @@ void tft_quant_fp8(const float* in, int64_t rows, int64_t cols,
       // numpy path: (x * 1.0).astype(fp8) -> +/-0 for |x| < ~1e-36;
       // e4m3 of such tiny values is 0x00 or 0x80 (signed zero) — match
       // the element-wise conversion rather than memset so -0.0 inputs
-      // keep their sign bit exactly like ml_dtypes does.
+      // keep their sign bit exactly like ml_dtypes does.  NaN rows land
+      // here too (NaN absmax): raw values through the full-domain scalar
+      // encoder, so NaN codes round-trip on the wire.
       for (int64_t c = 0; c < cols; ++c) out[c] = f32_to_e4m3(row[c]);
       continue;
     }
     scales[r] = absmax / qmax;
     const float inv = qmax / absmax;
-    for (int64_t c = 0; c < cols; ++c) out[c] = f32_to_e4m3(row[c] * inv);
+    for (int64_t c = 0; c < cols; ++c) {
+      const float f = row[c] * inv;
+      uint32_t b;
+      std::memcpy(&b, &f, 4);
+      const uint32_t sign = (b >> 24) & 0x80u;
+      const uint32_t abs = b & 0x7fffffffu;
+      // normal leg: RNE on the top 3 mantissa bits, re-bias 127 -> 7
+      const uint32_t rounded = abs + 0x7ffffu + ((abs >> 20) & 1u);
+      uint32_t e4 = (rounded >> 20) - ((127u - 7u) << 3);
+      if (e4 > 0x7fu) e4 = 0x7fu;  // safety clamp, unreachable on-domain
+      // subnormal leg: grid k * 2^-9, k in [0, 8] (continuous encoding).
+      // Clamp before the f32->int cast: its value is only USED for
+      // abs < 2^-6 (where a*512 < 8 and the clamp is a no-op), but it is
+      // COMPUTED for every lane, and casting an out-of-range/NaN float
+      // to integer is UB ([conv.fpint]; UBSan's float-cast-overflow).
+      // NaN/inf compare false, so they clamp too.
+      float a;
+      std::memcpy(&a, &abs, 4);
+      float v = a * 512.0f;
+      v = v <= 4096.0f ? v : 4096.0f;
+      const uint32_t sub = static_cast<uint32_t>(nearbyintf(v));
+      uint32_t mag = abs < 0x3c800000u ? sub : e4;
+      // inf * inv==0 gave NaN: fold to the fn NaN code like ml_dtypes
+      mag = abs >= 0x7f800000u ? 0x7fu : mag;
+      out[c] = static_cast<uint8_t>(sign | mag);
+    }
   }
 }
 
@@ -195,6 +241,50 @@ void tft_dequant_fp8_fma(const uint8_t* payload, const float* scales,
 // fallback's `acc /= average_by`.
 void tft_div_f32(float* acc, int64_t n, float div) {
   for (int64_t i = 0; i < n; ++i) acc[i] /= div;
+}
+
+// ---------------------------------------------------------------------------
+// row-range entry points (the threaded-codec surface)
+// ---------------------------------------------------------------------------
+//
+// Each takes FULL-buffer base pointers plus a [r0, r1) row range and
+// delegates to the whole-buffer kernel on offset pointers, so the pointer
+// arithmetic lives here rather than in ctypes call sites.  Rows are
+// independent in every kernel above (per-row absmax, per-row scale), so
+// concurrent calls over DISJOINT ranges of one buffer are data-race-free
+// — this is what lets a small Python worker pool drive one chunk's codec
+// across cores with the GIL released (the chunked-pipeline hot path; the
+// TSan smoke runs a concurrent round over these, native/smoke.cc).
+
+void tft_quant_int8_rows(const float* in, int64_t r0, int64_t r1,
+                         int64_t cols, float* scales, int8_t* payload) {
+  tft_quant_int8(in + r0 * cols, r1 - r0, cols, scales + r0,
+                 payload + r0 * cols);
+}
+
+void tft_quant_fp8_rows(const float* in, int64_t r0, int64_t r1,
+                        int64_t cols, float* scales, uint8_t* payload) {
+  tft_quant_fp8(in + r0 * cols, r1 - r0, cols, scales + r0,
+                payload + r0 * cols);
+}
+
+void tft_dequant_fma_rows(const int8_t* payload, const float* scales,
+                          int64_t r0, int64_t r1, int64_t cols, float* acc,
+                          int overwrite) {
+  tft_dequant_fma(payload + r0 * cols, scales + r0, r1 - r0, cols,
+                  acc + r0 * cols, overwrite);
+}
+
+void tft_dequant_fp8_fma_rows(const uint8_t* payload, const float* scales,
+                              const float* lut256, int64_t r0, int64_t r1,
+                              int64_t cols, float* acc, int overwrite) {
+  tft_dequant_fp8_fma(payload + r0 * cols, scales + r0, lut256, r1 - r0,
+                      cols, acc + r0 * cols, overwrite);
+}
+
+void tft_div_f32_rows(float* acc, int64_t r0, int64_t r1, int64_t cols,
+                      float div) {
+  tft_div_f32(acc + r0 * cols, (r1 - r0) * cols, div);
 }
 
 }  // extern "C"

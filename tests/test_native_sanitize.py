@@ -43,8 +43,9 @@ class TestMakefileModes:
 class TestTsanQuorumSmoke:
     def test_tsan_build_and_quorum_smoke(self):
         """Acceptance bar: `make -C native SANITIZE=thread` builds, and
-        the quorum smoke (2 replica groups x 3 live quorum+commit rounds
-        through a real lighthouse) runs with ZERO ThreadSanitizer
+        the quorum smoke (a concurrent codec round over the row-range
+        quant entry points, then 2 replica groups x 3 live quorum+commit
+        rounds through a real lighthouse) runs with ZERO ThreadSanitizer
         reports."""
         proc = _make("SANITIZE=thread", "smoke")
         assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -57,6 +58,9 @@ class TestTsanQuorumSmoke:
             timeout=300,
             env={**os.environ, "TSAN_OPTIONS": "halt_on_error=0 exitcode=66"},
         )
+        # the threaded-codec leg runs first: 4 threads over disjoint row
+        # ranges of shared buffers (the codec_pool access pattern)
+        assert "CODEC OK" in run.stdout, run.stdout + run.stderr
         assert "SMOKE OK" in run.stdout, run.stdout + run.stderr
         assert "WARNING: ThreadSanitizer" not in run.stderr, run.stderr
         assert run.returncode == 0, f"exit={run.returncode}\n{run.stderr}"

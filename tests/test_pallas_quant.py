@@ -235,6 +235,35 @@ class TestNativeFp8Codec:
             p_np.view(np.uint8), p_c.view(np.uint8)
         )
 
+    def test_nan_row_payload_bitwise_and_propagates(self, monkeypatch):
+        """A NaN element sends its row down the degenerate branch where
+        RAW values hit the encoder: the native path must emit the SAME
+        payload bytes as ml_dtypes — NaN stays the 0x7f NaN code (sign
+        preserved), inf and past-464 overflow fold to NaN per the "fn"
+        rule — so a NaN pseudograd round-trips as NaN instead of being
+        laundered into finite ±448 (ADVICE r5)."""
+        row = np.array(
+            [np.nan, -np.nan, np.inf, -np.inf, 1e6, 464.0, 465.0, 1.5, -2.0,
+             0.0],
+            dtype=np.float32,
+        )
+        a = row.reshape(1, -1)
+        self._toggle(monkeypatch, native=False)
+        s_np, p_np = host_q.quantize(a, "fp8_e4m3")
+        self._toggle(monkeypatch, native=True)
+        s_c, p_c = host_q.quantize(a, "fp8_e4m3")
+        np.testing.assert_array_equal(
+            p_np.view(np.uint8), p_c.view(np.uint8)
+        )
+        # both scales take the degenerate rule (NaN absmax -> 1.0)
+        np.testing.assert_array_equal(s_np, s_c)
+        # decode (LUT path) must propagate the NaNs, not finite garbage
+        out = host_q.dequantize(s_c, p_c, a.shape, np.float32)
+        assert np.isnan(out[0, 0]) and np.isnan(out[0, 1])
+        assert np.isnan(out[0, 2]) and np.isnan(out[0, 3])  # inf -> fn NaN
+        assert np.isnan(out[0, 4]) and np.isnan(out[0, 6])  # overflow -> NaN
+        assert out[0, 5] == 448.0  # 464 rounds even to max finite
+
     @pytest.mark.parametrize("average_by", [0, 3])
     def test_reduce_bitwise(self, average_by, monkeypatch):
         rows, cols = 6, 97

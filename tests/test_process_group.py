@@ -380,6 +380,42 @@ class TestBucketing:
         for pg in pgs:
             pg.shutdown()
 
+    def test_allreduce_reports_ring_wire_bytes(self, store):
+        """The unquantized path carries measured wire accounting too
+        (parity with the quantized collectives' wire_bytes, so
+        bench/diagnose compare f32 vs int8 traffic honestly)."""
+        world = 2
+        pgs = make_group(store, world, "wirebytes")
+        n = 10_000
+        data = np.ones(n, dtype=np.float32)
+
+        def run(rank, _):
+            w = pgs[rank].allreduce([data.copy()], REDUCE_SUM)
+            w.wait(timeout=30)
+            return w.wire_bytes, w.unquantized_wire_bytes
+
+        chunk = -(-n // world)
+        expected = 2 * (world - 1) * chunk * 4  # ring: rs half + ag half
+        for wire, unq in run_parallel(world, run):
+            assert wire == expected
+            assert unq == expected  # f32 IS the unquantized wire
+        # bucketized multi-leaf: accounting follows the same bucket plan
+        leaves = [np.ones(100, np.float32), np.ones(7, np.float64)]
+
+        def run_multi(rank, _):
+            w = pgs[rank].allreduce([l.copy() for l in leaves], REDUCE_SUM)
+            w.wait(timeout=30)
+            return w.wire_bytes
+
+        per_bucket = 2 * (world - 1)
+        expected_multi = per_bucket * (-(-100 // world)) * 4 + per_bucket * (
+            -(-7 // world)
+        ) * 8
+        for wire in run_parallel(world, run_multi):
+            assert wire == expected_multi
+        for pg in pgs:
+            pg.shutdown()
+
 
 class TestNumerics:
     def test_bfloat16_allreduce_and_sendrecv(self, store):

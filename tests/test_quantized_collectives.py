@@ -1,7 +1,10 @@
 """Quantized collective correctness vs eager (reference:
-torchft/quantization_test.py + collectives_test.py)."""
+torchft/quantization_test.py + collectives_test.py), plus the chunked
+overlapped pipeline's invariants: bitwise parity with the monolithic
+codec, bufpool steady-state, and mid-pipeline chaos."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -472,5 +475,280 @@ class TestFp8Wire:
             # half the rows cross the wire, quantized ~4x smaller
             assert unq == 4 * 4 * 512  # f32 bytes of the peer's slice
             assert 0 < wire < unq / 3.5, (wire, unq)
+        for pg in pgs:
+            pg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chunked overlapped pipeline (the r6 rebuild)
+# ---------------------------------------------------------------------------
+
+# Big enough that the (rows, 2048) flat matrix yields multi-row rank
+# slices (slice_rows ~ 49 at world 3), so small TORCHFT_QUANT_CHUNK_ROWS
+# values produce real multi-chunk pipelines including a padded-tail chunk
+# (total is NOT a multiple of 2048, and rows pad up to a world multiple).
+_PIPE_SHAPES = ((100, 501), (50_000,))
+
+
+def _pipe_data(world: int, seed: int = 5):
+    rng = np.random.default_rng(seed)
+    return [
+        [rng.standard_normal(s).astype(np.float32) for s in _PIPE_SHAPES]
+        for _ in range(world)
+    ]
+
+
+def _run_quantized(pgs, data, wire_dtype, op=REDUCE_SUM):
+    def run(rank, _):
+        w = allreduce_quantized(
+            data[rank], op, pgs[rank], wire_dtype=wire_dtype
+        )
+        out = w.wait(timeout=30)
+        return out, dict(w.quant_stats), w.wire_bytes
+
+    return run_parallel(len(pgs), run)
+
+
+class TestChunkedPipeline:
+    """Bitwise parity of the chunked pipeline vs the monolithic codec
+    (K=1), bufpool steady-state, and the overlap accounting surface."""
+
+    @pytest.mark.parametrize("wire_dtype", [q.WIRE_INT8, q.WIRE_FP8])
+    def test_chunked_bitwise_parity_world3(
+        self, store, monkeypatch, wire_dtype  # noqa: F811
+    ):
+        """Chunked vs monolithic output must be BIT-identical for both
+        wire formats — world 3 exercises uneven global row slicing and a
+        zero-padded tail chunk."""
+        world = 3
+        data = _pipe_data(world)
+        pgs = make_group(store, world, prefix=f"pmono{wire_dtype}")
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        mono = _run_quantized(pgs, data, wire_dtype)
+        for pg in pgs:
+            pg.shutdown()
+        assert mono[0][1]["n_chunks"] == 1
+
+        pgs = make_group(store, world, prefix=f"pchunk{wire_dtype}")
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "4")
+        chunked = _run_quantized(pgs, data, wire_dtype, op=REDUCE_AVG)
+        # AVG vs SUM differ; rerun monolithic AVG for the comparison
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs2 = make_group(store, world, prefix=f"pmonoA{wire_dtype}")
+        mono_avg = _run_quantized(pgs2, data, wire_dtype, op=REDUCE_AVG)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+
+        assert chunked[0][1]["n_chunks"] > 2, chunked[0][1]
+        for (mono_out, _, _), (chunk_out, _, _) in zip(mono_avg, chunked):
+            for m, c in zip(mono_out, chunk_out):
+                np.testing.assert_array_equal(m, c)
+
+    @pytest.mark.parametrize("wire_dtype", [q.WIRE_INT8, q.WIRE_FP8])
+    def test_chunked_parity_numpy_fallback(
+        self, store, monkeypatch, wire_dtype  # noqa: F811
+    ):
+        """The numpy codec path must satisfy the same chunked-vs-
+        monolithic bit identity for BOTH wire formats (its per-row math
+        is shared, but the row-range plumbing — incl. the fp8 astype
+        widen leg — differs)."""
+        monkeypatch.setenv("TORCHFT_NO_NATIVE_QUANT", "1")
+        world = 2
+        data = _pipe_data(world, seed=9)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix=f"pnpm{wire_dtype}")
+        mono = _run_quantized(pgs, data, wire_dtype)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "7")
+        pgs2 = make_group(store, world, prefix=f"pnpc{wire_dtype}")
+        chunked = _run_quantized(pgs2, data, wire_dtype)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+        assert chunked[0][1]["n_chunks"] > 2
+        for (mono_out, _, _), (chunk_out, _, _) in zip(mono, chunked):
+            for m, c in zip(mono_out, chunk_out):
+                np.testing.assert_array_equal(m, c)
+
+    def test_chunked_device_path_parity(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        """Device (Pallas) quantize feeds the same chunk queue: chunked
+        device-path output is bit-identical to monolithic device-path
+        output (one kernel launch either way; per-chunk device→host
+        copies must not change a byte)."""
+        import jax.numpy as jnp
+
+        world = 2
+        data = _pipe_data(world, seed=11)
+
+        def run_dev(pgs):
+            def run(rank, _):
+                arrays = [jnp.asarray(a) for a in data[rank]]
+                w = allreduce_quantized(
+                    arrays, REDUCE_SUM, pgs[rank], device_quantize=True
+                )
+                return w.wait(timeout=60), dict(w.quant_stats)
+
+            return run_parallel(world, run)
+
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix="pdevm")
+        mono = run_dev(pgs)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs2 = make_group(store, world, prefix="pdevc")
+        chunked = run_dev(pgs2)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+        assert chunked[0][1]["n_chunks"] > 1
+        for (mono_out, _), (chunk_out, _) in zip(mono, chunked):
+            for m, c in zip(mono_out, chunk_out):
+                np.testing.assert_array_equal(np.asarray(m), np.asarray(c))
+
+    def test_chunked_reduce_scatter_parity(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        world = 2
+        rng = np.random.default_rng(3)
+        data = [
+            rng.standard_normal((64, 700)).astype(np.float32)
+            for _ in range(world)
+        ]
+
+        def run_rs(pgs):
+            def run(rank, _):
+                return reduce_scatter_quantized(
+                    data[rank], REDUCE_SUM, pgs[rank]
+                ).wait(timeout=30)
+
+            return run_parallel(world, run)
+
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix="prsm")
+        mono = run_rs(pgs)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "7")
+        pgs2 = make_group(store, world, prefix="prsc")
+        chunked = run_rs(pgs2)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+        for m, c in zip(mono, chunked):
+            np.testing.assert_array_equal(m, c)
+
+    def test_wire_accounting_independent_of_chunking(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        """Per-chunk headers aside, wire bytes must not balloon with K,
+        and the ~4x reduction vs f32 holds at any chunking."""
+        world = 2
+        data = _pipe_data(world, seed=2)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix="pwm")
+        mono = _run_quantized(pgs, data, q.WIRE_INT8)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "4")
+        pgs2 = make_group(store, world, prefix="pwc")
+        chunked = _run_quantized(pgs2, data, q.WIRE_INT8)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+        wire_mono, wire_chunk = mono[0][2], chunked[0][2]
+        k = chunked[0][1]["n_chunks"]
+        assert k > 2
+        # chunking adds exactly (K-1) extra 4-byte pack headers per hop
+        # direction pair vs the monolithic buffer
+        assert wire_mono < wire_chunk <= wire_mono + 2 * (world - 1) * 4 * k
+        total = sum(int(np.prod(s)) for s in _PIPE_SHAPES)
+        assert wire_chunk < 4 * total / 3.0  # still ~4x under f32
+
+    def test_overlap_stats_surface(self, store, monkeypatch):  # noqa: F811
+        """quant_stats carries the pipeline accounting bench consumes."""
+        world = 2
+        data = _pipe_data(world, seed=4)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs = make_group(store, world, prefix="postats")
+        results = _run_quantized(pgs, data, q.WIRE_INT8)
+        for pg in pgs:
+            pg.shutdown()
+        for _, stats, _ in results:
+            assert stats["n_chunks"] >= 1
+            assert stats["codec_s"] >= 0.0
+            assert stats["wire_s"] >= 0.0
+            assert stats["wall_s"] > 0.0
+            assert 0.0 <= stats["overlap_efficiency"] <= 1.0
+
+    def test_bufpool_steady_state_no_growth(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        """After one warm collective of a given shape, a repeat takes
+        every staging buffer — wire bufs, accumulators, reduced pieces,
+        pool-backed receives — from the pool: zero new allocations
+        (misses) in steady state."""
+        from torchft_tpu.utils.bufpool import POOL
+
+        world = 2
+        data = _pipe_data(world, seed=6)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs = make_group(store, world, prefix="ppool")
+        _run_quantized(pgs, data, q.WIRE_INT8)  # warm: populates the pool
+        misses_before = POOL.misses
+        results = _run_quantized(pgs, data, q.WIRE_INT8)
+        misses_after = POOL.misses
+        for pg in pgs:
+            pg.shutdown()
+        assert results[0][1]["n_chunks"] > 2
+        assert misses_after == misses_before, (
+            f"steady-state pool misses grew: {misses_before} -> "
+            f"{misses_after} (a staging buffer is not recycling)"
+        )
+
+
+class TestChunkedChaos:
+    def test_fault_mid_pipeline_drains_and_recovers(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        """An injected pg.allreduce.chunk failure MID-pipeline (step =
+        chunk index 1: after chunk 0's alltoall is already on the wire)
+        must fail the Work promptly on every rank — abort drains the
+        codec workers, nothing deadlocks (tier-1 runs with
+        TORCHFT_LOCKCHECK=1 armed) — and the SAME process groups must
+        complete a clean collective afterwards (op streams left in
+        sync)."""
+        from torchft_tpu.utils import faults
+        from torchft_tpu.utils.faults import FaultRule, InjectedFault
+
+        world = 2
+        data = _pipe_data(world, seed=8)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs = make_group(store, world, prefix="pchaos")
+        # pg.allreduce.chunk carries the CHUNK index (the pg.allreduce
+        # site keeps its training-step namespace); times=world lets BOTH
+        # ranks' drivers (sharing this process's registry) inject at
+        # chunk 1 and stop submitting at the same point in the op stream
+        faults.FAULTS.configure(
+            [FaultRule(site="pg.allreduce.chunk", step=1, times=world)],
+            seed=1,
+        )
+
+        def run(rank, _):
+            w = allreduce_quantized([data[rank][1]], REDUCE_SUM, pgs[rank])
+            t0 = time.perf_counter()
+            try:
+                w.wait(timeout=30)
+                return None, 0.0
+            except Exception as e:  # noqa: BLE001
+                return e, time.perf_counter() - t0
+
+        results = run_parallel(world, run)
+        for exc, elapsed in results:
+            assert isinstance(exc, InjectedFault), exc
+            assert elapsed < 20.0, "mid-pipeline abort did not drain promptly"
+        assert faults.FAULTS.injected("pg.allreduce.chunk") == world
+
+        # recovery on the SAME pgs: both ranks aborted at the same chunk,
+        # so the sockets' op streams are still in lockstep
+        faults.FAULTS.configure([], seed=0)
+        expected = [sum(d[1] for d in data)]
+        clean = _run_quantized(pgs, [[d[1]] for d in data], q.WIRE_INT8)
+        for out, _, _ in clean:
+            rel = np.abs(out[0] - expected[0]).max() / (
+                np.abs(expected[0]).max() + 1e-9
+            )
+            assert rel < 0.05, rel
         for pg in pgs:
             pg.shutdown()

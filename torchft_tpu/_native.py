@@ -169,6 +169,25 @@ def get_lib() -> ctypes.CDLL:
         ]
         lib.tft_div_f32.restype = None
         lib.tft_div_f32.argtypes = [_f32p, ctypes.c_int64, ctypes.c_float]
+        # Row-range entry points: same kernels over [r0, r1) of a shared
+        # buffer — the threaded-codec surface (rows are independent, so
+        # disjoint ranges are data-race-free; ops/codec_pool.py fans one
+        # chunk across these with the GIL released).
+        _i64 = ctypes.c_int64
+        lib.tft_quant_int8_rows.restype = None
+        lib.tft_quant_int8_rows.argtypes = [_f32p, _i64, _i64, _i64, _f32p, _i8p]
+        lib.tft_quant_fp8_rows.restype = None
+        lib.tft_quant_fp8_rows.argtypes = [_f32p, _i64, _i64, _i64, _f32p, _u8p]
+        lib.tft_dequant_fma_rows.restype = None
+        lib.tft_dequant_fma_rows.argtypes = [
+            _i8p, _f32p, _i64, _i64, _i64, _f32p, ctypes.c_int,
+        ]
+        lib.tft_dequant_fp8_fma_rows.restype = None
+        lib.tft_dequant_fp8_fma_rows.argtypes = [
+            _u8p, _f32p, _f32p, _i64, _i64, _i64, _f32p, ctypes.c_int,
+        ]
+        lib.tft_div_f32_rows.restype = None
+        lib.tft_div_f32_rows.argtypes = [_f32p, _i64, _i64, _i64, ctypes.c_float]
         _lib = lib
         return _lib
 

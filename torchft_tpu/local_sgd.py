@@ -214,8 +214,11 @@ class _Fragment:
             self.original_parameters,
             local,
         )
-        # payload-byte fallback for the wire gauge when the collective
-        # doesn't report actual wire bytes (unquantized path)
+        # payload-byte fallback for the wire gauge: both the quantized
+        # pipeline AND the unquantized TCP ring now report measured
+        # wire_bytes on the Work (f32 vs int8 traffic compares honestly in
+        # bench/diagnose), so this only covers PG backends without ring
+        # accounting (e.g. test fakes)
         self._payload_bytes = sum(
             np.asarray(v).nbytes
             for v in jax.tree_util.tree_leaves(pseudograds)

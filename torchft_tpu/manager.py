@@ -735,13 +735,18 @@ class Manager:
 
             chained.get_future().add_done_callback(_done)
             managed = Work(out)
-            # surface the quantized path's wire accounting on the returned
-            # handle (set synchronously by allreduce_quantized)
+            # surface the collective's wire/codec accounting on the
+            # returned handle: the quantized pipeline's (wire_bytes set
+            # synchronously; codec_s_box/quant_stats written at pipeline
+            # completion — read after wait) and the TCP ring's measured
+            # wire_bytes on the unquantized path
             for attr in (
                 "wire_bytes",
                 "unquantized_wire_bytes",
                 "device_quantized",
                 "wire_dtype",
+                "codec_s_box",
+                "quant_stats",
             ):
                 if hasattr(work, attr):
                     setattr(managed, attr, getattr(work, attr))

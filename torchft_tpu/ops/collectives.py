@@ -1,4 +1,5 @@
-"""Quantized collectives: 8-bit allreduce / reduce-scatter over the FT PG.
+"""Quantized collectives: 8-bit allreduce / reduce-scatter over the FT PG,
+run as a chunked software pipeline that hides the codec behind the wire.
 
 Analog of the reference's quantized collectives
 (reference: torchft/collectives.py:159-415): quantize per-rank row-slices,
@@ -7,16 +8,58 @@ Analog of the reference's quantized collectives
 gradients (int8 payload + f32 row scales) at the cost of quantization error
 — the DiLoCo outer-gradient path is tolerant to this by design.
 
-Two bit-compatible quantizers feed the same wire format (the analog of the
-reference wiring its Triton kernels into the collective,
-reference collectives.py:297-415):
+**Pipeline shape** (r5 found the monolithic form codec-bound: int8 sync
+spent 83% of its wall in a single-threaded host codec while the NIC sat
+idle).  The flat row-matrix is split into K chunks of
+``TORCHFT_QUANT_CHUNK_ROWS`` rows (auto-sized to ~4 MiB of payload per
+peer when unset), and the stages overlap the way DynamiQ / Prime PCCL
+pipeline compressed collectives (PAPERS.md):
+
+- quantize(chunk i+1)  ∥  alltoall(chunk i)  ∥  reduce-requant(chunk i-1)
+  ∥  allgather/dequant of earlier chunks;
+- the codec itself is row-blocked across a small worker pool
+  (``TORCHFT_QUANT_THREADS``, ops/codec_pool.py) driving the GIL-releasing
+  native kernels (native/quant.cc row-range entry points), so both wire
+  formats scale across cores;
+- wire buffers, accumulators and reduced pieces cycle through
+  ``utils/bufpool.POOL`` — after the first collective of a given shape,
+  steady-state allocation is zero.
+
+Every rank submits the SAME fixed interleave of PG ops
+(``a2a_0, a2a_1, ag_0, a2a_2, ag_1, …``) from a dedicated driver thread,
+so the single-worker PG executes identical op sequences on every socket
+(the collective-ordering contract); per-chunk stage readiness only gates
+*when* the next submission happens, never its order.  That contract —
+like every PG collective's — assumes ONE collective in flight per
+process group at a time: a second concurrent quantized collective on the
+same PG would interleave its driver's submissions timing-dependently and
+desync the op streams across ranks.  The shipped callers respect this
+(DiLoCo serializes fragment syncs; ``Manager.allreduce`` is issued from
+the step protocol).  Chunking is by rows
+and quantization is per-row, so chunked output is bit-identical to the
+monolithic codec (K=1) on finite inputs — asserted for both wire formats
+in tests/test_quantized_collectives.py.
+
+Two bit-compatible quantizers feed the same wire format:
 
 - **device path** (default for jax arrays on a TPU backend): the Pallas
-  fused absmax-quantize kernel (torchft_tpu/ops/pallas_quant.py) runs
-  *before* the device→host copy, so only int8 payload + f32 row scales
-  cross PCIe/host memory — ~4x fewer device→host AND wire bytes;
-- **host path** (numpy codec, torchft_tpu/ops/quantization.py) for host
-  arrays or non-TPU backends.
+  fused absmax-quantize kernel (torchft_tpu/ops/pallas_quant.py) runs in
+  one launch *before* any host copy; the pipeline then copies each chunk's
+  int8 payload + f32 row scales device→host as a capture task, so the
+  PCIe hops overlap earlier chunks' sends;
+- **host path** (native/numpy codec, torchft_tpu/ops/quantization.py) for
+  host arrays or non-TPU backends.  A rank's OWN row-slice skips the
+  codec entirely: it is captured straight into the chunk's f32
+  accumulator at call time (zero codec time + zero quantization error on
+  own data, and one fewer memory pass than the old snapshot-then-copy).
+
+Observability: ``torchft_quant_codec_seconds`` /
+``torchft_quant_wire_seconds`` histograms per stage,
+``torchft_quant_overlap_efficiency`` gauge per collective, one flight
+record per chunk per hop, and chaos injects mid-pipeline: the existing
+``pg.allreduce`` site is consulted before every chunk's alltoall (no
+step context — unconstrained rules fire), plus ``pg.allreduce.chunk``
+with ``step`` = chunk index for deterministic per-hop targeting.
 
 SUM and AVG only, floating-point inputs only (parity: reference
 collectives.py:336-344).
@@ -24,15 +67,19 @@ collectives.py:336-344).
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import Future
-from typing import Any, List, Optional, Tuple
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from concurrent.futures import wait as futures_wait
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from torchft_tpu.ops import codec_pool as _cpool
 from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import (
     ProcessGroup,
@@ -40,7 +87,40 @@ from torchft_tpu.parallel.process_group import (
     REDUCE_SUM,
 )
 from torchft_tpu.parallel.work import Work, completed_work
+from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import lockcheck as _lockcheck
+from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.bufpool import POOL as _POOL
+from torchft_tpu.utils.env import env_int
+
+# Auto chunk sizing: one chunk's per-peer int8/fp8 payload, when
+# TORCHFT_QUANT_CHUNK_ROWS is unset.  ~4 MiB keeps per-message overhead
+# (<0.1%) negligible while giving a flagship-scale fragment (~14k slice
+# rows at 2048 cols) a pipeline depth of ~7.
+_AUTO_CHUNK_PAYLOAD_BYTES = 4 << 20
+# Runaway guard: a pathological TORCHFT_QUANT_CHUNK_ROWS=1 on a huge
+# fragment must not turn one collective into 50k wire messages.
+_MAX_CHUNKS = 1024
+
+
+def _resolve_chunk_rows(slice_rows: int, cols: int) -> int:
+    """Rows per pipeline chunk.  ``TORCHFT_QUANT_CHUNK_ROWS`` when set
+    (>0), else auto from the wire-buffer size target.  Clamped to
+    [ceil(slice_rows/_MAX_CHUNKS), slice_rows].  Like
+    ``TORCHFT_QUANT_WIRE``, the knob must agree across ranks — divergent
+    chunking desyncs the op streams and fails loudly mid-collective."""
+    rows = env_int("TORCHFT_QUANT_CHUNK_ROWS", 0, minimum=0)
+    if rows <= 0:
+        rows = max(_AUTO_CHUNK_PAYLOAD_BYTES // max(cols, 1), 1)
+    rows = max(rows, -(-slice_rows // _MAX_CHUNKS))
+    return max(1, min(rows, slice_rows))
+
+
+def _chunk_bounds(n_rows: int, chunk_rows: int) -> "List[Tuple[int, int]]":
+    return [
+        (a, min(a + chunk_rows, n_rows)) for a in range(0, n_rows, chunk_rows)
+    ]
 
 
 def _check_world(received: "List[np.ndarray]", world: int, op: str) -> None:
@@ -90,27 +170,531 @@ def _slice_rows(rows: int, world: int) -> "List[tuple[int, int]]":
     return bounds
 
 
-def _device_send_bufs(
-    arrays: "List[Any]", bounds: "List[tuple[int, int]]", rows: int, cols: int
-) -> "List[np.ndarray]":
-    """Quantize the whole flattened matrix ON DEVICE (one Pallas launch),
-    then copy only the int8 payload + f32 scales to the host and pack
-    per-destination row-slices in the shared wire layout.  Quantization is
-    per-row, so slicing after the kernel is bit-identical to quantizing
-    each slice — and costs one device→host round trip instead of
-    ``world``."""
-    from torchft_tpu.ops import pallas_quant as pq
+def _fill_tail(src: np.ndarray, tail: np.ndarray, g0: int, cols: int) -> None:
+    """Fill a pool block for a chunk spanning the padded tail: whatever of
+    the FLAT source remains past global row ``g0`` (including a partial
+    last row), zero-filled beyond it."""
+    flat = tail.ravel()
+    avail = max(src.size - g0 * cols, 0)
+    if avail > 0:
+        flat[:avail] = src[g0 * cols :]
+    flat[avail:] = 0.0
 
-    flat = jnp.concatenate(
-        [jnp.ravel(a).astype(jnp.float32) for a in arrays]
-    )
-    mat = jnp.zeros((rows * cols,), jnp.float32).at[: flat.size].set(flat)
-    scales, payload = pq.fused_quantize_into_int8(mat.reshape(rows, cols))
-    scales_np, payload_np = np.asarray(scales), np.asarray(payload)
-    return [
-        q.pack(scales_np[start:end], payload_np[start:end])
-        for start, end in bounds
-    ]
+
+class _ChunkPipeline:
+    """Shared state + driver of one chunked quantized collective.
+
+    Thread roles:
+
+    - **caller thread**: captures the contribution (quantizes peer
+      slices / copies the own slice into per-chunk accumulators) by
+      fanning row blocks onto the codec pool, then blocks until every
+      capture task ran — the call-time-snapshot contract: the caller may
+      mutate its arrays the moment the submit returns;
+    - **driver thread** (one per collective): submits every PG op in the
+      fixed global interleave, gated on stage futures;
+    - **codec pool** (process-wide): row-block tasks — pure compute,
+      never blocks, so abort always drains;
+    - **PG worker**: completion callbacks only timestamp, recycle and
+      dispatch the next codec stage — they never block the wire.
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        collective: str,
+        wire_dtype: str,
+        divisor: int,
+        cols: int,
+        chunks: "List[Tuple[int, int]]",
+    ) -> None:
+        self.pg = pg
+        self.collective = collective
+        self.wire_dtype = wire_dtype
+        self.divisor = divisor
+        self.cols = cols
+        self.chunks = chunks
+        self.my_rank = pg.rank()
+        self.world = pg.size()
+        self.trace = _cpool.CodecTrace()
+        k = len(chunks)
+        self.ready: "List[Future]" = [Future() for _ in range(k)]
+        self.reduce_done: "List[Future]" = [Future() for _ in range(k)]
+        self.dequant_done: "List[Future]" = [Future() for _ in range(k)]
+        self.send_bufs: "List[Optional[List[np.ndarray]]]" = [None] * k
+        self.accs: "List[Optional[np.ndarray]]" = [None] * k
+        self.pieces: "List[Optional[np.ndarray]]" = [None] * k
+        self.out_fut: Future = Future()
+        self.error: "Optional[BaseException]" = None
+        self._latch_lock = _lockcheck.lock("quant.pipeline_latch")
+        self._last_wire_done: "Optional[float]" = None
+        self.t_call = time.perf_counter()
+        # per-wait budget: each PG op enforces its own deadline
+        # (pg._timeout), so a stage future unresolved past that plus grace
+        # means a lost callback, not a slow wire
+        self.op_timeout = float(getattr(pg, "_timeout", 60.0)) + 30.0
+        self.stats: "Dict[str, Any]" = {"n_chunks": k, "wire": wire_dtype}
+        self.codec_s_box = [0.0]
+
+    # -- error funnel ----------------------------------------------------
+
+    def abort(self, exc: BaseException) -> None:
+        """First error wins; queued codec tasks become no-ops; every
+        pending stage future (and the result) fails so no waiter hangs."""
+        first = False
+        with self._latch_lock:
+            if self.error is None:
+                self.error = exc
+                first = True
+        if not first:
+            return
+        self.trace.abort()
+        _flightrec.record(
+            "quant.pipeline",
+            status="error",
+            collective=self.collective,
+            wire=self.wire_dtype,
+            chunks=len(self.chunks),
+            error=repr(exc),
+        )
+        for futs in (self.ready, self.reduce_done, self.dequant_done):
+            for f in futs:
+                try:
+                    f.set_exception(exc)
+                except Exception:  # noqa: BLE001 - already resolved
+                    pass
+        try:
+            self.out_fut.set_exception(exc)
+        except Exception:  # noqa: BLE001 - already resolved
+            pass
+
+    def _await(self, fut: Future) -> None:
+        try:
+            fut.result(timeout=self.op_timeout)
+        except FuturesTimeoutError:
+            exc = TimeoutError(
+                f"quantized {self.collective} pipeline stage did not "
+                f"resolve within {self.op_timeout:.0f}s"
+            )
+            self.abort(exc)
+            raise exc from None
+
+    # -- stage plumbing --------------------------------------------------
+
+    def chain(
+        self, futs: "List[Future]", done_cb: "Callable[[], None]",
+        stage_fut: Future,
+    ) -> None:
+        """When every codec future succeeds, run ``done_cb`` then resolve
+        ``stage_fut``; the first failure aborts the pipeline."""
+        remaining = [len(futs)]
+
+        def _one(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self.abort(exc)
+                return
+            with self._latch_lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                try:
+                    done_cb()
+                    stage_fut.set_result(None)
+                except BaseException as e:  # noqa: BLE001 - funnel
+                    self.abort(e)
+
+        if not futs:
+            try:
+                done_cb()
+                stage_fut.set_result(None)
+            except BaseException as e:  # noqa: BLE001 - funnel
+                self.abort(e)
+            return
+        for f in futs:
+            f.add_done_callback(_one)
+
+    def submit_wire(
+        self, hop: str, k: int, work: Work, nbytes: int, submit_t: float,
+        on_ok: "Callable[[Any], None]",
+    ) -> None:
+        """Attach the wire-accounting completion callback to a PG op: the
+        op's *execution* interval is [max(submit, previous completion),
+        completion] — exact under the PG's single-worker FIFO."""
+
+        def _cb(f: Future) -> None:
+            t1 = time.perf_counter()
+            prev = self._last_wire_done
+            t0 = submit_t if prev is None else max(submit_t, prev)
+            self._last_wire_done = t1
+            wire_s = max(t1 - t0, 0.0)
+            if t1 > t0:
+                self.trace.add_wire(t0, t1)
+            _metrics.QUANT_WIRE_SECONDS.labels(
+                op=hop, wire=self.wire_dtype
+            ).observe(wire_s)
+            exc = f.exception()
+            _flightrec.record(
+                "quant.chunk",
+                status="ok" if exc is None else "error",
+                collective=self.collective,
+                hop=hop,
+                chunk=k,
+                chunks=len(self.chunks),
+                nbytes=nbytes,
+                wire_s=round(wire_s, 6),
+                **({"error": repr(exc)} if exc is not None else {}),
+            )
+            if exc is not None:
+                self.abort(exc)
+                return
+            try:
+                on_ok(f.result())
+            except BaseException as e:  # noqa: BLE001 - funnel
+                self.abort(e)
+
+        work.get_future().add_done_callback(_cb)
+
+    # -- stages ----------------------------------------------------------
+
+    def submit_alltoall(self, k: int) -> None:
+        bufs = self.send_bufs[k]
+        assert bufs is not None
+        nbytes = sum(
+            b.nbytes for r, b in enumerate(bufs) if r != self.my_rank
+        )
+        t = time.perf_counter()
+        self.submit_wire(
+            "alltoall", k, self.pg.alltoall(bufs), nbytes, t,
+            lambda received: self.on_alltoall(k, received),
+        )
+
+    def on_alltoall(self, k: int, received: "List[np.ndarray]") -> None:
+        """Dispatch chunk ``k``'s dequant-reduce(-requant) row blocks (PG
+        worker thread: enqueue only, never compute)."""
+        _check_world(received, self.world, "alltoall")
+        a, b = self.chunks[k]
+        ck = b - a
+        acc = self.accs[k]
+        if acc is not None:
+            # host path: acc pre-filled with the own slice at capture
+            bufs = [r for i, r in enumerate(received) if i != self.my_rank]
+            overwrite_first = False
+        else:
+            # device path: every slot (own included) is a wire buffer
+            bufs = received
+            acc = _POOL.take((ck, self.cols), np.float32)
+            self.accs[k] = acc
+            overwrite_first = True
+        # one header check per received buffer (the loud cross-rank
+        # wire-format guard), hoisted off the per-row-block hot path
+        for buf in bufs:
+            q.validate_packed(buf, self.wire_dtype)
+        requant = self.collective == "allreduce"
+        piece: "Optional[np.ndarray]" = None
+        if requant:
+            piece = q.new_packed(ck, self.cols, self.wire_dtype, pool=_POOL)
+            self.pieces[k] = piece
+        t_red = time.perf_counter()
+
+        def block(r0: int, r1: int) -> None:
+            ow = overwrite_first
+            for buf in bufs:
+                q.fma_rows_packed(
+                    buf, ck, self.cols, r0, r1, self.wire_dtype,
+                    acc, r0, overwrite=ow,
+                )
+                ow = False
+            if self.divisor:
+                q.div_rows(acc, r0, r1, self.divisor)
+            if requant:
+                q.quantize_rows_packed(
+                    acc, r0, piece, ck, self.cols, r0, r1, self.wire_dtype
+                )
+
+        # rx lane: never queued behind pending capture (tx) work, so the
+        # reduce starts the moment the chunk lands even while later
+        # chunks are still quantizing
+        futs = _cpool.run_blocks(ck, block, self.trace, lane="rx")
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="reduce", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_red)
+            send = self.send_bufs[k]
+            if send is not None:
+                _recycle_wire_bufs(send, received, self.my_rank)
+                self.send_bufs[k] = None
+            if requant:
+                # allreduce: acc is scratch once requantized into piece
+                _POOL.give(self.accs[k])
+                self.accs[k] = None
+            # reduce_scatter: acc IS the caller's output region — keep it
+
+        self.chain(futs, done, self.reduce_done[k])
+
+    def submit_allgather(self, k: int, full_mat: np.ndarray,
+                         bounds: "List[Tuple[int, int]]") -> None:
+        piece = self.pieces[k]
+        assert piece is not None
+        nbytes = (self.world - 1) * piece.nbytes
+        t = time.perf_counter()
+        self.submit_wire(
+            "allgather", k, self.pg.allgather(piece), nbytes, t,
+            lambda gathered: self.on_allgather(k, gathered, full_mat, bounds),
+        )
+
+    def on_allgather(
+        self, k: int, gathered: "List[np.ndarray]", full_mat: np.ndarray,
+        bounds: "List[Tuple[int, int]]",
+    ) -> None:
+        """Dequantize every rank's reduced piece straight into its offset
+        of the full output matrix (PG worker thread: enqueue only)."""
+        _check_world(gathered, self.world, "allgather")
+        for gbuf in gathered:
+            q.validate_packed(gbuf, self.wire_dtype)
+        a, b = self.chunks[k]
+        ck = b - a
+        t_dq = time.perf_counter()
+        futs: "List[Future]" = []
+        for r, gbuf in enumerate(gathered):
+            base = bounds[r][0] + a
+
+            def block(r0: int, r1: int, gbuf=gbuf, base=base) -> None:
+                q.dequant_rows_into(
+                    gbuf, ck, self.cols, r0, r1, self.wire_dtype,
+                    full_mat, base + r0,
+                )
+
+            futs += _cpool.run_blocks(ck, block, self.trace, lane="rx")
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="dequant", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_dq)
+            piece = self.pieces[k]
+            _POOL.give(piece)
+            self.pieces[k] = None
+            _recycle_wire_bufs([], gathered, self.my_rank, exclude=piece)
+
+        self.chain(futs, done, self.dequant_done[k])
+
+    # -- capture (caller thread) ----------------------------------------
+
+    def capture_chunk(
+        self, k: int, futs: "List[Future]", give_after: "List[np.ndarray]",
+        t_cap: float,
+    ) -> None:
+        """Latch chunk ``k``'s capture tasks into ``ready[k]``."""
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="quantize", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_cap)
+            for blk in give_after:
+                _POOL.give(blk)
+
+        self.chain(futs, done, self.ready[k])
+
+    def capture_host_chunks(
+        self,
+        bounds: "List[Tuple[int, int]]",
+        source_rows: np.ndarray,
+        acc_for_chunk: "Callable[[int, int, int], np.ndarray]",
+        src_flat: "Optional[np.ndarray]" = None,
+        full_rows: "Optional[int]" = None,
+    ) -> "List[Future]":
+        """Caller-thread capture for the host codec path: per chunk,
+        quantize every peer slice into packed pool buffers and copy the
+        own slice into its accumulator (the call-time snapshot).
+
+        ``source_rows``: C-contiguous f32 ``(*, cols)`` the slices read
+        from.  ``src_flat``/``full_rows``: when set, chunks whose global
+        rows extend past ``full_rows`` read a zero-padded pool tail block
+        filled from the flat source (the allreduce's padded row matrix).
+        ``acc_for_chunk(k, a, b)``: the chunk's f32 accumulator — a pool
+        block for the allreduce, a region of the caller-visible output
+        for the reduce-scatter.  Returns the capture futures for
+        :meth:`wait_captured`.
+        """
+        futs_all: "List[Future]" = []
+        for k, (a, b) in enumerate(self.chunks):
+            ck = b - a
+            t_cap = time.perf_counter()
+            bufs_k: "List[np.ndarray]" = []
+            futs_k: "List[Future]" = []
+            give_after: "List[np.ndarray]" = []
+            for r in range(self.world):
+                g0 = bounds[r][0] + a
+                if full_rows is not None and g0 + ck > full_rows:
+                    tail = _POOL.take((ck, self.cols), np.float32)
+                    give_after.append(tail)
+                    _fill_tail(src_flat, tail, g0, self.cols)
+                    block_src, row0 = tail, 0
+                else:
+                    block_src, row0 = source_rows, g0
+                if r == self.my_rank:
+                    # own slice: captured straight into the chunk's f32
+                    # accumulator — no codec time, no quantization error
+                    # on own data, and the reduce fma-accumulates into it
+                    # in place (one fewer pass than snapshot-then-copy)
+                    acc = acc_for_chunk(k, a, b)
+                    self.accs[k] = acc
+
+                    def copy_own(
+                        r0: int, r1: int, acc=acc, bs=block_src, row0=row0
+                    ) -> None:
+                        np.copyto(acc[r0:r1], bs[row0 + r0 : row0 + r1])
+
+                    futs_k += _cpool.run_blocks(ck, copy_own, self.trace)
+                    bufs_k.append(np.empty(0, dtype=np.uint8))
+                else:
+                    buf = q.new_packed(
+                        ck, self.cols, self.wire_dtype, pool=_POOL
+                    )
+                    bufs_k.append(buf)
+
+                    def quant_peer(
+                        r0: int, r1: int, buf=buf, bs=block_src, row0=row0,
+                        ck=ck,
+                    ) -> None:
+                        q.quantize_rows_packed(
+                            bs, row0 + r0, buf, ck, self.cols, r0, r1,
+                            self.wire_dtype,
+                        )
+
+                    futs_k += _cpool.run_blocks(ck, quant_peer, self.trace)
+            self.send_bufs[k] = bufs_k
+            self.capture_chunk(k, futs_k, give_after, t_cap)
+            futs_all += futs_k
+        return futs_all
+
+    # -- driver ----------------------------------------------------------
+
+    def drive(
+        self,
+        on_finish: "Callable[[], Any]",
+        full_mat: "Optional[np.ndarray]" = None,
+        bounds: "Optional[List[Tuple[int, int]]]" = None,
+    ) -> None:
+        """Driver-thread body: every PG op in the fixed global interleave
+        (``a2a_0, a2a_1, ag_0, a2a_2, ag_1, …``), gated on stage futures.
+        The allgather leg runs when ``full_mat``/``bounds`` are given
+        (allreduce); without them the pipeline ends at the reduces
+        (reduce-scatter).  ``on_finish`` assembles the result after the
+        last stage."""
+        try:
+            n = len(self.chunks)
+            allgather = full_mat is not None
+            for k in range(n):
+                if self.error is not None:
+                    return
+                # chaos mid-pipeline (docs/robustness.md): the existing
+                # pg.allreduce site is consulted per chunk WITHOUT step
+                # context, so unconstrained rules (prob/times) inject
+                # mid-pipeline while step-constrained rules keep their
+                # training-step meaning; pg.allreduce.chunk carries the
+                # CHUNK index for deterministic per-hop targeting.
+                _faults.check("pg.allreduce")
+                _faults.check("pg.allreduce.chunk", step=k)
+                self._await(self.ready[k])
+                self.submit_alltoall(k)
+                if allgather and k >= 1:
+                    self._await(self.reduce_done[k - 1])
+                    self.submit_allgather(k - 1, full_mat, bounds)
+            if allgather:
+                self._await(self.reduce_done[n - 1])
+                self.submit_allgather(n - 1, full_mat, bounds)
+                waits = self.dequant_done
+            else:
+                waits = self.reduce_done
+            for fut in waits:
+                self._await(fut)
+            self.finish_stats()
+            self.out_fut.set_result(on_finish())
+        except BaseException as e:  # noqa: BLE001 - funnel
+            self.abort(e)
+
+    def start_driver(
+        self,
+        on_finish: "Callable[[], Any]",
+        full_mat: "Optional[np.ndarray]" = None,
+        bounds: "Optional[List[Tuple[int, int]]]" = None,
+    ) -> None:
+        threading.Thread(
+            target=self.drive,
+            args=(on_finish, full_mat, bounds),
+            name="tft_quant_pipeline",
+            daemon=True,
+        ).start()
+
+    def wait_captured(self, futs: "List[Future]") -> None:
+        """Block the caller until its contribution is fully captured —
+        the call-time-snapshot contract.  A capture failure surfaces
+        synchronously, like the monolithic codec's did."""
+        futures_wait(futs, timeout=self.op_timeout)
+        for f in futs:
+            if not f.done():
+                exc: BaseException = TimeoutError(
+                    "codec pool did not capture the contribution in time"
+                )
+                self.abort(exc)
+                raise exc
+            e = f.exception()
+            if e is not None:
+                self.abort(e)
+                raise e
+
+    # -- finish ----------------------------------------------------------
+
+    def finish_stats(self) -> None:
+        """Compute the overlap accounting and publish it (driver thread,
+        after the last stage)."""
+        wall = time.perf_counter() - self.t_call
+        codec_s = self.trace.busy_seconds()
+        wire_s = self.trace.wire_seconds()
+        floor = min(codec_s, wire_s)
+        efficiency = (
+            1.0
+            if floor <= 0.0
+            else max(0.0, min(1.0, (codec_s + wire_s - wall) / floor))
+        )
+        self.codec_s_box[0] = codec_s
+        self.stats.update(
+            wall_s=wall,
+            codec_s=codec_s,
+            wire_s=wire_s,
+            overlap_efficiency=efficiency,
+        )
+        _metrics.QUANT_OVERLAP_EFFICIENCY.labels(wire=self.wire_dtype).set(
+            efficiency
+        )
+        _flightrec.record(
+            "quant.pipeline",
+            collective=self.collective,
+            wire=self.wire_dtype,
+            chunks=len(self.chunks),
+            wall_s=round(wall, 6),
+            codec_s=round(codec_s, 6),
+            wire_s=round(wire_s, 6),
+            overlap_efficiency=round(efficiency, 4),
+        )
+
+
+def _attach_accounting(
+    work: Work, pipe: "Optional[_ChunkPipeline]", wire_bytes: int,
+    unquantized: int, wire_dtype: str, device_quantized: bool = False,
+) -> Work:
+    work.wire_bytes = wire_bytes
+    work.unquantized_wire_bytes = unquantized
+    work.device_quantized = device_quantized
+    work.wire_dtype = wire_dtype
+    if pipe is not None:
+        # both written once, at pipeline completion (finish_stats) —
+        # read them AFTER wait(); mid-flight reads see 0.0 / partial keys
+        work.codec_s_box = pipe.codec_s_box
+        work.quant_stats = pipe.stats
+    return work
 
 
 def allreduce_quantized(
@@ -126,7 +710,10 @@ def allreduce_quantized(
     Returns a Work resolving to the dequantized reduced arrays (f32
     precision loss ~1e-2 relative; see tests for bounds).  The Work
     carries ``wire_bytes`` / ``unquantized_wire_bytes`` attributes with
-    the measured per-rank alltoall payload size.
+    the measured per-rank wire payload, a ``codec_s_box`` (codec-busy
+    seconds, filled as stages run) and ``quant_stats`` (per-collective
+    pipeline accounting incl. ``overlap_efficiency``) — read after
+    ``wait``.
 
     Args:
         average_by: divide the sum by this count (fused into the requant
@@ -172,37 +759,80 @@ def allreduce_quantized(
         if op == REDUCE_AVG and average_by:
             out = [a / average_by for a in out]
         solo = completed_work(out)
-        solo.wire_bytes = 0  # nothing crosses the wire at world 1
-        solo.unquantized_wire_bytes = 0
-        solo.device_quantized = False
-        solo.wire_dtype = wire_dtype
-        return solo
+        return _attach_accounting(solo, None, 0, 0, wire_dtype)
     divisor = average_by if average_by is not None else (world if op == REDUCE_AVG else 0)
 
     # Flatten all arrays into one (rows, cols) matrix of quantization rows so
-    # a single alltoall/allgather round covers every gradient (the reference
-    # fuses arrays into one comm buffer the same way).
+    # a single pipelined alltoall/allgather schedule covers every gradient
+    # (the reference fuses arrays into one comm buffer the same way).
     total = sum(sizes)
+    if total == 0:
+        # nothing to reduce: zero-size outputs, no wire, no pipeline
+        solo = completed_work(
+            [np.zeros(s, dt) for s, dt in zip(shapes, out_dtypes)]
+        )
+        return _attach_accounting(solo, None, 0, 0, wire_dtype)
     cols = 2048 if total >= 2048 else max(total, 1)
     rows = -(-total // cols)
     # pad rows to a multiple of world so row-slices are even
     rows = -(-rows // world) * world
     bounds = _slice_rows(rows, world)
+    slice_rows = rows // world  # identical for every rank by construction
+    chunks = _chunk_bounds(slice_rows, _resolve_chunk_rows(slice_rows, cols))
 
-    codec_s = [0.0]  # wall spent in quantize/dequant (observability)
-    my_rank = pg.rank()
-    raw_self: "Optional[np.ndarray]" = None  # own slice, codec-free f32
+    pipe = _ChunkPipeline(pg, "allreduce", wire_dtype, divisor, cols, chunks)
+    my_rank = pipe.my_rank
+    # The full output matrix escapes to the caller as views — never pooled.
+    full_mat = np.empty((rows, cols), dtype=np.float32)
 
+    # ---- capture: quantize peer slices / copy the own slice, per chunk --
+    capture_futs: "List[Future]" = []
     if device_quantize:
-        send_bufs = _device_send_bufs(arrays, bounds, rows, cols)
+        from torchft_tpu.ops import pallas_quant as pq
+
+        flat_dev = jnp.concatenate(
+            [jnp.ravel(a).astype(jnp.float32) for a in arrays]
+        )
+        mat = (
+            jnp.zeros((rows * cols,), jnp.float32)
+            .at[: flat_dev.size]
+            .set(flat_dev)
+        )
+        scales_dev, payload_dev = pq.fused_quantize_into_int8(
+            mat.reshape(rows, cols)
+        )
+        for k, (a, b) in enumerate(chunks):
+            ck = b - a
+            t_cap = time.perf_counter()
+            bufs_k: "List[np.ndarray]" = []
+            futs_k: "List[Future]" = []
+            for r in range(world):
+                g0 = bounds[r][0] + a
+                buf = q.new_packed(ck, cols, wire_dtype, pool=_POOL)
+                bufs_k.append(buf)
+
+                def copy_chunk(r0: int, r1: int, g0=g0, buf=buf, ck=ck) -> None:
+                    # device→host hop of this chunk's slice: overlaps the
+                    # sends of earlier chunks (the PCIe/DMA leg of the
+                    # pipeline). Row-range [r0, r1) is the whole chunk —
+                    # transfers are not worth sub-splitting.
+                    sc, pl = q._packed_views(buf, ck, cols, wire_dtype)
+                    sc[r0:r1] = np.asarray(scales_dev[g0 + r0 : g0 + r1])
+                    pl[r0:r1] = np.asarray(payload_dev[g0 + r0 : g0 + r1])
+
+                futs_k += _cpool.run_blocks(
+                    ck, copy_chunk, pipe.trace, min_rows=ck
+                )
+            pipe.send_bufs[k] = bufs_k
+            pipe.capture_chunk(k, futs_k, [], t_cap)
+            capture_futs += futs_k
     else:
-        t0 = time.perf_counter()
         np_arrays = [np.asarray(a) for a in arrays]
         # Zero-copy flatten: a single contiguous f32 input (THE hot case —
         # a DiLoCo pseudograd fragment) is viewed, not copied; multi-array
-        # inputs concatenate once.  Row-slices then quantize straight off
-        # the source; only the slice that spans the padded tail pays a
-        # small zeroed copy.
+        # inputs concatenate once.  Chunks then quantize straight off the
+        # source; only chunks spanning the padded tail pay a small zeroed
+        # copy.
         if (
             len(np_arrays) == 1
             and np_arrays[0].dtype == np.float32
@@ -214,164 +844,61 @@ def allreduce_quantized(
                 [a.astype(np.float32, copy=False).ravel() for a in np_arrays]
             )
         full_rows = src.size // cols
+        src2d = src[: full_rows * cols].reshape(full_rows, cols)
 
-        def _slice_block(start: int, end: int) -> "Tuple[np.ndarray, bool]":
-            """(block, owned): owned blocks came from the pool (the slice
-            spans the padded tail, zero-filled past the source)."""
-            if end <= full_rows:
-                return (
-                    src[start * cols : end * cols].reshape(end - start, cols),
-                    False,
-                )
-            block = _POOL.take((end - start, cols), np.float32)
-            avail = src.size - start * cols
-            flat = block.ravel()
-            if avail > 0:
-                flat[:avail] = src[start * cols :]
-                flat[avail:] = 0.0
-            else:
-                flat[:] = 0.0
-            return block, True
-
-        # Quantize each destination rank's row-slice separately — EXCEPT
-        # our own: alltoall self-delivers locally (the slot never hits the
-        # wire), so the own slice skips the codec entirely and enters the
-        # reduce as raw f32 (zero codec time + zero quantization error on
-        # a rank's own contribution; the reference quantizes all slices,
-        # torchft/collectives.py:345-376).
-        send_bufs = []
-        for r, (start, end) in enumerate(bounds):
-            block, owned = _slice_block(start, end)
-            if r == my_rank:
-                if not owned:
-                    # view of the caller's array: SNAPSHOT it now (peer
-                    # slices are quantized synchronously, so the whole
-                    # contribution must be captured at call time — the
-                    # caller may mutate its array before the reduce runs)
-                    snap = _POOL.take(block.shape, np.float32)
-                    np.copyto(snap, block)
-                    block = snap
-                raw_self = block  # pool-owned either way; given post-reduce
-                send_bufs.append(np.empty(0, dtype=np.uint8))
-            else:
-                send_bufs.append(
-                    q.quantize_packed(block, wire_dtype, pool=_POOL)
-                )
-                if owned:
-                    # a padded PEER block is consumed by the quantize above
-                    _POOL.give(block)
-        codec_s[0] += time.perf_counter() - t0
-
-    reduced_box: "List[Optional[np.ndarray]]" = [None]
-
-    def _finish_alltoall(received: "List[np.ndarray]") -> Work:
-        _check_world(received, world, "alltoall")
-        my_rows = bounds[my_rank][1] - bounds[my_rank][0]
-        t0 = time.perf_counter()
-        # host path: own slot is the raw_self snapshot, not a wire buffer;
-        # device path (raw_self None) reduces every received slot
-        bufs = (
-            [b for r, b in enumerate(received) if r != my_rank]
-            if raw_self is not None
-            else received
+        capture_futs = pipe.capture_host_chunks(
+            bounds,
+            src2d,
+            lambda k, a, b: _POOL.take((b - a, cols), np.float32),
+            src_flat=src,
+            full_rows=full_rows,
         )
-        reduced = q.reduce_quantized(
-            bufs, my_rows, cols, average_by=divisor,
-            wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
-        )
-        if raw_self is not None:
-            _POOL.give(raw_self)  # call-time snapshot, consumed by the reduce
-        codec_s[0] += time.perf_counter() - t0
-        # send buffers drained + received buffers consumed by the reduce
-        _recycle_wire_bufs(send_bufs, received, my_rank)
-        reduced_box[0] = reduced
-        return pg.allgather(reduced)
 
-    def _finish_allgather(gathered: "List[np.ndarray]") -> "List[np.ndarray]":
-        # loud on short results: a partial fill of the into-place
-        # reassembly below would return uninitialized rows as gradients
-        _check_world(gathered, world, "allgather")
-        t0 = time.perf_counter()
-        # dequantize each rank's reduced piece straight into its offset of
-        # the full matrix — no per-piece alloc, no concat pass
-        full_mat = np.empty((rows, cols), dtype=np.float32)
-        for r, buf in enumerate(gathered):
-            start, end = bounds[r]
-            scales, payload = q.unpack(buf, end - start, cols, wire_dtype)
-            q.dequantize_into(scales, payload, full_mat[start:end])
-        reduced = reduced_box[0]
-        _POOL.give(reduced)  # own reduced piece: wire + decode done
-        reduced_box[0] = None
-        # gathered pieces are decoded into full_mat above — recycle them
-        # (no send buffers at this hop; `reduced` was already given)
-        _recycle_wire_bufs([], gathered, my_rank, exclude=reduced)
+    def assemble() -> "List[np.ndarray]":
         full = full_mat.ravel()[:total]
         out = []
         offset = 0
         for shape, size, dtype in zip(shapes, sizes, out_dtypes):
-            # asarray: zero-copy view when dtype is already f32 (disjoint
-            # slices of `full`, which the concatenate just materialized)
+            # asarray: zero-copy view when dtype is already f32
+            # (disjoint slices of the output matrix)
             out.append(
-                np.asarray(full[offset : offset + size].reshape(shape), dtype=dtype)
+                np.asarray(
+                    full[offset : offset + size].reshape(shape), dtype=dtype
+                )
             )
             offset += size
-        codec_s[0] += time.perf_counter() - t0
         return out
 
-    # Chain: alltoall -> local fused reduce -> allgather -> dequantize.
-    work = pg.alltoall(send_bufs)
+    pipe.start_driver(assemble, full_mat, bounds)
 
-    out_fut: Future = Future()
+    # call-time-snapshot contract: the contribution is fully captured
+    # before the submit returns (capture overlaps the driver's wire ops on
+    # earlier chunks, so this blocks for ~the codec's quantize leg only)
+    pipe.wait_captured(capture_futs)
 
-    def _stage2(f) -> None:
-        exc = f.exception()
-        if exc is not None:
-            out_fut.set_exception(exc)
-            return
-        try:
-            gather_work = _finish_alltoall(f.result())
-
-            def _stage3(g) -> None:
-                exc2 = g.exception()
-                if exc2 is not None:
-                    out_fut.set_exception(exc2)
-                    return
-                try:
-                    out_fut.set_result(_finish_allgather(g.result()))
-                except Exception as e:  # noqa: BLE001
-                    out_fut.set_exception(e)
-
-            gather_work.get_future().add_done_callback(_stage3)
-        except Exception as e:  # noqa: BLE001
-            out_fut.set_exception(e)
-
-    work.get_future().add_done_callback(_stage2)
-    out_work = Work(out_fut)
+    out_work = Work(pipe.out_fut)
     # Observability: measured wire bytes vs the unquantized f32 equivalent
     # (the ~4x reduction the codec exists for).  alltoall leg: only slots
     # bound for peers hit the wire (self-delivery is a local copy); the
-    # allgather ring then sends (w-1) reduced pieces per rank.
-    my_rows_n = bounds[my_rank][1] - bounds[my_rank][0]
-    piece_bytes = 4 + my_rows_n * 4 + my_rows_n * cols
-    out_work.wire_bytes = (
-        sum(b.nbytes for r, b in enumerate(send_bufs) if r != my_rank)
-        + (world - 1) * piece_bytes
+    # allgather leg then sends each reduced piece to (w-1) peers.
+    # Computed from the chunk plan, not the live buffers — those recycle
+    # into the pool as the pipeline drains.
+    packed_total = sum(q.packed_nbytes(b - a, cols) for a, b in chunks)
+    wire_bytes = 2 * (world - 1) * packed_total
+    return _attach_accounting(
+        out_work, pipe, wire_bytes, 4 * total, wire_dtype,
+        device_quantized=bool(device_quantize),
     )
-    out_work.unquantized_wire_bytes = 4 * total
-    out_work.device_quantized = bool(device_quantize)
-    out_work.wire_dtype = wire_dtype
-    out_work.codec_s_box = codec_s  # filled as stages run; read after wait
-    return out_work
 
 
 def reduce_scatter_quantized(
     array: Any, op: str, pg: ProcessGroup, wire_dtype: "Optional[str]" = None
 ) -> Work:
-    """8-bit quantized reduce-scatter: like allreduce_quantized without the
-    allgather (reference collectives.py:159-294). Resolves to this rank's
-    dequantized row-slice of the reduction.  ``wire_dtype`` defaults to
-    ``TORCHFT_QUANT_WIRE`` like the allreduce (one env knob, both
-    collectives)."""
+    """8-bit quantized reduce-scatter: the alltoall+reduce legs of the
+    pipeline without the allgather (reference collectives.py:159-294).
+    Resolves to this rank's dequantized row-slice of the reduction.
+    ``wire_dtype`` defaults to ``TORCHFT_QUANT_WIRE`` like the allreduce
+    (one env knob, both collectives)."""
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
     wire_dtype = q.resolve_wire(wire_dtype)
@@ -381,10 +908,7 @@ def reduce_scatter_quantized(
     world = pg.size()
     if world <= 1:
         solo = completed_work(np_array.astype(np.float32))
-        solo.wire_bytes = 0  # nothing crosses the wire at world 1
-        solo.unquantized_wire_bytes = 0
-        solo.wire_dtype = wire_dtype
-        return solo
+        return _attach_accounting(solo, None, 0, 0, wire_dtype)
     if np_array.shape[0] % world != 0:
         raise ValueError(
             f"reduce_scatter dim0 {np_array.shape[0]} not divisible by {world}"
@@ -398,49 +922,32 @@ def reduce_scatter_quantized(
     )
     bounds = _slice_rows(rows_total, world)
     my_rank = pg.rank()
-    # Same fast paths as the allreduce: the own slot self-delivers (never
-    # hits the wire), so it skips the codec and enters the reduce as raw
-    # f32; peer slices quantize straight into pooled wire buffers.  The
-    # own slice is SNAPSHOTTED at call time (peer slices are quantized
-    # synchronously — the whole contribution must be captured before the
-    # caller can mutate its array).
-    own = mat[bounds[my_rank][0] : bounds[my_rank][1]]
-    raw_self = _POOL.take(own.shape, np.float32)
-    np.copyto(raw_self, own)
-    send_bufs = [
-        np.empty(0, dtype=np.uint8)
-        if r == my_rank
-        else q.quantize_packed(mat[start:end], wire_dtype, pool=_POOL)
-        for r, (start, end) in enumerate(bounds)
-    ]
-
     my_rows = bounds[my_rank][1] - bounds[my_rank][0]
+    chunks = _chunk_bounds(my_rows, _resolve_chunk_rows(my_rows, cols))
+    pipe = _ChunkPipeline(
+        pg, "reduce_scatter", wire_dtype, divisor, cols, chunks
+    )
     out_shape = (my_rows,) + np_array.shape[1:]
+    # the raw f32 result (no requant: the reduced slice stays local, so
+    # requantizing would only add error) — escapes to the caller, so a
+    # plain allocation, and the per-chunk accumulators are REGIONS of it
+    out_mat = np.empty((my_rows, cols), dtype=np.float32)
 
-    def _finish(received: "List[np.ndarray]") -> np.ndarray:
-        _check_world(received, world, "alltoall")
-        bufs = [b for r, b in enumerate(received) if r != my_rank]
-        # raw f32 result: the reduced slice stays local, so requantizing
-        # (needed in allreduce for the allgather hop) would only add error.
-        # pool only feeds the accumulator's pages here (requantize=False
-        # hands acc to the caller, so the pool never gets it back — a
-        # warm-page win on take, replenished by the wire-buffer gives)
-        acc = q.reduce_quantized(
-            bufs, my_rows, cols, average_by=divisor, requantize=False,
-            wire_dtype=wire_dtype, raw=raw_self, pool=_POOL,
-        )
-        _POOL.give(raw_self)  # call-time snapshot, consumed by the reduce
-        _recycle_wire_bufs(send_bufs, received, my_rank)
-        return acc.reshape(out_shape)
+    # own-slice accumulators ARE regions of the caller-visible output; the
+    # reduce fma-accumulates peers into them in place, no requant
+    capture_futs = pipe.capture_host_chunks(
+        bounds, mat, lambda k, a, b: out_mat[a:b]
+    )
+    pipe.start_driver(lambda: out_mat.reshape(out_shape))
+    pipe.wait_captured(capture_futs)
 
-    out_work = pg.alltoall(send_bufs).then(_finish)
-    # same wire observability the allreduce carries (no allgather hop
-    # here: only the alltoall's peer slots cross the wire)
-    out_work.wire_bytes = sum(
-        b.nbytes for r, b in enumerate(send_bufs) if r != my_rank
+    out_work = Work(pipe.out_fut)
+    # no allgather hop here: only the alltoall's peer slots cross the wire
+    # (computed from the chunk plan — live buffers recycle as chunks drain)
+    wire_bytes = (world - 1) * sum(
+        q.packed_nbytes(b - a, cols) for a, b in chunks
     )
-    out_work.unquantized_wire_bytes = (
-        4 * (rows_total - my_rows) * cols
+    return _attach_accounting(
+        out_work, pipe, wire_bytes, 4 * (rows_total - my_rows) * cols,
+        wire_dtype,
     )
-    out_work.wire_dtype = wire_dtype
-    return out_work

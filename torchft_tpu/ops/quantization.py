@@ -375,6 +375,193 @@ def dequantize_into(
     np.multiply(pay, scales[:, None], dtype=np.float32, out=out.reshape(rows2.shape))
 
 
+# ---------------------------------------------------------------------------
+# row-range codec surface (the chunked-pipeline / worker-pool entry points)
+# ---------------------------------------------------------------------------
+#
+# Each helper operates on a row range [r0, r1) of a PACKED wire buffer
+# (header + scales + payload, layout as ``pack``), against a full 2-D f32
+# source/accumulator/output with its own row offset.  Rows are independent
+# in every codec kernel (per-row absmax, per-row scale), so concurrent
+# calls over DISJOINT ranges of one buffer are data-race-free — this is
+# what ``ops/codec_pool.py`` fans across a small worker pool, with the
+# native kernels releasing the GIL (native/quant.cc ``*_rows`` entry
+# points).  The numpy fallbacks apply the exact per-row math of the
+# monolithic codec above, so chunked output is bit-identical to monolithic
+# on finite inputs for BOTH paths (asserted in
+# tests/test_quantized_collectives.py).
+
+
+def packed_nbytes(rows: int, cols: int) -> int:
+    """Byte size of a packed wire buffer (8-bit payload wire formats)."""
+    return _HEADER_BYTES + rows * 4 + rows * cols
+
+
+def new_packed(
+    rows: int, cols: int, wire_dtype: str = WIRE_INT8, pool=None
+) -> np.ndarray:
+    """Allocate (or pool-take) a packed wire buffer and write its header;
+    scales/payload regions are left uninitialized for the row-range
+    writers below."""
+    _wire(wire_dtype)
+    nbytes = packed_nbytes(rows, cols)
+    buf = (
+        pool.take(nbytes, np.uint8) if pool is not None
+        else np.empty(nbytes, dtype=np.uint8)
+    )
+    buf[0] = _PACK_VERSION
+    buf[1] = _WIRE_CODES[wire_dtype]
+    buf[2] = buf[3] = 0
+    return buf
+
+
+def _packed_views(
+    buf: np.ndarray, rows: int, cols: int, wire_dtype: str
+) -> "Tuple[np.ndarray, np.ndarray]":
+    """(scales f32 [rows], payload [rows, cols]) views into a packed buf
+    (no header validation — internal writer-side helper)."""
+    dt, _ = _wire(wire_dtype)
+    scale_end = _HEADER_BYTES + rows * 4
+    scales = buf[_HEADER_BYTES:scale_end].view(np.float32)
+    payload = buf[scale_end : scale_end + rows * cols].view(dt).reshape(
+        rows, cols
+    )
+    return scales, payload
+
+
+def _rows_native(src: np.ndarray) -> bool:
+    return (
+        _native_lib() is not None
+        and src.dtype == np.float32
+        and src.flags.c_contiguous
+    )
+
+
+def quantize_rows_packed(
+    src: np.ndarray,
+    src_row0: int,
+    buf: np.ndarray,
+    rows: int,
+    cols: int,
+    r0: int,
+    r1: int,
+    wire_dtype: str = WIRE_INT8,
+) -> None:
+    """Quantize ``src[src_row0 : src_row0 + (r1-r0)]`` into packed ``buf``
+    rows ``[r0, r1)``.  ``src`` is C-contiguous f32 ``(*, cols)``."""
+    if r1 <= r0:
+        return
+    if _rows_native(src):
+        lib = _native_lib()
+        # pre-offset the source base so the kernel's single row index
+        # covers both sides: row r reads src[src_row0 + (r - r0)]
+        in_ptr = _f32_ptr(src, (src_row0 - r0) * cols * 4)
+        sc_ptr = _f32_ptr(buf, _HEADER_BYTES)
+        if wire_dtype == WIRE_INT8:
+            lib.tft_quant_int8_rows(
+                in_ptr, r0, r1, cols, sc_ptr,
+                _i8_ptr(buf, _HEADER_BYTES + rows * 4),
+            )
+        else:
+            lib.tft_quant_fp8_rows(
+                in_ptr, r0, r1, cols, sc_ptr,
+                _u8_ptr(buf, _HEADER_BYTES + rows * 4),
+            )
+        return
+    scales, payload = quantize(
+        src[src_row0 : src_row0 + (r1 - r0)].reshape(r1 - r0, cols),
+        wire_dtype,
+    )
+    sc, pl = _packed_views(buf, rows, cols, wire_dtype)
+    sc[r0:r1] = scales
+    pl[r0:r1] = payload
+
+
+def validate_packed(buf: np.ndarray, wire_dtype: str = WIRE_INT8) -> None:
+    """Validate a packed buffer's on-wire header (version + format code)
+    — the same loud cross-rank wire-format guard as :func:`unpack`,
+    without building the views.  The pipeline calls this ONCE per
+    received buffer before fanning row blocks; the row-range writers
+    below stay validation-free on the hot path."""
+    unpack(buf, 0, 0, wire_dtype)
+
+
+def fma_rows_packed(
+    buf: np.ndarray,
+    rows: int,
+    cols: int,
+    r0: int,
+    r1: int,
+    wire_dtype: str,
+    acc: np.ndarray,
+    acc_row0: int,
+    overwrite: bool,
+) -> None:
+    """``acc[acc_row0 : acc_row0 + (r1-r0)]`` (op)= dequant of packed
+    ``buf`` rows ``[r0, r1)`` (op: overwrite or accumulate).  The caller
+    validates the buffer header once via :func:`validate_packed`."""
+    if r1 <= r0:
+        return
+    if _rows_native(acc):
+        lib = _native_lib()
+        acc_ptr = _f32_ptr(acc, (acc_row0 - r0) * cols * 4)
+        sc_ptr = _f32_ptr(buf, _HEADER_BYTES)
+        ow = 1 if overwrite else 0
+        if wire_dtype == WIRE_INT8:
+            lib.tft_dequant_fma_rows(
+                _i8_ptr(buf, _HEADER_BYTES + rows * 4), sc_ptr,
+                r0, r1, cols, acc_ptr, ow,
+            )
+        else:
+            lut = _fp8_decode_lut()
+            lib.tft_dequant_fp8_fma_rows(
+                _u8_ptr(buf, _HEADER_BYTES + rows * 4), sc_ptr,
+                _f32_ptr(lut), r0, r1, cols, acc_ptr, ow,
+            )
+        return
+    sc, pl = _packed_views(buf, rows, cols, wire_dtype)
+    pay = pl[r0:r1]
+    if pay.dtype != np.int8:
+        pay = pay.astype(np.float32)
+    target = acc[acc_row0 : acc_row0 + (r1 - r0)].reshape(r1 - r0, cols)
+    if overwrite:
+        np.multiply(pay, sc[r0:r1, None], dtype=np.float32, out=target)
+    else:
+        target += np.multiply(pay, sc[r0:r1, None], dtype=np.float32)
+
+
+def div_rows(acc: np.ndarray, r0: int, r1: int, divisor: float) -> None:
+    """In-place ``acc[r0:r1] /= divisor`` (the fused AVG step), native
+    when available — bit-identical either way (true divide)."""
+    if r1 <= r0 or not divisor:
+        return
+    if _rows_native(acc):
+        _native_lib().tft_div_f32_rows(
+            _f32_ptr(acc), r0, r1, acc.shape[-1] if acc.ndim > 1 else 1,
+            float(divisor),
+        )
+        return
+    acc[r0:r1] /= divisor
+
+
+def dequant_rows_into(
+    buf: np.ndarray,
+    rows: int,
+    cols: int,
+    r0: int,
+    r1: int,
+    wire_dtype: str,
+    out: np.ndarray,
+    out_row0: int,
+) -> None:
+    """``out[out_row0 : out_row0 + (r1-r0)] = dequant(buf rows [r0,r1))``
+    — the allgather-reassembly writer (overwrite form of
+    :func:`fma_rows_packed`)."""
+    fma_rows_packed(
+        buf, rows, cols, r0, r1, wire_dtype, out, out_row0, overwrite=True
+    )
+
+
 def reduce_quantized(
     bufs: "List[np.ndarray]",
     rows: int,

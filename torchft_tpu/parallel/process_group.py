@@ -51,6 +51,7 @@ from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import lockcheck as _lockcheck
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.bufpool import POOL as _pool
 from torchft_tpu.utils.env import env_float
 
 logger = logging.getLogger(__name__)
@@ -772,7 +773,13 @@ class ProcessGroupTCP(ProcessGroup):
                 f"collective tag mismatch: expected {tag}, got {header['tag']}"
             )
         if out is None:
-            out = np.empty(header["shape"], dtype=np.dtype(header["dtype"]))
+            # Pool-backed receive: repeated collective shapes (ring chunks,
+            # the quantized pipeline's per-chunk wire buffers) re-take the
+            # SAME pages their consumers gave back, so steady-state receive
+            # allocation — and its mmap page-fault bill — is zero.  Buffers
+            # that escape to callers simply never return to the pool (take
+            # falls back to np.empty on a miss), same contract as before.
+            out = _pool.take(header["shape"], np.dtype(header["dtype"]))
             if out.nbytes != nbytes:
                 raise RuntimeError(
                     f"collective payload size mismatch: header says {nbytes},"
@@ -852,7 +859,25 @@ class ProcessGroupTCP(ProcessGroup):
             np_arrays = [_as_numpy(a) for a in arrays]
             return self._allreduce_coalesced(np_arrays, op, deadline)
 
-        return self._submit(run, op="allreduce")
+        work = self._submit(run, op="allreduce")
+        # Wire accounting on the UNQUANTIZED path too (parity with the
+        # quantized collectives' measured wire_bytes, so bench/diagnose
+        # compare f32 vs int8 traffic honestly): per-rank ring egress from
+        # the same bucket plan the reduce will use, computed synchronously
+        # from shapes/dtypes — device arrays stay unmaterialized.
+        def _leaf(a: Any) -> "Tuple[np.dtype, int]":
+            if not hasattr(a, "dtype") or not hasattr(a, "size"):
+                a = np.asarray(a)
+            return _accumulation_dtype(np.dtype(a.dtype)), int(a.size)
+
+        try:
+            work.wire_bytes = self._ring_wire_bytes(
+                [_leaf(a) for a in arrays], self._world
+            )
+            work.unquantized_wire_bytes = work.wire_bytes
+        except Exception:  # noqa: BLE001 - accounting must not fail the op
+            logger.debug("allreduce wire accounting failed", exc_info=True)
+        return work
 
     # Pack small same-acc-dtype leaves into buckets up to this many bytes.
     # Below the cap, coalescing wins (one ring amortizes per-message
@@ -860,6 +885,52 @@ class ProcessGroupTCP(ProcessGroup):
     # concat/split memcpy costs more than the saved round trips, so big
     # leaves ring solo (zero-copy path).
     BUCKET_BYTES = 4 * 1024 * 1024
+
+    @classmethod
+    def _plan_buckets(
+        cls, leaves: "List[Tuple[np.dtype, int]]"
+    ) -> "List[Tuple[np.dtype, List[int], int]]":
+        """Greedy same-accumulation-dtype buckets under ``BUCKET_BYTES``.
+
+        ``leaves``: per-leaf (acc dtype, element count).  Returns
+        ``(acc, leaf indices, total elements)`` per bucket, order-
+        preserving — the one plan both the reduce and the wire-byte
+        accounting derive from.
+        """
+        buckets: "List[Tuple[np.dtype, List[int], int]]" = []
+        bucket_bytes: "List[int]" = []
+        open_bucket: "Dict[np.dtype, int]" = {}  # acc dtype -> bucket index
+        for i, (acc, size) in enumerate(leaves):
+            nbytes = size * acc.itemsize
+            if nbytes >= cls.BUCKET_BYTES:
+                buckets.append((acc, [i], size))
+                bucket_bytes.append(nbytes)
+                continue
+            bi = open_bucket.get(acc)
+            if bi is not None and bucket_bytes[bi] + nbytes <= cls.BUCKET_BYTES:
+                buckets[bi][1].append(i)
+                buckets[bi] = (acc, buckets[bi][1], buckets[bi][2] + size)
+                bucket_bytes[bi] += nbytes
+            else:
+                buckets.append((acc, [i], size))
+                bucket_bytes.append(nbytes)
+                open_bucket[acc] = len(buckets) - 1
+        return buckets
+
+    @classmethod
+    def _ring_wire_bytes(
+        cls, leaves: "List[Tuple[np.dtype, int]]", world: int
+    ) -> int:
+        """Per-rank ring-allreduce egress for these leaves: each bucket
+        rings once, sending 2*(w-1) chunk-sized messages (reduce-scatter
+        half + allgather half) of its accumulation dtype."""
+        if world <= 1:
+            return 0
+        total = 0
+        for acc, _idxs, elems in cls._plan_buckets(leaves):
+            chunk = -(-elems // world)
+            total += 2 * (world - 1) * chunk * acc.itemsize
+        return total
 
     def _allreduce_coalesced(
         self, arrays: "List[np.ndarray]", op: str, deadline: float
@@ -877,23 +948,9 @@ class ProcessGroupTCP(ProcessGroup):
             # world==1: _allreduce_one is a pure copy; skip bucketing work
             # entirely (the post-failure shrunken-group hot path)
             return [self._allreduce_one(a, op, deadline) for a in arrays]
-        # greedy same-dtype buckets, capped
-        buckets: "List[Tuple[np.dtype, List[int], int]]" = []  # (acc, idxs, bytes)
-        open_bucket: "Dict[np.dtype, int]" = {}  # acc dtype -> bucket index
-        for i, a in enumerate(arrays):
-            acc = _accumulation_dtype(a.dtype)
-            nbytes = a.size * acc.itemsize
-            if nbytes >= self.BUCKET_BYTES:
-                buckets.append((acc, [i], nbytes))
-                continue
-            bi = open_bucket.get(acc)
-            if bi is not None and buckets[bi][2] + nbytes <= self.BUCKET_BYTES:
-                buckets[bi][1].append(i)
-                buckets[bi] = (acc, buckets[bi][1], buckets[bi][2] + nbytes)
-            else:
-                buckets.append((acc, [i], nbytes))
-                open_bucket[acc] = len(buckets) - 1
-
+        buckets = self._plan_buckets(
+            [(_accumulation_dtype(a.dtype), a.size) for a in arrays]
+        )
         results: "List[Optional[np.ndarray]]" = [None] * len(arrays)
         for acc_dtype, idxs, _ in buckets:
             if len(idxs) == 1:
@@ -933,8 +990,6 @@ class ProcessGroupTCP(ProcessGroup):
         # single private buffer; chunks are views of it, so ring steps
         # receive in place and reduce in place — the only full-size copies
         # are the pad-in and (if dtype widened) the cast back out
-        from torchft_tpu.utils.bufpool import POOL as _pool
-
         # buf escapes to the caller as the result view — not poolable;
         # scratch is private to this call and its size repeats every ring
         # (page-fault amortization, utils/bufpool.py)

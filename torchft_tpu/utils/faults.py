@@ -102,6 +102,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "manager.heal",
     "pg.reconfigure",
     "pg.allreduce",
+    "pg.allreduce.chunk",
     "transport.send",
     "transport.recv",
     "store.barrier",

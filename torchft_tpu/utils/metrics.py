@@ -900,6 +900,25 @@ DILOCO_WIRE_BYTES = gauge(
     "actual when available, else payload bytes)",
     ("fragment",),
 )
+QUANT_CODEC_SECONDS = histogram(
+    "torchft_quant_codec_seconds",
+    "Quantized-collective codec wall per pipeline chunk by stage "
+    "(quantize/reduce/dequant) and wire format (ops/collectives.py)",
+    ("stage", "wire"),
+)
+QUANT_WIRE_SECONDS = histogram(
+    "torchft_quant_wire_seconds",
+    "Quantized-collective wire-op execution seconds per pipeline chunk "
+    "by hop (alltoall/allgather) and wire format",
+    ("op", "wire"),
+)
+QUANT_OVERLAP_EFFICIENCY = gauge(
+    "torchft_quant_overlap_efficiency",
+    "Codec/wire overlap achieved by the last quantized collective: "
+    "(codec_s + wire_s - wall) / min(codec_s, wire_s), 1.0 = perfectly "
+    "pipelined, 0.0 = fully serialized",
+    ("wire",),
+)
 FAULTS_INJECTED = counter(
     "torchft_faults_injected_total",
     "Chaos faults injected by site and action (utils/faults.py registry)",
