@@ -1437,6 +1437,91 @@ def bench_model() -> "Dict[str, Any]":
 
 
 # ---------------------------------------------------------------------------
+# compact tail summary
+# ---------------------------------------------------------------------------
+
+# The driver keeps only the LAST 2000 bytes of stdout; the full result
+# line alone is several KB, so its head (with the primary metric) was
+# truncated out of r5's capture.  The compact summary printed after it
+# must always fit the tail window with room for the trailing newline.
+COMPACT_SUMMARY_MAX_BYTES = 1500
+
+
+def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
+    """Distill the full bench result into one < 1.5 KB JSON line: the
+    primary recovery metric + cycle medians, overhead + cross-check
+    verdict, MFU, and the DiLoCo winners table.  Degrades field by field
+    (never errors) so a partially failed run still tails its primary
+    metric."""
+    model = result.get("model") or {}
+    diloco = result.get("diloco") or {}
+    crosscheck = result.get("crosscheck") or {}
+    phases = result.get("recovery_phases_ms") or {}
+    top_phases = dict(
+        sorted(phases.items(), key=lambda kv: -abs(kv[1]))[:4]
+    )
+    winners = {
+        gbps: {
+            "winner": leg.get("winner"),
+            "int8_speedup_x": leg.get("int8_speedup_x"),
+        }
+        for gbps, leg in sorted((diloco.get("shaped") or {}).items())
+        if isinstance(leg, dict)
+    }
+    out: "Dict[str, Any]" = {
+        "compact": True,
+        "metric": result.get("metric", "recovery_to_healthy_step_latency"),
+        "unit": result.get("unit", "s"),
+        "value": result.get("value"),
+        "vs_baseline": result.get("vs_baseline"),
+        "recovery_cycles_s": result.get("recovery_cycles_s"),
+        "recovery_phases_ms_top": top_phases,
+        "overhead_pct": result.get("overhead_pct"),
+        "model_overhead_pct": result.get("model_overhead_pct"),
+        "crosscheck": {
+            "converged_2pts": crosscheck.get("converged_2pts"),
+            "gap_pts": crosscheck.get("gap_pts"),
+            "noise_floor_bound": crosscheck.get("noise_floor_bound"),
+        },
+        "mfu_pct": model.get("mfu_pct"),
+        "step_ms": model.get("step_ms"),
+        "diloco_winners": winners,
+        "diloco_wire_reduction_x": diloco.get("wire_reduction_x"),
+    }
+    if "error" in result:
+        out["error"] = str(result["error"])[:200]
+    # Enforce the byte budget structurally: drop the least essential
+    # fields first rather than shipping an unparseable truncation.
+    droppable = [
+        "diloco_wire_reduction_x", "step_ms", "diloco_winners",
+        "crosscheck", "recovery_phases_ms_top", "recovery_cycles_s",
+    ]
+    while (
+        len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
+    ):
+        out.pop(droppable.pop(0), None)
+    return out
+
+
+def last_json_line(text: str) -> "Dict[str, Any]":
+    """Parse the last complete JSON line of a captured emission tail —
+    exactly what the driver's 2000-byte tail parser needs to do.  A
+    truncated first line (the tail window cutting into the full result
+    line) is skipped, not fatal."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    raise ValueError("no parseable JSON line in tail")
+
+
+# ---------------------------------------------------------------------------
 
 
 def main() -> None:
@@ -1514,6 +1599,10 @@ def main() -> None:
         "diloco": diloco,
     }
     print(json.dumps(result), flush=True)
+    # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail
+    # must carry the primary metric no matter how large the full result
+    # line grew (VERDICT r5 #2 — r5's number was truncated out).
+    print(json.dumps(compact_summary(result)), flush=True)
 
 
 if __name__ == "__main__":

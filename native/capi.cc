@@ -59,7 +59,9 @@ void tft_free(char* p) { free(p); }
 int64_t tft_lighthouse_create(const char* bind_host, int port,
                               int64_t min_replicas, int64_t join_timeout_ms,
                               int64_t quorum_tick_ms,
-                              int64_t heartbeat_timeout_ms) {
+                              int64_t heartbeat_timeout_ms,
+                              int64_t status_page_size,
+                              int64_t straggler_topk, int64_t timeline_ring) {
   try {
     tft::LighthouseOpt opt;
     opt.bind_host = bind_host ? bind_host : "";
@@ -68,6 +70,9 @@ int64_t tft_lighthouse_create(const char* bind_host, int port,
     opt.join_timeout_ms = join_timeout_ms;
     opt.quorum_tick_ms = quorum_tick_ms;
     opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    if (status_page_size > 0) opt.status_page_size = status_page_size;
+    if (straggler_topk > 0) opt.straggler_topk = straggler_topk;
+    if (timeline_ring > 0) opt.timeline_ring = timeline_ring;
     auto server = std::make_unique<tft::LighthouseServer>(opt);
     server->start_serving();
     return register_server(
@@ -170,6 +175,30 @@ int tft_manager_report_progress(int64_t h, int64_t step,
     return -1;
   }
   manager->report_progress(step, inflight_op ? inflight_op : "");
+  return 0;
+}
+
+// Record a replica group's per-step digest (JSON: step, phase_ms,
+// codec_busy_s, wire_busy_s); the heartbeat loop piggybacks it so the
+// lighthouse can aggregate the rolling cluster step-timeline
+// (/timeline.json).  Invalid JSON is rejected here rather than poisoning
+// the heartbeat path.
+int tft_manager_report_summary(int64_t h, const char* summary_json) {
+  tft::RpcServer* s = find_server(h);
+  auto* manager = dynamic_cast<tft::ManagerServer*>(s);
+  if (manager == nullptr) {
+    g_last_error = "bad manager handle";
+    return -1;
+  }
+  try {
+    tft::Json summary =
+        tft::Json::parse(summary_json ? summary_json : "{}");
+    if (!summary.is_object()) throw std::runtime_error("summary: not an object");
+    manager->report_summary(summary);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
   return 0;
 }
 

@@ -4,6 +4,7 @@
 #include <string.h>
 
 #include <algorithm>
+#include <cctype>
 #include <chrono>
 #include <cstdio>
 #include <set>
@@ -107,8 +108,35 @@ void LighthouseServer::tick_loop() {
   }
 }
 
+void LighthouseServer::touch_heartbeat_locked(const std::string& rid,
+                                              int64_t now) {
+  auto hb = heartbeats_.find(rid);
+  // Dirty only on a freshness TRANSITION: a refresh of an already-fresh
+  // replica cannot change the quorum decision, so it must not cost a
+  // recompute — this is what keeps steady-state ticks O(1) while the
+  // whole fleet heartbeats.
+  bool was_fresh =
+      hb != heartbeats_.end() && now - hb->second < opt_.heartbeat_timeout_ms;
+  if (!was_fresh) dirty_.insert(rid);
+  heartbeats_[rid] = now;
+  auto pos = hb_pos_.find(rid);
+  if (pos != hb_pos_.end()) hb_expiry_.erase(pos->second);
+  hb_pos_[rid] =
+      hb_expiry_.emplace(now + opt_.heartbeat_timeout_ms, rid);
+}
+
+void LighthouseServer::drop_heartbeat_locked(const std::string& rid) {
+  heartbeats_.erase(rid);
+  auto pos = hb_pos_.find(rid);
+  if (pos != hb_pos_.end()) {
+    hb_expiry_.erase(pos->second);
+    hb_pos_.erase(pos);
+  }
+  dirty_.insert(rid);
+}
+
 std::optional<std::vector<QuorumMember>> LighthouseServer::quorum_compute(
-    int64_t now, std::string* reason) {
+    int64_t now, std::string* reason, int64_t* wake_deadline_ms) {
   // Healthy = heartbeat seen within the timeout window.
   std::set<std::string> healthy_replicas;
   for (const auto& [rid, last] : heartbeats_)
@@ -187,6 +215,12 @@ std::optional<std::vector<QuorumMember>> LighthouseServer::quorum_compute(
   for (const auto* d : healthy_participants)
     first_joined = std::min(first_joined, d->joined_ms);
   if (!all_healthy_joined && now - first_joined < opt_.join_timeout_ms) {
+    // The only "no" that flips to "yes" by pure time passage: tell the
+    // tick loop when to look again so the dirty-set gate can't sleep
+    // through the join-timeout expiry.
+    if (wake_deadline_ms != nullptr)
+      *wake_deadline_ms = std::min(*wake_deadline_ms,
+                                   first_joined + opt_.join_timeout_ms);
     *reason = "Valid quorum with " +
               std::to_string(healthy_participants.size()) +
               " participants, waiting for " +
@@ -201,11 +235,50 @@ std::optional<std::vector<QuorumMember>> LighthouseServer::quorum_compute(
   return candidates;
 }
 
+void LighthouseServer::observe_tick_locked(double seconds) {
+  int b = 0;
+  while (b < kNumTickBuckets && seconds > kTickBuckets[b]) ++b;
+  tick_bucket_counts_[b] += 1;
+  tick_count_ += 1;
+  tick_sum_s_ += seconds;
+}
+
 void LighthouseServer::tick_locked(int64_t now) {
+  auto t0 = std::chrono::steady_clock::now();
+  // Pop heartbeats whose freshness expired since the last tick: the only
+  // time-driven healthy-set change.  The expiry index is kept current by
+  // touch_heartbeat_locked, so everything popped here genuinely
+  // transitioned (a refresh re-inserted it at its new expiry).
+  while (!hb_expiry_.empty() && hb_expiry_.begin()->first <= now) {
+    const std::string rid = hb_expiry_.begin()->second;
+    hb_pos_.erase(rid);
+    hb_expiry_.erase(hb_expiry_.begin());
+    dirty_.insert(rid);
+  }
+  // Dirty-set gate: with no state change and no timed deadline due, the
+  // last decision is still the decision — skip the O(fleet) recompute.
+  if (dirty_.empty() && now < wake_deadline_ms_) {
+    // The gauge tracks the most recent TICK (0 = skipped), not the last
+    // decision: an idle fleet must read ~0, not echo its join burst.
+    dirty_last_decision_ = 0;
+    observe_tick_locked(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
+    return;
+  }
+  dirty_last_decision_ = static_cast<int64_t>(dirty_.size());
+  dirty_.clear();
+  wake_deadline_ms_ = INT64_MAX;
+
   std::string reason;
-  auto maybe = quorum_compute(now, &reason);
+  auto maybe = quorum_compute(now, &reason, &wake_deadline_ms_);
   last_reason_ = reason;
-  if (!maybe.has_value()) return;
+  if (!maybe.has_value()) {
+    observe_tick_locked(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
+    return;
+  }
 
   std::vector<QuorumMember>& parts = *maybe;
 
@@ -228,10 +301,21 @@ void LighthouseServer::tick_locked(int64_t now) {
 
   prev_quorum_ = q;
   participants_.clear();
+  // Consuming the registrations flips the cached reason back to "not
+  // ready" — knowable without a recompute, so say it directly.  The old
+  // full-rescan loop re-derived it by re-dirtying every participant,
+  // which made each post-formation decision O(fleet) and pinned the
+  // dirty gauge at fleet size even in steady state.
+  last_reason_ = "Quorum " + std::to_string(quorum_id_) +
+                 " formed with " + std::to_string(parts.size()) +
+                 " members; waiting for new participants";
   latest_quorum_ = q;
   quorum_seq_ += 1;
   quorums_formed_total_ += 1;
   quorum_cv_.notify_all();
+  observe_tick_locked(
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count());
 }
 
 bool LighthouseServer::tick_for_test() {
@@ -246,8 +330,13 @@ Json LighthouseServer::handle(const std::string& method, const Json& params,
   if (method == "quorum") return rpc_quorum(params, timeout_ms);
   if (method == "heartbeat") return rpc_heartbeat(params);
   // One status document for the RPC and GET /status.json: the dashboard
-  // schema IS the programmatic schema (tests assert they round-trip).
-  if (method == "status") return status_json();
+  // schema IS the programmatic schema (tests assert they round-trip),
+  // including the pagination/shard controls.
+  if (method == "status")
+    return status_json(params.get("page").as_int(-1),
+                       params.get("per_page").as_int(0),
+                       params.get("replica").as_string());
+  if (method == "timeline") return timeline_json();
   throw std::runtime_error("lighthouse: unknown method " + method);
 }
 
@@ -275,10 +364,11 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   }
   // Implicit heartbeat + registration (+ progress: the member's step is
   // the freshest progress signal the straggler table can get).
-  heartbeats_[requester.replica_id] = now;
+  touch_heartbeat_locked(requester.replica_id, now);
   note_progress_locked(requester.replica_id, requester.step, 0, "quorum", now);
   int64_t my_token = ++next_reg_token_;
   participants_[requester.replica_id] = {requester, now, my_token};
+  dirty_.insert(requester.replica_id);  // registration changes the decision
   // Fast-restart supersession: replica ids carry a ":uuid" incarnation
   // suffix (Manager appends it precisely so a restarted replica is not
   // confused with its dead predecessor). A new incarnation of the same
@@ -306,16 +396,17 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
     };
     const std::string new_prefix = prefix_of(requester.replica_id);
     if (!new_prefix.empty()) {
-      for (auto it = heartbeats_.begin(); it != heartbeats_.end();) {
-        if (it->first != requester.replica_id &&
-            prefix_of(it->first) == new_prefix) {
-          evicted_at_ms_[it->first] = now;
-          participants_.erase(it->first);
-          progress_.erase(it->first);
-          it = heartbeats_.erase(it);
-        } else {
-          ++it;
-        }
+      std::vector<std::string> superseded;
+      for (const auto& [rid, last] : heartbeats_) {
+        (void)last;
+        if (rid != requester.replica_id && prefix_of(rid) == new_prefix)
+          superseded.push_back(rid);
+      }
+      for (const auto& rid : superseded) {
+        evicted_at_ms_[rid] = now;
+        participants_.erase(rid);
+        progress_.erase(rid);
+        drop_heartbeat_locked(rid);  // also marks the decision dirty
       }
     }
     // Stamps are effectively PERMANENT: supersession is one-directional
@@ -356,8 +447,10 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
   // a repeating 5 s miss in the restart-storm soak test).
   auto deregister_if_mine = [&]() {
     auto it = participants_.find(requester.replica_id);
-    if (it != participants_.end() && it->second.reg_token == my_token)
+    if (it != participants_.end() && it->second.reg_token == my_token) {
       participants_.erase(it);
+      dirty_.insert(requester.replica_id);
+    }
   };
   while (true) {
     // Superseded by a newer incarnation after we entered: abort BEFORE
@@ -385,12 +478,13 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       // cleared participants) — re-register and keep waiting.
       my_token = ++next_reg_token_;
       participants_[requester.replica_id] = {requester, now_ms(), my_token};
+      dirty_.insert(requester.replica_id);
     }
     if (stopping_.load()) {
       deregister_if_mine();
       throw std::runtime_error("lighthouse shutting down");
     }
-    heartbeats_[requester.replica_id] = now_ms();
+    touch_heartbeat_locked(requester.replica_id, now_ms());
     if (std::chrono::steady_clock::now() >= deadline) {
       deregister_if_mine();
       throw TimeoutError("timeout waiting for quorum");
@@ -418,7 +512,7 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
     return out;
   }
   int64_t now = now_ms();
-  heartbeats_[rid] = now;
+  touch_heartbeat_locked(rid, now);
   // Progress piggyback (optional params; a bare heartbeat stays valid):
   // step/last_step_wall_ms/inflight_op feed per-replica step-lag and
   // straggler-score telemetry.
@@ -427,6 +521,92 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
     note_progress_locked(rid, step, params.get("last_step_wall_ms").as_int(0),
                          params.get("inflight_op").as_string(), now);
   }
+  // Step-summary piggyback (optional): the replica's per-step digest
+  // (phase timings, codec/wire busy) folds into the rolling cluster
+  // timeline served at /timeline.json.
+  const Json& summary = params.get("summary");
+  if (summary.is_object()) note_summary_locked(rid, summary, now);
+  return out;
+}
+
+void LighthouseServer::note_summary_locked(const std::string& rid,
+                                           const Json& summary, int64_t now) {
+  int64_t step = summary.get("step").as_int(-1);
+  if (step < 0) return;
+  if (static_cast<int64_t>(timeline_.size()) >= opt_.timeline_ring &&
+      !timeline_.empty() && step < timeline_.begin()->first &&
+      timeline_.count(step) == 0) {
+    return;  // older than the full ring's horizon: evicted, stay evicted
+  }
+  StepBucket& b = timeline_[step];
+  if (b.reports == 0) {
+    b.step = step;
+    b.first_ms = now;
+  }
+  b.last_ms = now;
+  b.reports += 1;
+  b.replicas.insert(rid);
+  for (const auto& [phase, val] : summary.get("phase_ms").as_object()) {
+    PhaseAgg& agg = b.phases[phase];
+    double ms = val.as_double(0.0);
+    agg.n += 1;
+    agg.sum_ms += ms;
+    agg.max_ms = std::max(agg.max_ms, ms);
+  }
+  b.codec_busy_s += summary.get("codec_busy_s").as_double(0.0);
+  b.wire_busy_s += summary.get("wire_busy_s").as_double(0.0);
+  while (static_cast<int64_t>(timeline_.size()) > opt_.timeline_ring)
+    timeline_.erase(timeline_.begin());
+}
+
+Json LighthouseServer::timeline_json() {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  Json out = Json::object();
+  out["quorum_id"] = quorum_id_;
+  out["now_ms"] = wall_ms();
+  out["ring"] = opt_.timeline_ring;
+  out["steps_tracked"] = static_cast<int64_t>(timeline_.size());
+  Json steps = Json::array();
+  for (const auto& [step, b] : timeline_) {
+    (void)step;
+    Json row = Json::object();
+    row["step"] = b.step;
+    row["replicas"] = static_cast<int64_t>(b.replicas.size());
+    row["reports"] = b.reports;
+    row["first_ms"] = b.first_ms;
+    row["last_ms"] = b.last_ms;
+    row["span_ms"] = b.last_ms - b.first_ms;
+    Json phases = Json::object();
+    for (const auto& [name, agg] : b.phases) {
+      Json p = Json::object();
+      p["n"] = agg.n;
+      p["mean_ms"] = agg.n > 0 ? agg.sum_ms / static_cast<double>(agg.n) : 0.0;
+      p["max_ms"] = agg.max_ms;
+      phases[name] = p;
+    }
+    row["phases"] = phases;
+    row["codec_busy_s"] = b.codec_busy_s;
+    row["wire_busy_s"] = b.wire_busy_s;
+    steps.push_back(row);
+  }
+  out["steps"] = steps;
+  // Worst-K straggler snapshot rides along so one /timeline.json scrape
+  // answers both "what was the fleet doing" and "who is holding it up"
+  // (torchft-diagnose --timeline consumes exactly this document).
+  Json worst = Json::array();
+  for (const auto& s : worst_stragglers_locked(now)) {
+    Json row = Json::object();
+    row["replica_id"] = s.replica_id;
+    row["step"] = s.step;
+    row["step_lag"] = s.step_lag;
+    row["progress_age_ms"] = s.progress_age_ms;
+    row["straggler_score"] = s.score;
+    row["inflight_op"] = s.inflight_op;
+    row["stale"] = s.stale;
+    worst.push_back(row);
+  }
+  out["stragglers_worst"] = worst;
   return out;
 }
 
@@ -492,11 +672,90 @@ LighthouseServer::compute_stragglers_locked(int64_t now) {
   return rows;
 }
 
+std::vector<LighthouseServer::StragglerInfo>
+LighthouseServer::worst_stragglers(std::vector<StragglerInfo> rows) {
+  // Stale rows first (a dead replica is always worth a row), then by
+  // descending score — the bounded "summary tier" every unbounded
+  // surface (per-replica /metrics labels, the dashboard straggler
+  // table, the default status document) renders instead of the fleet.
+  std::sort(rows.begin(), rows.end(),
+            [](const StragglerInfo& a, const StragglerInfo& b) {
+              if (a.stale != b.stale) return a.stale > b.stale;
+              if (a.score != b.score) return a.score > b.score;
+              return a.replica_id < b.replica_id;
+            });
+  if (static_cast<int64_t>(rows.size()) > opt_.straggler_topk)
+    rows.resize(static_cast<size_t>(opt_.straggler_topk));
+  return rows;
+}
+
+std::vector<LighthouseServer::StragglerInfo>
+LighthouseServer::worst_stragglers_locked(int64_t now) {
+  return worst_stragglers(compute_stragglers_locked(now));
+}
+
+namespace {
+// Minimal query-string parser: "/p?a=1&b=x" -> {a:"1", b:"x"}.  Values
+// are used as integers or replica ids; %-unescaping covers the one
+// character replica ids legitimately carry in queries (%3A for ':').
+std::map<std::string, std::string> parse_query(const std::string& path) {
+  std::map<std::string, std::string> out;
+  auto qpos = path.find('?');
+  if (qpos == std::string::npos) return out;
+  std::string q = path.substr(qpos + 1);
+  size_t start = 0;
+  while (start <= q.size()) {
+    size_t amp = q.find('&', start);
+    std::string kv = q.substr(
+        start, amp == std::string::npos ? std::string::npos : amp - start);
+    auto eq = kv.find('=');
+    if (eq != std::string::npos) {
+      std::string key = kv.substr(0, eq);
+      std::string val = kv.substr(eq + 1);
+      std::string decoded;
+      for (size_t i = 0; i < val.size(); ++i) {
+        // Decode only well-formed escapes; a malformed one (%zz, trailing
+        // %) passes through literally instead of throwing out of the
+        // HTTP handler and dropping the request with no response.
+        if (val[i] == '%' && i + 2 < val.size() &&
+            std::isxdigit(static_cast<unsigned char>(val[i + 1])) &&
+            std::isxdigit(static_cast<unsigned char>(val[i + 2]))) {
+          decoded += static_cast<char>(
+              std::stoi(val.substr(i + 1, 2), nullptr, 16));
+          i += 2;
+        } else if (val[i] == '+') {
+          decoded += ' ';
+        } else {
+          decoded += val[i];
+        }
+      }
+      out[key] = decoded;
+    }
+    if (amp == std::string::npos) break;
+    start = amp + 1;
+  }
+  return out;
+}
+
+int64_t query_int(const std::map<std::string, std::string>& q,
+                  const std::string& key, int64_t dflt) {
+  auto it = q.find(key);
+  if (it == q.end()) return dflt;
+  try {
+    return std::stoll(it->second);
+  } catch (const std::exception&) {
+    return dflt;
+  }
+}
+}  // namespace
+
 void LighthouseServer::handle_http(int fd, const std::string& request_head) {
   // First line: "METHOD /path HTTP/1.1"
   std::istringstream is(request_head);
-  std::string method, path;
-  is >> method >> path;
+  std::string method, full_path;
+  is >> method >> full_path;
+  auto query = parse_query(full_path);
+  std::string path = full_path.substr(0, full_path.find('?'));
 
   if (method == "POST" && path.rfind("/replica/", 0) == 0) {
     // /replica/{id}/kill — forward a kill RPC to that replica's manager.
@@ -527,11 +786,21 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
     }
   }
   if (method == "GET" && (path == "/" || path == "/status")) {
-    http_reply(fd, 200, "text/html", render_status_html());
+    http_reply(fd, 200, "text/html",
+               render_status_html(query_int(query, "page", 0)));
     return;
   }
   if (method == "GET" && path == "/status.json") {
-    http_reply(fd, 200, "application/json", render_status_json());
+    auto rep = query.find("replica");
+    http_reply(fd, 200, "application/json",
+               status_json(query_int(query, "page", -1),
+                           query_int(query, "per_page", 0),
+                           rep == query.end() ? "" : rep->second)
+                   .dump());
+    return;
+  }
+  if (method == "GET" && path == "/timeline.json") {
+    http_reply(fd, 200, "application/json", timeline_json().dump());
     return;
   }
   if (method == "GET" && path == "/metrics") {
@@ -583,19 +852,52 @@ std::string LighthouseServer::render_metrics() {
           "heartbeat\n"
        << "# TYPE torchft_lighthouse_heartbeats_live gauge\n"
        << "torchft_lighthouse_heartbeats_live " << fresh << "\n";
+    // Tick-cost telemetry: the incremental-quorum claim, measured.
+    os << "# HELP torchft_lighthouse_tick_seconds Quorum tick wall time "
+          "(includes the O(1) dirty-set skip path)\n"
+       << "# TYPE torchft_lighthouse_tick_seconds histogram\n";
+    int64_t cum = 0;
+    char num[64];
+    for (int b = 0; b < kNumTickBuckets; ++b) {
+      cum += tick_bucket_counts_[b];
+      snprintf(num, sizeof(num), "%g", kTickBuckets[b]);
+      os << "torchft_lighthouse_tick_seconds_bucket{le=\"" << num << "\"} "
+         << cum << "\n";
+    }
+    cum += tick_bucket_counts_[kNumTickBuckets];
+    os << "torchft_lighthouse_tick_seconds_bucket{le=\"+Inf\"} " << cum
+       << "\n";
+    snprintf(num, sizeof(num), "%.9g", tick_sum_s_);
+    os << "torchft_lighthouse_tick_seconds_sum " << num << "\n"
+       << "torchft_lighthouse_tick_seconds_count " << tick_count_ << "\n"
+       << "# HELP torchft_lighthouse_dirty_replicas Replicas the most "
+          "recent quorum tick re-evaluated (0 = dirty-set skip; steady "
+          "state is far below fleet size)\n"
+       << "# TYPE torchft_lighthouse_dirty_replicas gauge\n"
+       << "torchft_lighthouse_dirty_replicas " << dirty_last_decision_
+       << "\n";
     // Straggler telemetry: per-replica step lag and score, computed from
     // the progress piggybacked on heartbeat/quorum RPCs.  A dead replica
     // keeps exporting a growing lag until it is superseded/evicted — the
-    // alerting window BEFORE the quorum shrinks around it.
-    auto stragglers = compute_stragglers_locked(now);
+    // alerting window BEFORE the quorum shrinks around it.  Per-replica
+    // labels are the BOUNDED worst-K tier (straggler_topk): at fleet
+    // scale the scrape stays O(K), with fleet-wide truth preserved by
+    // the aggregate max/count gauges below (docs/observability.md
+    // "metric cardinality" — the metrics-cardinality lint pass enforces
+    // the same rule on the Python registry).
+    auto all_rows = compute_stragglers_locked(now);
+    int64_t max_lag = 0;
+    for (const auto& s : all_rows) max_lag = std::max(max_lag, s.step_lag);
+    auto stragglers = worst_stragglers(all_rows);
     os << "# HELP torchft_replica_step_lag Steps behind the most advanced "
-          "tracked replica\n"
+          "tracked replica (worst-K replicas only)\n"
        << "# TYPE torchft_replica_step_lag gauge\n";
     for (const auto& s : stragglers)
       os << "torchft_replica_step_lag{replica=\""
          << escape_label(s.replica_id) << "\"} " << s.step_lag << "\n";
     os << "# HELP torchft_straggler_score Progress age over the median "
-          "fresh-replica age (~1 = typical; large = straggling/dead)\n"
+          "fresh-replica age (~1 = typical; large = straggling/dead; "
+          "worst-K replicas only)\n"
        << "# TYPE torchft_straggler_score gauge\n";
     for (const auto& s : stragglers) {
       char buf[64];
@@ -603,6 +905,15 @@ std::string LighthouseServer::render_metrics() {
       os << "torchft_straggler_score{replica=\""
          << escape_label(s.replica_id) << "\"} " << buf << "\n";
     }
+    os << "# HELP torchft_replica_step_lag_max Fleet-wide maximum step "
+          "lag (unbounded-cardinality truth, one series)\n"
+       << "# TYPE torchft_replica_step_lag_max gauge\n"
+       << "torchft_replica_step_lag_max " << max_lag << "\n"
+       << "# HELP torchft_stragglers_tracked Replicas in the full "
+          "straggler table (worst-K of these are exported per replica)\n"
+       << "# TYPE torchft_stragglers_tracked gauge\n"
+       << "torchft_stragglers_tracked "
+       << static_cast<int64_t>(all_rows.size()) << "\n";
   }
   {
     std::lock_guard<std::mutex> g(provider_mu_);
@@ -623,11 +934,30 @@ std::string LighthouseServer::render_metrics() {
   return os.str();
 }
 
-std::string LighthouseServer::render_status_json() { return status_json().dump(); }
+namespace {
+// Page [page*per_page, (page+1)*per_page) of 0..total; returns [lo, hi).
+// Overflow-proof against attacker-sized query values: any page past the
+// last row is an empty slice, never a wrapped product serving page 0.
+std::pair<size_t, size_t> page_bounds(size_t total, int64_t page,
+                                      int64_t per_page) {
+  size_t pg = static_cast<size_t>(page);
+  size_t pp = static_cast<size_t>(per_page);
+  size_t lo = (pp == 0 || pg > total / pp) ? total : pg * pp;
+  size_t hi = lo + std::min(pp, total - lo);
+  return {lo, hi};
+}
+}  // namespace
 
-Json LighthouseServer::status_json() {
+Json LighthouseServer::status_json(int64_t page, int64_t per_page,
+                                   const std::string& replica_filter) {
   std::lock_guard<std::mutex> g(mu_);
   int64_t now = now_ms();
+  if (per_page <= 0) per_page = opt_.status_page_size;
+  // Cap per_page so query-controlled values can't overflow the `pages`
+  // arithmetic below (and a single page stays a bounded render anyway).
+  if (per_page > 100000) per_page = 100000;
+  if (page < 0) page = 0;
+  const bool sharded = !replica_filter.empty();
   Json out = Json::object();
   out["quorum_id"] = quorum_id_;
   out["status"] = last_reason_;
@@ -637,66 +967,149 @@ Json LighthouseServer::status_json() {
   std::string live_reason;
   quorum_compute(now, &live_reason);
   out["live_status"] = live_reason;
+
+  // Row arrays are paginated (page/per_page over replica_id order) or —
+  // with ?replica= — sharded down to one replica.  Totals and the
+  // summary are always fleet-wide, so the default document is truthful
+  // about scale while staying O(page) in bytes.
+  auto straggler_rows = compute_stragglers_locked(now);
+  int64_t max_step = 0;
+  for (const auto& s : straggler_rows) max_step = std::max(max_step, s.step);
+
+  size_t hb_total = heartbeats_.size();
+  size_t st_total = straggler_rows.size();
+  size_t pq_total =
+      prev_quorum_.has_value() ? prev_quorum_->participants.size() : 0;
+
   Json hbs = Json::array();
-  for (const auto& [rid, ts] : heartbeats_) {
-    Json h = Json::object();
-    h["replica_id"] = rid;
-    h["age_ms"] = now - ts;
-    h["stale"] = (now - ts) >= opt_.heartbeat_timeout_ms;
-    hbs.push_back(h);
+  {
+    // heartbeats_ is replica_id-ordered (std::map): slice directly.
+    auto [lo, hi] = sharded ? std::pair<size_t, size_t>{0, hb_total}
+                            : page_bounds(hb_total, page, per_page);
+    size_t i = 0;
+    int64_t fresh = 0, stale = 0;
+    for (const auto& [rid, ts] : heartbeats_) {
+      bool is_stale = (now - ts) >= opt_.heartbeat_timeout_ms;
+      (is_stale ? stale : fresh) += 1;
+      bool in_page = sharded ? rid == replica_filter : (i >= lo && i < hi);
+      if (in_page) {
+        Json h = Json::object();
+        h["replica_id"] = rid;
+        h["age_ms"] = now - ts;
+        h["stale"] = is_stale;
+        hbs.push_back(h);
+      }
+      ++i;
+    }
+    out["heartbeats_fresh"] = fresh;
+    out["heartbeats_stale"] = stale;
   }
   out["heartbeats"] = hbs;
-  // Straggler telemetry (same rows as /metrics and the dashboard table).
+  out["heartbeats_total"] = static_cast<int64_t>(hb_total);
+
   Json stragglers = Json::array();
-  int64_t max_step = 0;
-  for (const auto& s : compute_stragglers_locked(now)) {
-    Json row = Json::object();
-    row["replica_id"] = s.replica_id;
-    row["step"] = s.step;
-    row["step_lag"] = s.step_lag;
-    row["progress_age_ms"] = s.progress_age_ms;
-    row["last_step_wall_ms"] = s.last_step_wall_ms;
-    row["straggler_score"] = s.score;
-    row["inflight_op"] = s.inflight_op;
-    row["stale"] = s.stale;
-    stragglers.push_back(row);
-    max_step = std::max(max_step, s.step);
+  {
+    // compute_stragglers_locked iterates progress_ (ordered): sliceable.
+    auto [lo, hi] = sharded ? std::pair<size_t, size_t>{0, st_total}
+                            : page_bounds(st_total, page, per_page);
+    for (size_t i = 0; i < straggler_rows.size(); ++i) {
+      const auto& s = straggler_rows[i];
+      bool in_page =
+          sharded ? s.replica_id == replica_filter : (i >= lo && i < hi);
+      if (!in_page) continue;
+      Json row = Json::object();
+      row["replica_id"] = s.replica_id;
+      row["step"] = s.step;
+      row["step_lag"] = s.step_lag;
+      row["progress_age_ms"] = s.progress_age_ms;
+      row["last_step_wall_ms"] = s.last_step_wall_ms;
+      row["straggler_score"] = s.score;
+      row["inflight_op"] = s.inflight_op;
+      row["stale"] = s.stale;
+      stragglers.push_back(row);
+    }
   }
   out["stragglers"] = stragglers;
+  out["stragglers_total"] = static_cast<int64_t>(st_total);
   out["max_step"] = max_step;
+
   if (prev_quorum_.has_value()) {
     Json q = Json::object();
     q["quorum_id"] = prev_quorum_->quorum_id;
     q["created_ms"] = prev_quorum_->created_ms;
     q["age_ms"] = wall_ms() - prev_quorum_->created_ms;
-    int64_t max_step = 0;
+    q["num_participants"] = static_cast<int64_t>(pq_total);
+    int64_t pq_max_step = 0;
     for (const auto& p : prev_quorum_->participants)
-      max_step = std::max(max_step, p.step);
+      pq_max_step = std::max(pq_max_step, p.step);
     Json parts = Json::array();
-    for (const auto& p : prev_quorum_->participants) {
+    auto [lo, hi] = sharded ? std::pair<size_t, size_t>{0, pq_total}
+                            : page_bounds(pq_total, page, per_page);
+    for (size_t i = 0; i < pq_total; ++i) {
+      const auto& p = prev_quorum_->participants[i];
+      bool in_page =
+          sharded ? p.replica_id == replica_filter : (i >= lo && i < hi);
+      if (!in_page) continue;
       // full member fields (the pre-unification status RPC served
       // QuorumMember::to_json — consumers may rely on any of them) plus
       // the dashboard's derived "recovering" flag
       Json m = p.to_json();
-      m["recovering"] = p.step < max_step;
+      m["recovering"] = p.step < pq_max_step;
       parts.push_back(m);
     }
     q["participants"] = parts;
     out["prev_quorum"] = q;
   }
+
+  // Pagination envelope + the always-small summary (worst-K stragglers):
+  // at any fleet size the DEFAULT document answers "is the job healthy
+  // and who is holding it up" without paging.
+  size_t rows_max = std::max(hb_total, std::max(st_total, pq_total));
+  out["page"] = page;
+  out["per_page"] = per_page;
+  out["pages"] = static_cast<int64_t>(
+      (rows_max + static_cast<size_t>(per_page) - 1) /
+      static_cast<size_t>(per_page));
+  if (sharded) out["replica"] = replica_filter;
+  Json summary = Json::object();
+  summary["replicas_tracked"] = static_cast<int64_t>(hb_total);
+  summary["participants_waiting"] =
+      static_cast<int64_t>(participants_.size());
+  summary["quorum_id"] = quorum_id_;
+  summary["max_step"] = max_step;
+  summary["timeline_steps"] = static_cast<int64_t>(timeline_.size());
+  Json worst = Json::array();
+  for (const auto& s : worst_stragglers(straggler_rows)) {
+    Json row = Json::object();
+    row["replica_id"] = s.replica_id;
+    row["step_lag"] = s.step_lag;
+    row["straggler_score"] = s.score;
+    row["stale"] = s.stale;
+    row["inflight_op"] = s.inflight_op;
+    worst.push_back(row);
+  }
+  summary["stragglers_worst"] = worst;
+  out["summary"] = summary;
   return out;
 }
 
-std::string LighthouseServer::render_status_html() {
+std::string LighthouseServer::render_status_html(int64_t page) {
   // Parity with the reference's askama status page
   // (reference templates/status.html:1-52, src/lighthouse.rs:415-452):
   // live next-quorum status, prev-quorum summary (id, participant count,
   // age), per-member card fields (step/manager/store/world_size) with a
-  // "recovering" badge when behind max_step, a kill button, and a full
+  // "recovering" badge when behind max_step, a kill button, and a
   // heartbeat list with an "old" marker past the heartbeat timeout.
   // Auto-refresh via meta refresh instead of htmx (no JS dependency).
+  //
+  // Fleet scale: row tables render ONE page (?page=N, status_page_size
+  // rows) and the straggler table the worst-K by score — at 64+ churning
+  // replicas the page stays a constant-size render, with totals and
+  // next/prev links making the cut visible instead of silent.
   std::lock_guard<std::mutex> g(mu_);
   int64_t now = now_ms();
+  const size_t per_page = static_cast<size_t>(opt_.status_page_size);
+  if (page < 0) page = 0;
   // Recompute the quorum reason LIVE like the reference's get_status
   // (lighthouse.rs:419) rather than echoing the last tick's.
   std::string live_reason;
@@ -710,6 +1123,20 @@ std::string LighthouseServer::render_status_html() {
      << "</head><body><h1>torchft_tpu lighthouse</h1>"
      << "<p>quorum_id: " << quorum_id_ << "</p>"
      << "<p>next quorum status: " << live_reason << "</p>";
+  size_t max_rows = std::max(
+      heartbeats_.size(),
+      prev_quorum_.has_value() ? prev_quorum_->participants.size() : 0);
+  size_t pages = (max_rows + per_page - 1) / per_page;
+  if (pages > 1) {
+    os << "<p>page " << page << " of " << pages << " (" << per_page
+       << " rows/page)";
+    if (page > 0) os << " &middot; <a href=\"/status?page=" << (page - 1)
+                     << "\">prev</a>";
+    if (static_cast<size_t>(page) + 1 < pages)
+      os << " &middot; <a href=\"/status?page=" << (page + 1)
+         << "\">next</a>";
+    os << "</p>";
+  }
   if (prev_quorum_.has_value()) {
     int64_t age_ms = wall_ms() - prev_quorum_->created_ms;
     os << "<h2>previous quorum (id " << prev_quorum_->quorum_id << ")</h2>"
@@ -721,7 +1148,11 @@ std::string LighthouseServer::render_status_html() {
     int64_t max_step = 0;
     for (const auto& p : prev_quorum_->participants)
       max_step = std::max(max_step, p.step);
-    for (const auto& p : prev_quorum_->participants) {
+    auto [lo, hi] =
+        page_bounds(prev_quorum_->participants.size(), page,
+                    static_cast<int64_t>(per_page));
+    for (size_t i = lo; i < hi; ++i) {
+      const auto& p = prev_quorum_->participants[i];
       auto hb = heartbeats_.find(p.replica_id);
       int64_t age = hb == heartbeats_.end() ? -1 : now - hb->second;
       bool recovering = p.step < max_step;
@@ -736,9 +1167,12 @@ std::string LighthouseServer::render_status_html() {
     os << "</table>";
   }
   {
-    auto stragglers = compute_stragglers_locked(now);
+    auto tracked_rows = compute_stragglers_locked(now);
+    size_t tracked = tracked_rows.size();
+    auto stragglers = worst_stragglers(std::move(tracked_rows));
     if (!stragglers.empty()) {
-      os << "<h2>straggler telemetry</h2>"
+      os << "<h2>straggler telemetry (worst " << stragglers.size() << " of "
+         << tracked << " by score)</h2>"
          << "<table><tr><th>replica</th><th>step</th><th>step lag</th>"
          << "<th>progress age (ms)</th><th>score</th><th>in-flight op</th>"
          << "<th>heartbeat</th></tr>";
@@ -755,16 +1189,33 @@ std::string LighthouseServer::render_status_html() {
       os << "</table>";
     }
   }
-  os << "<h2>pending participants (" << participants_.size() << ")</h2><ul>";
-  for (const auto& [rid, det] : participants_)
-    os << "<li>" << rid << " (step " << det.member.step << ")</li>";
+  {
+    os << "<h2>pending participants (" << participants_.size()
+       << ")</h2><ul>";
+    auto [lo, hi] = page_bounds(participants_.size(), page,
+                                static_cast<int64_t>(per_page));
+    size_t i = 0;
+    for (const auto& [rid, det] : participants_) {
+      if (i >= lo && i < hi)
+        os << "<li>" << rid << " (step " << det.member.step << ")</li>";
+      ++i;
+    }
+  }
   os << "</ul><h2>heartbeats (" << heartbeats_.size() << ")</h2><ul>";
-  for (const auto& [rid, ts] : heartbeats_) {
-    int64_t age = now - ts;
-    bool old = age >= opt_.heartbeat_timeout_ms;
-    os << "<li class=\"" << (old ? "old" : "fresh") << "\">" << rid
-       << ": seen " << (age / 1000.0) << "s ago"
-       << (old ? " (stale)" : "") << "</li>";
+  {
+    auto [lo, hi] = page_bounds(heartbeats_.size(), page,
+                                static_cast<int64_t>(per_page));
+    size_t i = 0;
+    for (const auto& [rid, ts] : heartbeats_) {
+      if (i >= lo && i < hi) {
+        int64_t age = now - ts;
+        bool old = age >= opt_.heartbeat_timeout_ms;
+        os << "<li class=\"" << (old ? "old" : "fresh") << "\">" << rid
+           << ": seen " << (age / 1000.0) << "s ago"
+           << (old ? " (stale)" : "") << "</li>";
+      }
+      ++i;
+    }
   }
   os << "</ul></body></html>";
   return os.str();

@@ -61,6 +61,15 @@ struct LighthouseOpt {
   int64_t join_timeout_ms = 100;
   int64_t quorum_tick_ms = 100;
   int64_t heartbeat_timeout_ms = 5000;
+  // Fleet-scale status plane (see docs/observability.md):
+  // default page size for /status.json row arrays (and the dashboard
+  // tables) — the default document stays small at any fleet size.
+  int64_t status_page_size = 16;
+  // straggler rows exported per-replica on /metrics and in the status
+  // summary's worst-K list; the full table is only in the paginated rows.
+  int64_t straggler_topk = 8;
+  // steps retained in the rolling cluster timeline (/timeline.json).
+  int64_t timeline_ring = 256;
 };
 
 class LighthouseServer : public RpcServer {
@@ -105,17 +114,30 @@ class LighthouseServer : public RpcServer {
   };
 
   // Pure decision function over current state; returns participants if a
-  // quorum can form now, plus a human-readable reason either way.
-  std::optional<std::vector<QuorumMember>> quorum_compute(int64_t now,
-                                                          std::string* reason);
-  // Runs one tick under mu_: compute, bump quorum_id, broadcast.
+  // quorum can form now, plus a human-readable reason either way.  When
+  // the answer is "not yet, but pure time passage can change it" (the
+  // join-timeout straggler wait), *wake_deadline_ms is lowered to the
+  // moment the decision must be re-run even with no state change.
+  std::optional<std::vector<QuorumMember>> quorum_compute(
+      int64_t now, std::string* reason, int64_t* wake_deadline_ms = nullptr);
+  // Runs one tick under mu_: pop expired heartbeats into the dirty set,
+  // and only when the dirty set is non-empty (or a timed deadline
+  // passed) re-run the decision: compute, bump quorum_id, broadcast.
+  // Steady-state cost is O(1), not O(fleet).
   void tick_locked(int64_t now);
   void tick_loop();
+  // Heartbeat bookkeeping funnel: updates heartbeats_ + the expiry index,
+  // and marks rid dirty only on a freshness TRANSITION (new or was-stale)
+  // — a refresh of an already-fresh replica cannot change the quorum
+  // decision, so it must not cost a recompute (caller holds mu_).
+  void touch_heartbeat_locked(const std::string& rid, int64_t now);
+  void drop_heartbeat_locked(const std::string& rid);
 
   Json rpc_quorum(const Json& params, int64_t timeout_ms);
   Json rpc_heartbeat(const Json& params);
-  std::string render_status_html();
-  std::string render_status_json();
+  void note_summary_locked(const std::string& rid, const Json& summary,
+                           int64_t now);
+  std::string render_status_html(int64_t page);
   std::string render_metrics();
 
   // Per-replica progress piggybacked on heartbeat/quorum RPCs — the
@@ -142,6 +164,28 @@ class LighthouseServer : public RpcServer {
     bool stale = false;            // heartbeat past timeout
   };
 
+  // One bucket of the rolling cluster step-timeline: aggregated from the
+  // per-replica summaries piggybacked on heartbeats.  Phase stats are
+  // mean+max over the replicas' own per-step values (each replica
+  // reports its local value; the cluster keeps sum/n/max — medians of
+  // 64 streams would need per-report storage the ring deliberately
+  // avoids).
+  struct PhaseAgg {
+    int64_t n = 0;
+    double sum_ms = 0.0;
+    double max_ms = 0.0;
+  };
+  struct StepBucket {
+    int64_t step = 0;
+    int64_t first_ms = 0;  // lighthouse clock: first report for this step
+    int64_t last_ms = 0;   // ... and the latest
+    int64_t reports = 0;
+    std::set<std::string> replicas;  // distinct reporters (≤ fleet size)
+    std::map<std::string, PhaseAgg> phases;
+    double codec_busy_s = 0.0;  // summed across reports
+    double wire_busy_s = 0.0;
+  };
+
  private:
   // Record progress for rid (caller holds mu_).
   void note_progress_locked(const std::string& rid, int64_t step,
@@ -150,9 +194,24 @@ class LighthouseServer : public RpcServer {
   // Straggler table over replicas with a heartbeat entry AND progress
   // (caller holds mu_).
   std::vector<StragglerInfo> compute_stragglers_locked(int64_t now);
+  // Worst-K rows by straggler score (K = straggler_topk), stale rows
+  // first-class — the bounded tier /metrics and the summary document use.
+  // The rows overload sorts/truncates a table the caller already
+  // computed (scoring the fleet twice per scrape under mu_ is exactly
+  // the O(n) tax this PR removes); the now overload is the convenience
+  // for callers that need nothing but the worst-K.
+  std::vector<StragglerInfo> worst_stragglers(std::vector<StragglerInfo> rows);
+  std::vector<StragglerInfo> worst_stragglers_locked(int64_t now);
   // The one status document served by the status RPC and /status.json
-  // (locks mu_ internally).
-  Json status_json();
+  // (locks mu_ internally).  page < 0 = the default first page;
+  // per_page <= 0 = opt_.status_page_size; non-empty replica_filter
+  // shards every row array down to that replica id (no paging).
+  Json status_json(int64_t page, int64_t per_page,
+                   const std::string& replica_filter);
+  Json status_json() { return status_json(-1, 0, ""); }
+  // The rolling cluster step-timeline (/timeline.json and the
+  // "timeline" RPC); locks mu_ internally.
+  Json timeline_json();
 
   LighthouseOpt opt_;
 
@@ -160,8 +219,21 @@ class LighthouseServer : public RpcServer {
   CondVar quorum_cv_;
   std::map<std::string, ParticipantDetails> participants_;
   std::map<std::string, int64_t> heartbeats_;
+  // Incremental-quorum bookkeeping.  hb_expiry_/hb_pos_ index heartbeats_
+  // by expiry time so a tick pops exactly the replicas whose freshness
+  // transitioned instead of rescanning the fleet; dirty_ holds the
+  // replicas whose quorum-relevant state (registration, freshness,
+  // member fields) changed since the decision last ran; wake_deadline_ms_
+  // is the next PURELY time-driven decision change (join-timeout wait).
+  std::multimap<int64_t, std::string> hb_expiry_;
+  std::map<std::string, std::multimap<int64_t, std::string>::iterator> hb_pos_;
+  std::set<std::string> dirty_;
+  int64_t wake_deadline_ms_ = INT64_MAX;
   // replica_id -> progress (pruned with heartbeats_ on supersession).
   std::map<std::string, ReplicaProgress> progress_;
+  // Rolling cluster step-timeline, keyed by step, capped to
+  // opt_.timeline_ring buckets (oldest step evicted).
+  std::map<int64_t, StepBucket> timeline_;
   // Fast-restart supersession bookkeeping: id -> eviction wall time (ms).
   // Presence is the supersession stamp: an evicted incarnation can never
   // re-register, heartbeat, or evict its successor (one-directional — the
@@ -182,6 +254,19 @@ class LighthouseServer : public RpcServer {
   int64_t quorums_formed_total_ = 0;
   int64_t quorum_requests_total_ = 0;
   int64_t heartbeats_total_ = 0;
+  // Tick-cost observability: every tick (including the O(1) skip path —
+  // cheap ticks are the claim) lands in a fixed-bucket histogram, and
+  // the dirty-set size the last decision consumed is exported as a
+  // gauge, so "bounded tick cost" is measured, not assumed.
+  static constexpr double kTickBuckets[] = {
+      1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0};
+  static constexpr int kNumTickBuckets =
+      static_cast<int>(sizeof(kTickBuckets) / sizeof(kTickBuckets[0]));
+  int64_t tick_bucket_counts_[kNumTickBuckets + 1] = {0};  // +1: +Inf
+  int64_t tick_count_ = 0;
+  double tick_sum_s_ = 0.0;
+  int64_t dirty_last_decision_ = 0;
+  void observe_tick_locked(double seconds);
 
   std::mutex provider_mu_;
   MetricsProvider metrics_provider_ = nullptr;

@@ -154,11 +154,17 @@ void ManagerServer::report_progress(int64_t step,
   progress_op_ = inflight_op;
 }
 
+void ManagerServer::report_summary(const Json& summary) {
+  std::lock_guard<std::mutex> g(mu_);
+  pending_summary_ = summary;
+}
+
 void ManagerServer::heartbeat_loop() {
   RpcClient client(opt_.lighthouse_addr);
   while (!stopping_.load()) {
     Json params = Json::object();
     params["replica_id"] = opt_.replica_id;
+    std::optional<Json> summary;
     // Piggyback training progress (straggler telemetry): once the Python
     // Manager has reported a step, every heartbeat carries it so the
     // lighthouse can compute per-replica step lag without extra RPCs.
@@ -168,6 +174,14 @@ void ManagerServer::heartbeat_loop() {
         params["step"] = progress_step_;
         params["last_step_wall_ms"] = progress_wall_ms_;
         params["inflight_op"] = progress_op_;
+      }
+      // Per-step digest rides at most once (cluster timeline aggregates
+      // would overcount a re-sent digest); restored below if the RPC
+      // fails so a transient lighthouse outage doesn't eat it.
+      if (pending_summary_.has_value()) {
+        summary = std::move(pending_summary_);
+        pending_summary_.reset();
+        params["summary"] = *summary;
       }
     }
     try {
@@ -187,6 +201,12 @@ void ManagerServer::heartbeat_loop() {
     } catch (const std::exception&) {
       // Lighthouse unreachable: keep trying; quorum path surfaces errors.
       client.close();
+      if (summary.has_value()) {
+        // Undelivered digest: put it back unless a newer one arrived.
+        std::lock_guard<std::mutex> g(mu_);
+        if (!pending_summary_.has_value())
+          pending_summary_ = std::move(summary);
+      }
     }
     // interruptible sleep: stop() must not wait out a full heartbeat
     // interval (shutdown sits on the recovery-latency critical path), and

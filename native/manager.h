@@ -80,6 +80,13 @@ class ManagerServer : public RpcServer {
   // Manager at quorum entry and after each commit.
   void report_progress(int64_t step, const std::string& inflight_op);
 
+  // Cluster step-timeline: record this group's per-step digest (JSON
+  // object: step, phase_ms{...}, codec_busy_s, wire_busy_s).  The next
+  // heartbeat carries it ONCE (consumed on send — a digest describes one
+  // step; re-sending it every 100 ms heartbeat would overcount it in the
+  // lighthouse's per-step aggregates).
+  void report_summary(const Json& summary);
+
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
@@ -111,6 +118,8 @@ class ManagerServer : public RpcServer {
   int64_t progress_step_ = -1;
   int64_t progress_wall_ms_ = 0;  // wall clock when step last advanced
   std::string progress_op_;
+  // pending per-step digest; consumed by the next heartbeat (mu_)
+  std::optional<Json> pending_summary_;
 
   std::thread heartbeat_thread_;
   // Lighthouse quorum calls run on detached threads (bounded by the request

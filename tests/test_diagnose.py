@@ -49,6 +49,133 @@ class TestSelftest:
 
 
 # ---------------------------------------------------------------------------
+# cluster timeline (--timeline: the lighthouse's fleet view)
+# ---------------------------------------------------------------------------
+
+
+def _timeline_doc(worst=None, steps=None):
+    return {
+        "quorum_id": 3,
+        "now_ms": 1_000_000,
+        "ring": 256,
+        "steps_tracked": len(steps or []),
+        "steps": steps
+        or [
+            {
+                "step": 41,
+                "replicas": 4,
+                "reports": 4,
+                "first_ms": 999_000,
+                "last_ms": 999_100,
+                "span_ms": 100,
+                "phases": {"ring": {"n": 4, "mean_ms": 12.0, "max_ms": 30.0}},
+                "codec_busy_s": 0.4,
+                "wire_busy_s": 0.8,
+            }
+        ],
+        "stragglers_worst": worst or [],
+    }
+
+
+class TestClusterTimeline:
+    def test_timeline_straggler_named_without_any_dumps(self, tmp_path, capsys):
+        """One /timeline.json scrape alone (no flight dumps collected)
+        names the wedged replica — the acceptance path the churn soak
+        exercises live."""
+        doc = _timeline_doc(
+            worst=[
+                {
+                    "replica_id": "stub007:u2", "step": 38, "step_lag": 3,
+                    "progress_age_ms": 9000, "straggler_score": 18.0,
+                    "inflight_op": "wedged", "stale": False,
+                },
+                {
+                    "replica_id": "stub001:u0", "step": 41, "step_lag": 0,
+                    "progress_age_ms": 400, "straggler_score": 1.1,
+                    "inflight_op": "train", "stale": False,
+                },
+            ]
+        )
+        path = tmp_path / "timeline.json"
+        path.write_text(json.dumps(doc))
+        assert diagnose.main(["--timeline", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "LIKELY CULPRIT: stub007:u2" in out
+        assert "timeline_straggler" in out
+        assert "cluster timeline" in out
+        assert "step 41" in out and "replicas=4" in out
+        assert "worst stragglers" in out
+
+    def test_stale_replica_beats_score_threshold(self, tmp_path):
+        doc = _timeline_doc(
+            worst=[
+                {
+                    "replica_id": "dead:u1", "step": 10, "step_lag": 5,
+                    "progress_age_ms": 30000, "straggler_score": 2.0,
+                    "inflight_op": "", "stale": True,
+                }
+            ]
+        )
+        report = diagnose.analyze_timeline(doc)
+        assert report["culprit"]["replica_id"] == "dead:u1"
+        assert "stale" in report["culprit"]["reason"]
+
+    def test_healthy_timeline_names_nobody(self):
+        doc = _timeline_doc(
+            worst=[
+                {
+                    "replica_id": "ok:u1", "step": 41, "step_lag": 0,
+                    "progress_age_ms": 100, "straggler_score": 1.2,
+                    "inflight_op": "train", "stale": False,
+                }
+            ]
+        )
+        assert diagnose.analyze_timeline(doc)["culprit"] is None
+
+    def test_flight_evidence_outranks_timeline(self, tmp_path, capsys):
+        """A dump-implicated replica wins over the timeline straggler:
+        inside-the-replica evidence is stronger than the outside view."""
+        t0 = 1_000_000_000_000
+        dump = tmp_path / "a.jsonl"
+        with open(dump, "w") as fh:
+            for rid, last in (("replica_a:u1", 5), ("replica_b:u2", 1)):
+                for step in range(last):
+                    fh.write(json.dumps({
+                        "flight": "rec", "op": "quorum_rpc", "status": "ok",
+                        "start_ns": t0 + step * 10**9,
+                        "end_ns": t0 + step * 10**9 + 10**6,
+                        "replica_id": rid, "step": step, "quorum_id": 1,
+                    }) + "\n")
+            fh.write(json.dumps({
+                "flight": "rec", "op": "allreduce", "status": "error",
+                "start_ns": t0 + 5 * 10**9, "end_ns": t0 + 6 * 10**9,
+                "replica_id": "replica_a:u1", "step": 4, "quorum_id": 1,
+                "reason": "peer gone",
+            }) + "\n")
+        tl = tmp_path / "timeline.json"
+        tl.write_text(json.dumps(_timeline_doc(worst=[{
+            "replica_id": "unrelated:u9", "step": 2, "step_lag": 3,
+            "progress_age_ms": 9000, "straggler_score": 30.0,
+            "inflight_op": "", "stale": True,
+        }])))
+        assert diagnose.main([str(dump), "--timeline", str(tl)]) == 0
+        out = capsys.readouterr().out
+        # silent-death signal from the dumps wins; timeline still rendered
+        assert "LIKELY CULPRIT: replica_b:u2" in out
+        assert "cluster timeline" in out
+
+    def test_unreadable_timeline_degrades_with_warning(self, tmp_path, capsys):
+        assert diagnose.main(["--timeline", str(tmp_path / "nope.json")]) == 1
+        assert "--timeline" in capsys.readouterr().err
+
+    def test_load_timeline_rejects_non_timeline_json(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text('{"not": "a timeline"}')
+        with pytest.raises(ValueError):
+            diagnose.load_timeline(str(p))
+
+
+# ---------------------------------------------------------------------------
 # attribution units
 # ---------------------------------------------------------------------------
 

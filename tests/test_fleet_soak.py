@@ -1,0 +1,317 @@
+"""Fleet-scale churn soak: the lighthouse status plane at 24-64 replicas.
+
+ROADMAP open-item #2 made "coordination plane survives fleet scale" a
+tested property.  Each replica is a lightweight stub thread (heartbeat +
+quorum participation + per-step digests — no Manager/PG stack, so 64 of
+them fit one process) driven through staggered joins, kills, rejoins
+(new incarnations → supersession), and one deliberately wedged replica.
+
+Asserted, not assumed:
+- quorum_id observations are monotone non-decreasing per stub and
+  quorums keep forming after the churn (no livelock);
+- p99 lighthouse tick latency is bounded, measured via the
+  ``torchft_lighthouse_tick_seconds`` histogram the tick loop exports;
+- the dirty-set path is actually engaged: in steady state
+  ``torchft_lighthouse_dirty_replicas`` is far below fleet size;
+- the DEFAULT ``/status.json`` stays under a fixed byte budget at fleet
+  size while the paginated form still exposes every row;
+- ``torchft-diagnose --timeline`` consumes the lighthouse's
+  ``/timeline.json`` and names the wedged replica.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer, Quorum
+from torchft_tpu.utils.metrics import (
+    parse_text_exposition,
+    quantile_from_histogram,
+)
+
+STATUS_BYTE_BUDGET = 16 * 1024
+TICK_P99_BUDGET_S = 0.1
+
+
+class ReplicaStub:
+    """One fleet member: a thread that heartbeats (with step progress and
+    per-step digests) and joins every quorum round, recording the
+    quorum_ids it observes.  ``wedge()`` freezes its step while the
+    heartbeat keeps running — the classic live-but-stuck straggler."""
+
+    def __init__(self, base_id: str, incarnation: int, addr: str):
+        self.base_id = base_id
+        self.replica_id = f"{base_id}:u{incarnation}"
+        self.addr = addr
+        self.step = 0
+        self.quorum_ids: "list[int]" = []
+        self.errors: "list[Exception]" = []
+        self.superseded = False
+        self._stop = threading.Event()
+        self._wedged = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Simulate a kill: the thread just vanishes (no dereg RPC)."""
+        self._stop.set()
+
+    def wedge(self) -> None:
+        self._wedged.set()
+
+    def join(self, timeout: float = 10.0) -> None:
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        client = LighthouseClient(self.addr)
+        try:
+            while not self._stop.is_set():
+                try:
+                    if self._wedged.is_set():
+                        # wedged: alive (heartbeating) but no progress and
+                        # no quorum participation
+                        reply = client.heartbeat(
+                            self.replica_id, step=self.step,
+                            inflight_op="wedged",
+                        )
+                        if reply.get("superseded"):
+                            self.superseded = True
+                            return
+                        time.sleep(0.02)
+                        continue
+                    q = client.quorum(
+                        replica_id=self.replica_id,
+                        step=self.step,
+                        timeout=3.0,
+                    )
+                    assert isinstance(q, Quorum)
+                    self.quorum_ids.append(q.quorum_id)
+                    self.step += 1
+                    reply = client.heartbeat(
+                        self.replica_id,
+                        step=self.step,
+                        inflight_op="train",
+                        summary={
+                            "step": self.step,
+                            "phase_ms": {"quorum_rpc": 1.0, "ring": 2.0},
+                            "codec_busy_s": 0.001,
+                            "wire_busy_s": 0.002,
+                        },
+                    )
+                    if reply.get("superseded"):
+                        self.superseded = True
+                        return
+                    time.sleep(0.01)
+                except TimeoutError:
+                    continue  # churn: quorum didn't form this round
+                except Exception as e:  # noqa: BLE001 - collected for asserts
+                    msg = str(e).lower()
+                    if "superseded" in msg:
+                        self.superseded = True
+                        return
+                    if self._stop.is_set() or "shutting down" in msg:
+                        return
+                    if "timeout" in msg or "timed out" in msg:
+                        continue
+                    self.errors.append(e)
+                    return
+        finally:
+            client.close()
+
+
+def _http_get(addr: str, path: str) -> bytes:
+    return urllib.request.urlopen(f"http://{addr}{path}", timeout=10).read()
+
+
+def _run_churn_soak(fleet_size: int, tmp_path) -> None:
+    server = LighthouseServer(
+        min_replicas=4,
+        join_timeout_ms=150,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=2000,
+        status_page_size=16,
+        straggler_topk=8,
+        timeline_ring=512,
+    )
+    addr = server.address()
+    stubs: "dict[str, ReplicaStub]" = {}
+    incarnation = {f"stub{i:03d}": 0 for i in range(fleet_size)}
+    try:
+        # phase 1: staggered joins
+        for i, base in enumerate(sorted(incarnation)):
+            stub = ReplicaStub(base, 0, addr)
+            stubs[base] = stub
+            stub.start()
+            if i % 4 == 0:
+                time.sleep(0.02)
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if sum(len(s.quorum_ids) for s in stubs.values()) >= fleet_size:
+                break
+            time.sleep(0.1)
+        assert sum(len(s.quorum_ids) for s in stubs.values()) >= fleet_size, (
+            "fleet never started forming quorums"
+        )
+
+        # phase 2: churn — kill a third of the fleet, rejoin each as a new
+        # incarnation (supersession evicts the old one)
+        victims = sorted(incarnation)[:: 3]
+        for base in victims:
+            stubs[base].stop()
+        time.sleep(0.3)
+        for base in victims:
+            incarnation[base] += 1
+            stub = ReplicaStub(base, incarnation[base], addr)
+            stubs[base] = stub
+            stub.start()
+            time.sleep(0.01)
+
+        # phase 3: wedge one replica (alive, heartbeating, zero progress)
+        wedged = stubs[sorted(incarnation)[1]]
+        wedged.wedge()
+        time.sleep(2.0)  # straggler score needs real wall time to grow
+
+        # phase 4: steady state — no churn; sample the dirty-set gauge
+        dirty_samples = []
+        for _ in range(6):
+            fams = parse_text_exposition(_http_get(addr, "/metrics").decode())
+            dirty_samples.append(
+                fams["torchft_lighthouse_dirty_replicas"]["samples"][
+                    ("torchft_lighthouse_dirty_replicas", ())
+                ]
+            )
+            time.sleep(0.2)
+
+        # no livelock: quorums still form after all the churn
+        before = sum(len(s.quorum_ids) for s in stubs.values())
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sum(len(s.quorum_ids) for s in stubs.values()) > before:
+                break
+            time.sleep(0.1)
+        assert (
+            sum(len(s.quorum_ids) for s in stubs.values()) > before
+        ), "no quorum formed after churn: livelock"
+
+        # -- status plane budget + pagination ---------------------------
+        default_status = _http_get(addr, "/status.json")
+        assert len(default_status) < STATUS_BYTE_BUDGET, (
+            f"default /status.json is {len(default_status)}B at "
+            f"{fleet_size} replicas (budget {STATUS_BYTE_BUDGET})"
+        )
+        doc = json.loads(default_status)
+        assert doc["heartbeats_total"] >= fleet_size
+        assert len(doc["heartbeats"]) <= doc["per_page"]
+        assert doc["summary"]["stragglers_worst"], "summary lost the worst-K"
+        # paginated union covers every tracked replica
+        seen = set()
+        for page in range(doc["pages"]):
+            page_doc = json.loads(
+                _http_get(addr, f"/status.json?page={page}&per_page=16")
+            )
+            seen.update(h["replica_id"] for h in page_doc["heartbeats"])
+        assert len(seen) == doc["heartbeats_total"], (
+            "paginated pages do not cover every heartbeat row"
+        )
+        live_ids = {s.replica_id for s in stubs.values()}
+        assert live_ids <= seen
+        # per-replica shard
+        shard = json.loads(
+            _http_get(
+                addr,
+                "/status.json?replica=" + wedged.replica_id.replace(":", "%3A"),
+            )
+        )
+        assert [h["replica_id"] for h in shard["heartbeats"]] == [
+            wedged.replica_id
+        ]
+
+        # -- tick cost --------------------------------------------------
+        fams = parse_text_exposition(_http_get(addr, "/metrics").decode())
+        tick_count = fams["torchft_lighthouse_tick_seconds"]["samples"][
+            ("torchft_lighthouse_tick_seconds_count", ())
+        ]
+        assert tick_count > 50, "tick histogram barely populated"
+        p99 = quantile_from_histogram(
+            fams, "torchft_lighthouse_tick_seconds", 0.99
+        )
+        assert p99 <= TICK_P99_BUDGET_S, (
+            f"p99 tick latency {p99}s over budget at {fleet_size} replicas"
+        )
+        # dirty-set engaged: steady state re-evaluates a small fraction of
+        # the fleet, not all of it
+        assert min(dirty_samples) < fleet_size / 4, (
+            f"dirty set never dropped below fleet/4: {dirty_samples}"
+        )
+        # the bounded per-replica tier holds at fleet scale
+        lag_rows = [
+            k
+            for k in fams["torchft_replica_step_lag"]["samples"]
+            if k[0] == "torchft_replica_step_lag"
+        ]
+        assert len(lag_rows) <= 8, "per-replica /metrics labels unbounded"
+        assert (
+            fams["torchft_stragglers_tracked"]["samples"][
+                ("torchft_stragglers_tracked", ())
+            ]
+            >= fleet_size
+        )
+
+        # -- timeline + diagnose ----------------------------------------
+        timeline = json.loads(_http_get(addr, "/timeline.json"))
+        assert timeline["steps"], "no timeline buckets aggregated"
+        assert max(b["replicas"] for b in timeline["steps"]) >= 2
+        assert any(b["phases"].get("ring") for b in timeline["steps"])
+        worst = timeline["stragglers_worst"]
+        assert worst and worst[0]["replica_id"] == wedged.replica_id, (
+            f"wedged replica not the worst straggler: {worst[:3]}"
+        )
+
+        tl_path = tmp_path / "timeline.json"
+        tl_path.write_text(json.dumps(timeline))
+        from torchft_tpu import diagnose
+
+        report = diagnose.analyze_timeline(timeline)
+        assert report["culprit"] is not None
+        assert report["culprit"]["replica_id"] == wedged.replica_id
+        assert report["culprit"]["signal"] == "timeline_straggler"
+        # ... and through the CLI, from the serialized scrape alone
+        assert diagnose.main(["--timeline", str(tl_path)]) == 0
+
+        # -- quorum_id monotonicity -------------------------------------
+        for s in stubs.values():
+            assert s.quorum_ids == sorted(s.quorum_ids), (
+                f"{s.replica_id} observed non-monotone quorum ids: "
+                f"{s.quorum_ids[:20]}"
+            )
+        assert not any(s.errors for s in stubs.values()), {
+            s.replica_id: s.errors for s in stubs.values() if s.errors
+        }
+    finally:
+        for s in stubs.values():
+            s.stop()
+        for s in stubs.values():
+            s.join(timeout=5.0)
+        server.shutdown()
+
+
+class TestFleetChurnSoak:
+    def test_churn_soak_24_replicas(self, tmp_path):
+        """Tier-1 variant: 24 stubs under staggered joins/kills/rejoins in
+        well under the 60 s soak budget."""
+        t0 = time.monotonic()
+        _run_churn_soak(24, tmp_path)
+        assert time.monotonic() - t0 < 60.0
+
+    @pytest.mark.slow
+    def test_churn_soak_64_replicas(self, tmp_path):
+        """Full fleet-scale variant (slow-marked): 64 stubs."""
+        t0 = time.monotonic()
+        _run_churn_soak(64, tmp_path)
+        assert time.monotonic() - t0 < 60.0

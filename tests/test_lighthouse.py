@@ -375,6 +375,257 @@ class TestLighthouse:
             assert all("stale" in h for h in status["heartbeats"])
 
 
+class TestStatusPlanePagination:
+    """The fleet-scale status surface: paginated/sharded /status.json,
+    byte-budgeted dashboard, tick-cost metrics, and the cluster
+    step-timeline (ISSUE 6 tentpole b/c)."""
+
+    FLEET = 64
+
+    def _populate(self, server, n):
+        client = LighthouseClient(server.address())
+        for i in range(n):
+            client.heartbeat(
+                f"replica{i:03d}", step=100 + (i % 7), inflight_op="train",
+                summary={
+                    "step": 100 + (i % 7),
+                    "phase_ms": {"ring": 10.0 + i, "commit": 1.0},
+                    "codec_busy_s": 0.01,
+                    "wire_busy_s": 0.02,
+                },
+            )
+        return client
+
+    def test_paginated_roundtrip_native_python_dashboard(self):
+        """The same paginated document through all three surfaces: the
+        native HTTP render, the status RPC (LighthouseClient.status with
+        page/per_page/replica), and the dashboard's data — rows slice
+        without loss and fleet-wide totals stay truthful on every page."""
+        import json as _json
+
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=60000,
+            status_page_size=10,
+        ) as server:
+            client = self._populate(server, self.FLEET)
+            # default document: first page, server page size
+            rpc = client.status()
+            http = _json.loads(
+                urllib.request.urlopen(
+                    f"http://{server.address()}/status.json", timeout=5
+                ).read().decode()
+            )
+            for doc in (rpc, http):
+                assert doc["page"] == 0 and doc["per_page"] == 10
+                assert doc["heartbeats_total"] == self.FLEET
+                assert doc["stragglers_total"] == self.FLEET
+                assert doc["pages"] == 7
+                assert len(doc["heartbeats"]) == 10
+                assert len(doc["stragglers"]) == 10
+                assert doc["max_step"] == 106  # fleet-wide, not page-wide
+                assert doc["summary"]["replicas_tracked"] == self.FLEET
+                assert len(doc["summary"]["stragglers_worst"]) <= 8
+            # explicit paging round-trips identically RPC vs HTTP, and the
+            # union of pages is exactly the fleet
+            seen_rpc, seen_http = set(), set()
+            for page in range(rpc["pages"]):
+                p_rpc = client.status(page=page, per_page=10)
+                p_http = _json.loads(
+                    urllib.request.urlopen(
+                        f"http://{server.address()}"
+                        f"/status.json?page={page}&per_page=10",
+                        timeout=5,
+                    ).read().decode()
+                )
+                assert [h["replica_id"] for h in p_rpc["heartbeats"]] == [
+                    h["replica_id"] for h in p_http["heartbeats"]
+                ]
+                assert [s["replica_id"] for s in p_rpc["stragglers"]] == [
+                    s["replica_id"] for s in p_http["stragglers"]
+                ]
+                seen_rpc.update(h["replica_id"] for h in p_rpc["heartbeats"])
+                seen_http.update(h["replica_id"] for h in p_http["heartbeats"])
+            expected = {f"replica{i:03d}" for i in range(self.FLEET)}
+            assert seen_rpc == expected and seen_http == expected
+            # replica shard: one replica's rows from every array
+            shard = client.status(replica="replica007")
+            assert shard["replica"] == "replica007"
+            assert [h["replica_id"] for h in shard["heartbeats"]] == [
+                "replica007"
+            ]
+            assert [s["replica_id"] for s in shard["stragglers"]] == [
+                "replica007"
+            ]
+            assert shard["heartbeats_total"] == self.FLEET  # totals intact
+            # straggler row fields survive pagination (schema round-trip)
+            row = shard["stragglers"][0]
+            for field in (
+                "step", "step_lag", "progress_age_ms", "last_step_wall_ms",
+                "straggler_score", "inflight_op", "stale",
+            ):
+                assert field in row, field
+            client.close()
+
+    def test_dashboard_byte_budget_at_fleet_scale(self):
+        """At 64 replicas the default /status.json and the dashboard HTML
+        both stay under fixed byte budgets while ?page= walks every row
+        (ISSUE 6 acceptance: < 16 KB default document)."""
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=60000,
+            status_page_size=16,
+        ) as server:
+            client = self._populate(server, self.FLEET)
+            body = urllib.request.urlopen(
+                f"http://{server.address()}/status.json", timeout=5
+            ).read()
+            assert len(body) < 16 * 1024, f"default status {len(body)}B"
+            html = urllib.request.urlopen(
+                f"http://{server.address()}/status", timeout=5
+            ).read()
+            assert len(html) < 32 * 1024, f"dashboard page {len(html)}B"
+            page_html = html.decode()
+            assert "page 0 of 4" in page_html
+            assert "/status?page=1" in page_html  # next link
+            # straggler table is the bounded worst-K tier
+            assert "worst 8 of 64 by score" in page_html
+            # the last page still renders the last replica
+            last = urllib.request.urlopen(
+                f"http://{server.address()}/status?page=3", timeout=5
+            ).read().decode()
+            assert "replica063" in last
+            client.close()
+
+    def test_tick_metrics_and_bounded_labels(self):
+        """/metrics exports the tick-cost histogram + dirty gauge, and the
+        per-replica straggler series are capped at straggler_topk with
+        fleet-wide aggregates alongside."""
+        from torchft_tpu.utils.metrics import (
+            parse_text_exposition,
+            quantile_from_histogram,
+        )
+
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=60000,
+            straggler_topk=5,
+        ) as server:
+            client = self._populate(server, 20)
+            time.sleep(0.3)  # a few tick-loop iterations
+            body = urllib.request.urlopen(
+                f"http://{server.address()}/metrics", timeout=5
+            ).read().decode()
+            client.close()
+        fams = parse_text_exposition(body)
+        assert fams["torchft_lighthouse_tick_seconds"]["type"] == "histogram"
+        count = fams["torchft_lighthouse_tick_seconds"]["samples"][
+            ("torchft_lighthouse_tick_seconds_count", ())
+        ]
+        assert count >= 1
+        # bounded even on a loaded host: ticks are O(dirty), not O(fleet)
+        assert quantile_from_histogram(
+            fams, "torchft_lighthouse_tick_seconds", 0.99
+        ) <= 1.0
+        assert ("torchft_lighthouse_dirty_replicas", ()) in fams[
+            "torchft_lighthouse_dirty_replicas"
+        ]["samples"]
+        lag_rows = [
+            k for k in fams["torchft_replica_step_lag"]["samples"]
+        ]
+        assert 0 < len(lag_rows) <= 5
+        assert (
+            fams["torchft_stragglers_tracked"]["samples"][
+                ("torchft_stragglers_tracked", ())
+            ]
+            == 20
+        )
+        assert ("torchft_replica_step_lag_max", ()) in fams[
+            "torchft_replica_step_lag_max"
+        ]["samples"]
+
+    def test_timeline_aggregation_and_manager_piggyback(self):
+        """/timeline.json aggregates heartbeat-piggybacked digests (means,
+        maxes, replica counts per step) — including through the native
+        ManagerServer.report_summary -> heartbeat-loop path the real
+        Manager uses."""
+        import json as _json
+
+        from torchft_tpu.coordination import ManagerServer, StoreServer
+
+        with LighthouseServer(
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=60000,
+            timeline_ring=4,
+        ) as server:
+            client = LighthouseClient(server.address())
+            for step in range(6):  # ring=4: steps 0,1 must be evicted
+                for rid in ("a", "b"):
+                    client.heartbeat(
+                        rid, step=step,
+                        summary={
+                            "step": step,
+                            "phase_ms": {"ring": 10.0 if rid == "a" else 20.0},
+                            "codec_busy_s": 0.5,
+                            "wire_busy_s": 0.25,
+                        },
+                    )
+            tl = client.timeline()
+            assert [b["step"] for b in tl["steps"]] == [2, 3, 4, 5]
+            bucket = tl["steps"][-1]
+            assert bucket["replicas"] == 2 and bucket["reports"] == 2
+            assert bucket["phases"]["ring"]["mean_ms"] == pytest.approx(15.0)
+            assert bucket["phases"]["ring"]["max_ms"] == pytest.approx(20.0)
+            assert bucket["codec_busy_s"] == pytest.approx(1.0)
+            assert bucket["wire_busy_s"] == pytest.approx(0.5)
+            # HTTP serves the same document
+            http = _json.loads(
+                urllib.request.urlopen(
+                    f"http://{server.address()}/timeline.json", timeout=5
+                ).read().decode()
+            )
+            assert http["steps"] == tl["steps"]
+
+            # the native manager path: report_summary rides the next
+            # heartbeat exactly once
+            store = StoreServer()
+            manager = ManagerServer(
+                replica_id="mgr:u1",
+                lighthouse_addr=server.address(),
+                store_address=store.address(),
+                world_size=1,
+                heartbeat_interval=0.05,
+            )
+            try:
+                manager.report_progress(7, "train")
+                manager.report_summary(
+                    {
+                        "step": 7,
+                        "phase_ms": {"commit": 3.0},
+                        "codec_busy_s": 0.0,
+                        "wire_busy_s": 0.0,
+                    }
+                )
+                deadline = time.monotonic() + 5.0
+                bucket = None
+                while time.monotonic() < deadline:
+                    tl = client.timeline()
+                    bucket = next(
+                        (b for b in tl["steps"] if b["step"] == 7), None
+                    )
+                    if bucket is not None:
+                        break
+                    time.sleep(0.05)
+                assert bucket is not None, "manager digest never arrived"
+                assert bucket["phases"]["commit"]["mean_ms"] == pytest.approx(3.0)
+                first_reports = bucket["reports"]
+                # consumed-on-send: later heartbeats must not re-deliver it
+                time.sleep(0.3)
+                tl = client.timeline()
+                bucket = next(b for b in tl["steps"] if b["step"] == 7)
+                assert bucket["reports"] == first_reports
+            finally:
+                manager.shutdown()
+                store.shutdown()
+            client.close()
+
+
 class TestCoordinationDocs:
     def test_public_api_documented(self):
         """Every public coordination class + method carries a docstring

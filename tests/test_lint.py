@@ -84,6 +84,17 @@ _SEEDED = {
             'M = counter("myapp_rogue_total", "wrong namespace")\n'
         ),
     },
+    "metrics-cardinality": {
+        "pkg/bad.py": textwrap.dedent(
+            """
+            from torchft_tpu.utils.metrics import gauge
+            G = gauge("torchft_peer_lag", "d")
+            def export(peers):
+                for p in peers:
+                    G.labels(peer=p.addr).set(p.lag)
+            """
+        ),
+    },
     "retry-ban": {
         "pkg/bad.py": textwrap.dedent(
             """

@@ -113,6 +113,8 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_lighthouse_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            # status plane: status_page_size, straggler_topk, timeline_ring
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ]
         lib.tft_manager_create.restype = ctypes.c_int64
         lib.tft_manager_create.argtypes = [
@@ -136,6 +138,11 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_manager_report_progress.restype = ctypes.c_int
         lib.tft_manager_report_progress.argtypes = [
             ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+        ]
+
+        lib.tft_manager_report_summary.restype = ctypes.c_int
+        lib.tft_manager_report_summary.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
         ]
 
         lib.tft_compute_quorum_results.restype = ctypes.c_void_p

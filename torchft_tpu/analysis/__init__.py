@@ -18,6 +18,9 @@ file for grandfathered findings — all empty):
                           TORCHFT_*-named, documented
 ``metrics-sync``          metric names torchft_*, unique, documented;
                           event kinds in both _LOGGERS and _SEVERITY
+``metrics-cardinality``   per-replica/per-peer label values bounded or
+                          top-K-aggregated (fleet churn must not grow
+                          the registry)
 ``retry-ban``             no time.sleep retry loops outside utils/retry.py
 ``fault-coverage``        fault sites registered/documented/wired; PG +
                           transport paths feed the flight recorder
@@ -38,6 +41,7 @@ from torchft_tpu.analysis.core import (  # noqa: F401
 from torchft_tpu.analysis.coverage import PASS as _coverage
 from torchft_tpu.analysis.env_hygiene import PASS as _env_hygiene
 from torchft_tpu.analysis.lock_discipline import PASS as _lock_discipline
+from torchft_tpu.analysis.metrics_cardinality import PASS as _metrics_cardinality
 from torchft_tpu.analysis.metrics_sync import PASS as _metrics_sync
 from torchft_tpu.analysis.retry_ban import PASS as _retry_ban
 
@@ -46,6 +50,7 @@ PASSES = (
     _lock_discipline,
     _env_hygiene,
     _metrics_sync,
+    _metrics_cardinality,
     _retry_ban,
     _coverage,
 )

@@ -373,7 +373,12 @@ class LighthouseServer(_NativeServer):
         join_timeout_ms: int = 100,
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
+        status_page_size: "Optional[int]" = None,
+        straggler_topk: "Optional[int]" = None,
+        timeline_ring: "Optional[int]" = None,
     ) -> None:
+        from torchft_tpu.utils.env import env_int
+
         host, _, port = bind.rpartition(":")
         lib = _native.get_lib()
         handle = lib.tft_lighthouse_create(
@@ -383,6 +388,18 @@ class LighthouseServer(_NativeServer):
             join_timeout_ms,
             quorum_tick_ms,
             heartbeat_timeout_ms,
+            # fleet-scale status plane sizing (docs/observability.md):
+            # rows per /status.json + dashboard page, worst-K straggler
+            # export, and the cluster step-timeline ring length
+            status_page_size
+            if status_page_size is not None
+            else env_int("TORCHFT_STATUS_PAGE_SIZE", 16, minimum=1),
+            straggler_topk
+            if straggler_topk is not None
+            else env_int("TORCHFT_STRAGGLER_TOPK", 8, minimum=1),
+            timeline_ring
+            if timeline_ring is not None
+            else env_int("TORCHFT_TIMELINE_RING", 256, minimum=1),
         )
         super().__init__(handle)
         self._metrics_cb: Any = None
@@ -476,6 +493,19 @@ class ManagerServer(_NativeServer):
             self._handle, int(step), inflight_op.encode()
         )
 
+    def report_summary(self, summary: "Dict[str, Any]") -> None:
+        """Record this replica group's per-step digest (``step``,
+        ``phase_ms`` name->ms, ``codec_busy_s``, ``wire_busy_s``); the
+        next lighthouse heartbeat carries it exactly once, feeding the
+        cluster step-timeline (``/timeline.json``)."""
+        if self._handle is None:
+            return
+        rc = _native.get_lib().tft_manager_report_summary(
+            self._handle, json.dumps(summary).encode()
+        )
+        if rc != 0:
+            raise RuntimeError(_native.last_error())
+
 
 # ---------------------------------------------------------------------------
 # clients
@@ -537,6 +567,7 @@ class LighthouseClient:
         step: "Optional[int]" = None,
         last_step_wall_ms: "Optional[int]" = None,
         inflight_op: "Optional[str]" = None,
+        summary: "Optional[Dict[str, Any]]" = None,
     ) -> Dict[str, Any]:
         """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms.
 
@@ -546,8 +577,12 @@ class LighthouseClient:
         replica is currently doing.  The lighthouse folds these into
         per-replica step lag and straggler scores (``/status.json``
         ``stragglers``, ``/metrics`` ``torchft_replica_step_lag`` /
-        ``torchft_straggler_score``).  Returns the server reply (e.g.
-        ``{"superseded": true}`` for an evicted incarnation)."""
+        ``torchft_straggler_score``).  ``summary`` is the per-step digest
+        (``step``, ``phase_ms`` name->ms, ``codec_busy_s``,
+        ``wire_busy_s``) aggregated into the cluster step-timeline
+        (``/timeline.json``) — send a given step's digest ONCE.  Returns
+        the server reply (e.g. ``{"superseded": true}`` for an evicted
+        incarnation)."""
         # chaos site: the straggler-telemetry path must itself be
         # chaos-testable (docs/robustness.md site table)
         _faults.check("lighthouse.heartbeat", replica=replica_id)
@@ -558,11 +593,44 @@ class LighthouseClient:
             params["last_step_wall_ms"] = int(last_step_wall_ms)
         if inflight_op is not None:
             params["inflight_op"] = inflight_op
+        if summary is not None:
+            params["summary"] = summary
         return self._client.call("heartbeat", params, timeout)
 
-    def status(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
-        """Quorum/participant/heartbeat snapshot (the dashboard's data)."""
-        return self._client.call("status", {}, timeout)
+    def status(
+        self,
+        timeout: "float | timedelta" = 5.0,
+        page: "Optional[int]" = None,
+        per_page: "Optional[int]" = None,
+        replica: "Optional[str]" = None,
+    ) -> Dict[str, Any]:
+        """Quorum/participant/heartbeat snapshot (the dashboard's data).
+
+        The same document as ``GET /status.json``: row arrays
+        (``heartbeats``, ``stragglers``, ``prev_quorum.participants``)
+        are paginated — ``page``/``per_page`` select a slice (defaults:
+        page 0 of the server's ``TORCHFT_STATUS_PAGE_SIZE``), ``replica``
+        shards every array down to one replica id.  Fleet-wide truth is
+        always present regardless of page: ``*_total`` counts, ``pages``,
+        ``max_step``, and ``summary`` (counts + the worst-K stragglers by
+        score).  See docs/observability.md for the schema."""
+        params: "Dict[str, Any]" = {}
+        if page is not None:
+            params["page"] = int(page)
+        if per_page is not None:
+            params["per_page"] = int(per_page)
+        if replica is not None:
+            params["replica"] = replica
+        return self._client.call("status", params, timeout)
+
+    def timeline(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
+        """The rolling cluster step-timeline (same document as
+        ``GET /timeline.json``): per-step buckets aggregated from the
+        heartbeat-piggybacked replica digests (replicas seen, phase
+        mean/max, codec/wire busy, first/last report stamps) plus the
+        worst-K straggler snapshot — one scrape answers "what was the
+        whole fleet doing at step N"."""
+        return self._client.call("timeline", {}, timeout)
 
     def close(self) -> None:
         """Close the underlying connection; the client is unusable after."""

@@ -17,6 +17,13 @@ degraded run:
    that phase;
 4. **retry storms** — bursts of ``retry`` records flagged per operation.
 
+``--timeline <file-or-URL>`` additionally folds in the lighthouse's
+rolling cluster step-timeline (``GET /timeline.json`` — aggregated from
+the heartbeat-piggybacked per-replica step digests) so one scrape
+answers "what was the whole fleet doing at step N"; its worst-K
+straggler snapshot names a culprit (signal ``timeline_straggler``) even
+when no flight dumps were collected at all.
+
 Output is a human timeline + verdict (default) or ``--json`` for machines.
 ``--selftest`` generates a synthetic two-replica dump pair in a temp dir
 and checks culprit attribution end to end — wired into the test suite so
@@ -37,7 +44,16 @@ import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["load_records", "analyze", "render_text", "selftest", "main"]
+__all__ = [
+    "load_records",
+    "load_timeline",
+    "analyze",
+    "analyze_timeline",
+    "render_text",
+    "render_timeline_text",
+    "selftest",
+    "main",
+]
 
 # record statuses that mean "something went wrong here"
 _ERROR_STATUSES = ("error", "abort")
@@ -45,6 +61,9 @@ _ERROR_STATUSES = ("error", "abort")
 _ERROR_KINDS = ("error", "abort")
 # at least this many retry records for one op counts as a storm
 RETRY_STORM_THRESHOLD = 3
+# a straggler score this far past typical (~1.0) in the lighthouse
+# timeline snapshot is a culprit signal of its own
+TIMELINE_STRAGGLER_SCORE = 4.0
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +171,30 @@ def load_records(
         parse_file(p, events_only=True)
     entries.sort(key=lambda e: e["t_ns"])
     return entries, warnings
+
+
+def load_timeline(src: str) -> "Dict[str, Any]":
+    """Load a lighthouse ``/timeline.json`` document from a file path or
+    an ``http(s)://`` URL (``host:port`` shorthand fetches
+    ``http://host:port/timeline.json``).  Raises on unreadable/invalid
+    input — a requested timeline that cannot be read is an error, not a
+    silently thinner report."""
+    if src.startswith(("http://", "https://")) or (
+        "/" not in src and ":" in src and not os.path.exists(src)
+    ):
+        import urllib.request
+
+        url = src if src.startswith("http") else f"http://{src}"
+        if not url.rstrip("/").endswith("/timeline.json"):
+            url = url.rstrip("/") + "/timeline.json"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+    else:
+        with open(src, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    if not isinstance(doc, dict) or "steps" not in doc:
+        raise ValueError(f"{src}: not a /timeline.json document")
+    return doc
 
 
 # ---------------------------------------------------------------------------
@@ -421,6 +464,47 @@ def analyze(entries: "List[Dict[str, Any]]") -> "Dict[str, Any]":
     }
 
 
+def analyze_timeline(timeline: "Dict[str, Any]") -> "Dict[str, Any]":
+    """Culprit attribution from the lighthouse's own fleet view: the
+    worst straggler snapshot riding ``/timeline.json``.
+
+    A replica is named when it is **stale** (still tracked, heartbeat
+    expired — dead or wedged hard) or its straggler score is past
+    ``TIMELINE_STRAGGLER_SCORE`` (progress age many multiples of the
+    fleet-typical cadence).  This is evidence the flight-recorder path
+    cannot see: it requires no dump from any replica."""
+    worst = timeline.get("stragglers_worst") or []
+    culprit: "Optional[Dict[str, Any]]" = None
+    for row in worst:
+        score = float(row.get("straggler_score") or 0.0)
+        stale = bool(row.get("stale"))
+        if stale or score >= TIMELINE_STRAGGLER_SCORE:
+            reason = (
+                f"lighthouse timeline: heartbeat stale at step "
+                f"{row.get('step')} (lag {row.get('step_lag')})"
+                if stale
+                else (
+                    f"lighthouse timeline: straggler score {score:.1f} "
+                    f"(>= {TIMELINE_STRAGGLER_SCORE:.0f}x typical progress "
+                    f"age) at step {row.get('step')}, "
+                    f"lag {row.get('step_lag')}"
+                )
+            )
+            culprit = {
+                "replica_id": str(row.get("replica_id", "?")),
+                "reason": reason,
+                "signal": "timeline_straggler",
+            }
+            break  # worst-first order: the first hit is the worst
+    steps = timeline.get("steps") or []
+    return {
+        "culprit": culprit,
+        "steps": len(steps),
+        "stragglers_worst": worst,
+        "last_step": steps[-1].get("step") if steps else None,
+    }
+
+
 # ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
@@ -489,6 +573,53 @@ def render_text(
             f" {marker} {_fmt_t(e['t_ns'], t0)} {e['replica_id'][:28]:28s} "
             f"{e['op']:24s} {e['status']:8s} {ctx}"
         )
+    return "\n".join(out)
+
+
+def render_timeline_text(
+    timeline: "Dict[str, Any]", max_rows: int = 30
+) -> str:
+    """The cluster step-timeline as a text section: one row per step
+    bucket (replicas seen, wall span, codec/wire busy, slowest phase)
+    plus the worst-straggler snapshot."""
+    out: "List[str]" = []
+    steps = timeline.get("steps") or []
+    out.append(
+        f"cluster timeline ({min(len(steps), max_rows)} of {len(steps)} "
+        f"step buckets, ring {timeline.get('ring')}):"
+    )
+    for b in steps[-max_rows:]:
+        phases = b.get("phases") or {}
+        slowest = max(
+            phases.items(), key=lambda kv: kv[1].get("mean_ms", 0.0), default=None
+        )
+        slow_txt = (
+            f" slowest {slowest[0]} {slowest[1].get('mean_ms', 0.0):.1f}ms "
+            f"(max {slowest[1].get('max_ms', 0.0):.1f})"
+            if slowest
+            else ""
+        )
+        busy = ""
+        if b.get("codec_busy_s") or b.get("wire_busy_s"):
+            busy = (
+                f" codec {b.get('codec_busy_s', 0.0):.2f}s"
+                f" wire {b.get('wire_busy_s', 0.0):.2f}s"
+            )
+        out.append(
+            f"  step {b.get('step'):<6} replicas={b.get('replicas'):<4} "
+            f"span={b.get('span_ms', 0)}ms{busy}{slow_txt}"
+        )
+    worst = timeline.get("stragglers_worst") or []
+    if worst:
+        out.append("worst stragglers (lighthouse snapshot):")
+        for row in worst:
+            out.append(
+                f"  {str(row.get('replica_id', '?')):32s} "
+                f"score={float(row.get('straggler_score') or 0.0):6.1f} "
+                f"lag={row.get('step_lag')} "
+                f"{'STALE' if row.get('stale') else 'fresh'} "
+                f"op={row.get('inflight_op') or '-'}"
+            )
     return "\n".join(out)
 
 
@@ -608,6 +739,11 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         help="TORCHFT_EVENTS_FILE JSONL log(s) to merge (repeatable)",
     )
     parser.add_argument(
+        "--timeline", default=None, metavar="FILE_OR_URL",
+        help="lighthouse /timeline.json (file, URL, or host:port) to fold "
+        "into the report — names a straggler culprit even without dumps",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="machine-readable JSON report"
     )
     parser.add_argument(
@@ -622,18 +758,34 @@ def main(argv: "Optional[List[str]]" = None) -> int:
 
     if args.selftest:
         return 0 if selftest() else 1
-    if not args.dumps and not args.events:
+    if not args.dumps and not args.events and not args.timeline:
         parser.print_usage(sys.stderr)
         print("torchft-diagnose: no input files", file=sys.stderr)
         return 2
 
+    cluster_timeline: "Optional[Dict[str, Any]]" = None
+    timeline_report: "Optional[Dict[str, Any]]" = None
+    if args.timeline:
+        try:
+            cluster_timeline = load_timeline(args.timeline)
+            timeline_report = analyze_timeline(cluster_timeline)
+        except Exception as e:  # noqa: BLE001 - report, don't die mid-postmortem
+            print(f"warning: --timeline {args.timeline}: {e}", file=sys.stderr)
+
     entries, warnings = load_records(list(args.dumps), list(args.events))
-    if not entries:
+    if not entries and timeline_report is None:
         for w in warnings:
             print(f"warning: {w}", file=sys.stderr)
         print("torchft-diagnose: no parseable records", file=sys.stderr)
         return 1
     report = analyze(entries)
+    # The flight-record signals see INSIDE a replica and win when present;
+    # the lighthouse timeline sees the fleet from outside and fills the
+    # gap when no dump implicates anyone (or none were collected).
+    if report["culprit"] is None and timeline_report is not None:
+        report["culprit"] = timeline_report["culprit"]
+    if timeline_report is not None:
+        report["cluster_timeline"] = timeline_report
     if args.json:
         payload = dict(report)
         payload["warnings"] = warnings
@@ -641,6 +793,8 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         print(json.dumps(payload, indent=2, default=str))
     else:
         print(render_text(entries, report, warnings, max_rows=args.max_rows))
+        if cluster_timeline is not None:
+            print(render_timeline_text(cluster_timeline))
     return 0
 
 

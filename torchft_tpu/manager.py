@@ -322,6 +322,14 @@ class Manager:
         self._m_participants = metrics.PARTICIPANTS.labels(
             replica_id=self._metric_replica_id
         )
+        # Cluster step-timeline digest state (guarded by _phase_lock):
+        # phase_times() snapshot at the last digest, plus codec/wire busy
+        # seconds accumulated from quantized collectives since then.  The
+        # per-step deltas ride the native manager's lighthouse heartbeat
+        # (report_summary) into /timeline.json.
+        self._summary_phase_snapshot: Dict[str, float] = {}
+        self._summary_codec_s = 0.0
+        self._summary_wire_s = 0.0
 
     @staticmethod
     def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
@@ -722,6 +730,14 @@ class Manager:
 
             def _done(f: "concurrent.futures.Future[Any]") -> None:
                 self._record_phase("ring", time.perf_counter() - t_submit)
+                # quantized-pipeline accounting for the step digest: the
+                # stats dict is complete once the pipeline finished, i.e.
+                # before this callback fires
+                qs = getattr(work, "quant_stats", None)
+                if isinstance(qs, dict):
+                    with self._phase_lock:
+                        self._summary_codec_s += float(qs.get("codec_s") or 0.0)
+                        self._summary_wire_s += float(qs.get("wire_s") or 0.0)
                 exc = f.exception()
                 if exc is not None:
                     self.report_error(
@@ -872,8 +888,10 @@ class Manager:
         self._m_step.set(self._step)
         # step (possibly) advanced: refresh the heartbeat-piggybacked
         # progress so lighthouse step-lag tracking follows commits, not
-        # just quorum entries
+        # just quorum entries — and ship the step digest (phase deltas +
+        # codec/wire busy) for the cluster timeline
         self._report_progress("")
+        self._report_step_summary()
 
         # Close the quorum round's root span (children were emitted per
         # phase from _record_phase); trace joins to the structured events
@@ -1008,6 +1026,36 @@ class Manager:
             server.report_progress(self._step, inflight_op)
         except Exception:  # noqa: BLE001 - telemetry must not fail the step
             logger.debug("progress report failed", exc_info=True)
+
+    def _report_step_summary(self) -> None:
+        """Ship the per-step digest (phase-time deltas since the last
+        digest, codec/wire busy seconds from quantized collectives) to the
+        native ManagerServer; its next lighthouse heartbeat carries it
+        once into the rolling cluster timeline (``/timeline.json``).
+        Best-effort like :meth:`_report_progress`."""
+        server = self._manager_server
+        if server is None:
+            return
+        with self._phase_lock:
+            phases = {
+                k: round((v - self._summary_phase_snapshot.get(k, 0.0)) * 1e3, 3)
+                for k, v in self._phase_acc.items()
+                if v - self._summary_phase_snapshot.get(k, 0.0) > 0.0
+            }
+            self._summary_phase_snapshot = dict(self._phase_acc)
+            codec_s, self._summary_codec_s = self._summary_codec_s, 0.0
+            wire_s, self._summary_wire_s = self._summary_wire_s, 0.0
+        try:
+            server.report_summary(
+                {
+                    "step": self._step,
+                    "phase_ms": phases,
+                    "codec_busy_s": round(codec_s, 6),
+                    "wire_busy_s": round(wire_s, 6),
+                }
+            )
+        except Exception:  # noqa: BLE001 - telemetry must not fail the step
+            logger.debug("step summary report failed", exc_info=True)
 
     def current_step(self) -> int:
         return self._step

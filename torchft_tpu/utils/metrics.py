@@ -563,6 +563,53 @@ def parse_text_exposition(text: str) -> "Dict[str, Dict[str, Any]]":
     return families
 
 
+def quantile_from_histogram(
+    families: "Dict[str, Dict[str, Any]]",
+    name: str,
+    q: float,
+    labels: "Sequence[Tuple[str, str]]" = (),
+) -> float:
+    """Estimate the ``q`` quantile (0..1) of a parsed histogram family.
+
+    Standard Prometheus upper-bound estimation: find the first bucket
+    whose cumulative count reaches ``q * count`` and return its ``le``
+    bound (conservative — the true value is at or below it; ``+Inf``
+    degrades to the largest finite bound).  ``labels`` narrows to one
+    child's series, exactly as rendered.  Raises ``KeyError`` for a
+    missing family and ``ValueError`` for an empty histogram — a p99
+    assertion against a histogram nobody observed must fail loudly, not
+    return 0.
+    """
+    fam = families[name]
+    want = tuple(sorted(labels))
+    buckets: "List[Tuple[float, float]]" = []  # (le, cumulative count)
+    total = 0.0
+    for (sample, sample_labels), value in fam["samples"].items():
+        rest = tuple(
+            sorted((k, v) for k, v in sample_labels if k != "le")
+        )
+        if rest != want:
+            continue
+        if sample == f"{name}_bucket":
+            le = dict(sample_labels).get("le", "")
+            buckets.append(
+                (float("inf") if le == "+Inf" else float(le), value)
+            )
+        elif sample == f"{name}_count":
+            total = value
+    if total <= 0 or not buckets:
+        raise ValueError(f"histogram {name}{dict(want)} has no observations")
+    buckets.sort()
+    rank = q * total
+    largest_finite = max(
+        (le for le, _ in buckets if le != float("inf")), default=float("inf")
+    )
+    for le, cum in buckets:
+        if cum >= rank:
+            return largest_finite if le == float("inf") else le
+    return largest_finite
+
+
 # ---------------------------------------------------------------------------
 # per-process HTTP scrape server (the per-manager surface)
 # ---------------------------------------------------------------------------
