@@ -1,0 +1,33 @@
+# Developer entry points.  The native core builds via native/Makefile
+# (wheels trigger it from setup.py); this file wires the repo-level
+# verification gates CI and humans share.
+
+PYTHON ?= python
+
+.PHONY: native verify lint typecheck test tier1
+
+native:
+	$(MAKE) -C native
+
+# The correctness gate: project-invariant lint (tft-lint), the protocol
+# model checker's self-consistency (mutation gate + clean steady space +
+# wire extractor selftest), then the full bounded exploration + liveness
+# + wire-schema drift pass.  Exit code != 0 on any finding/violation.
+verify:
+	$(PYTHON) -m torchft_tpu.analysis torchft_tpu/
+	$(PYTHON) -m torchft_tpu.analysis.verify_cli --selftest
+	$(PYTHON) -m torchft_tpu.analysis.verify_cli
+
+lint:
+	$(PYTHON) -m torchft_tpu.analysis torchft_tpu/
+
+# mypy strict over the analysis + utils layers (mirrors the slow-marked
+# tests/test_typecheck.py gate); requires mypy on PATH.
+typecheck:
+	$(PYTHON) -m mypy --config-file mypy.ini torchft_tpu/analysis torchft_tpu/utils
+
+# tier-1: the default CI selection (ROADMAP.md).
+tier1:
+	$(PYTHON) -m pytest tests/ -m "not slow" -q
+
+test: tier1

@@ -338,9 +338,31 @@ void ManagerServer::run_quorum(QuorumMember member, int64_t timeout_ms) {
 
 Json ManagerServer::rpc_should_commit(const Json& params, int64_t timeout_ms) {
   int64_t group_rank = params.get("group_rank").as_int();
+  int64_t step = params.get("step").as_int(-1);
   bool vote = params.get("should_commit").as_bool();
 
   std::unique_lock<std::mutex> lk(mu_);
+  // Step-tag the barrier round so a stale vote (a delivered-then-resent
+  // copy from a broken connection, or a tally left behind by a round that
+  // timed out) can never satisfy a later round — the server-side half of
+  // the vote-integrity invariant the tft-verify vote sub-model checks
+  // (analysis/protocol_model.py).  Ranks advance their step ONLY through
+  // a completed barrier, so a vote for a NEWER step proves the open tally
+  // belongs to an abandoned round: discard it and start fresh (this also
+  // un-wedges a tally orphaned by a crash + re-quorum).  A vote for an
+  // OLDER step is the stale copy itself: reject it.
+  if (commit_votes_.empty()) {
+    commit_step_ = step;
+  } else if (step > commit_step_) {
+    commit_votes_.clear();
+    commit_failures_.clear();
+    commit_step_ = step;
+  } else if (step < commit_step_) {
+    throw std::runtime_error(
+        "should_commit vote for step " + std::to_string(step) +
+        " in a barrier round voting on step " + std::to_string(commit_step_) +
+        " (stale or double-delivered vote)");
+  }
   int64_t round = commit_round_seq_;
   if (!vote) commit_failures_.insert(group_rank);
   commit_votes_.insert(group_rank);
@@ -357,8 +379,17 @@ Json ManagerServer::rpc_should_commit(const Json& params, int64_t timeout_ms) {
                   std::chrono::milliseconds(timeout_ms);
   while (commit_round_seq_ == round) {
     if (stopping_.load()) throw std::runtime_error("manager shutting down");
-    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+      if (commit_round_seq_ != round) break;  // completed at the deadline
+      // The round is still open: withdraw this rank's vote.  A failed
+      // commit retries the SAME step, so a tally left behind here would
+      // merge with the retry round's fresh votes (and an orphaned no
+      // vote would poison its decision) — the step tag above only
+      // guards rounds at a DIFFERENT step.
+      commit_votes_.erase(group_rank);
+      commit_failures_.erase(group_rank);
       throw TimeoutError("timeout waiting for should_commit barrier");
+    }
   }
   Json out = Json::object();
   out["should_commit"] = commit_decision_;

@@ -112,6 +112,7 @@ class ManagerServer : public RpcServer {
   std::set<int64_t> commit_votes_;
   std::set<int64_t> commit_failures_;
   int64_t commit_round_seq_ = 0;
+  int64_t commit_step_ = -1;  // step the open barrier round is voting on
   bool commit_decision_ = false;
 
   // progress state piggybacked on heartbeats (guarded by mu_)

@@ -138,7 +138,7 @@ bool send_frame(int fd, const std::string& payload, int64_t deadline_ms,
 }
 
 bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
-                std::string* err) {
+                std::string* err, int64_t body_timeout_ms) {
   char hdr[4];
   if (!read_exact(fd, hdr, 4, deadline_ms, err)) return false;
   uint32_t len;
@@ -150,7 +150,10 @@ bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
   }
   payload->resize(len);
   if (len == 0) return true;
-  return read_exact(fd, payload->data(), len, deadline_ms, err);
+  int64_t body_deadline = deadline_ms;
+  if (body_timeout_ms > 0)
+    body_deadline = std::min(deadline_ms, now_ms() + body_timeout_ms);
+  return read_exact(fd, payload->data(), len, body_deadline, err);
 }
 
 int connect_once(const std::string& addr, int64_t timeout_ms,
@@ -442,8 +445,12 @@ void RpcServer::serve_conn(int fd) {
   while (!stopping_.load()) {
     std::string payload;
     std::string err;
-    // Idle connections are fine: wait in 1-day slices for the next request.
-    if (!recv_frame(fd, &payload, now_ms() + 86400000, &err)) break;
+    // Idle connections are fine: wait in 1-day slices for the next request
+    // header — but once a header arrives, the body must land within
+    // kFrameBodyTimeoutMs so a mid-frame stall cannot pin this thread.
+    if (!recv_frame(fd, &payload, now_ms() + 86400000, &err,
+                    kFrameBodyTimeoutMs))
+      break;
     Json reply = Json::object();
     try {
       Json req = Json::parse(payload);

@@ -102,11 +102,19 @@ class CondVar {
 
 constexpr uint32_t kMaxFrameBytes = 512u * 1024u * 1024u;
 
+// Once a frame's length header has arrived, the body must follow promptly:
+// a peer that stalls mid-frame (half-sent request, wedged sender) would
+// otherwise hold a server connection thread until the full idle deadline.
+constexpr int64_t kFrameBodyTimeoutMs = 30'000;
+
 // All return false on error/timeout (errno-style detail in *err if non-null).
+// recv_frame: ``deadline_ms`` bounds the wait for the 4-byte header (idle
+// connections may park here); the body additionally gets at most
+// ``body_timeout_ms`` from header arrival (0 = header deadline only).
 bool send_frame(int fd, const std::string& payload, int64_t deadline_ms,
                 std::string* err = nullptr);
 bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
-                std::string* err = nullptr);
+                std::string* err = nullptr, int64_t body_timeout_ms = 0);
 // Peek up to n bytes without consuming (used to sniff HTTP vs framed proto).
 bool peek_bytes(int fd, char* buf, size_t n, int64_t deadline_ms);
 bool read_exact(int fd, char* buf, size_t n, int64_t deadline_ms,

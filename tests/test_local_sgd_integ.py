@@ -4,7 +4,6 @@ Mirrors reference torchft/local_sgd_integ_test.py: LocalSGD recovery,
 DiLoCo recovery, and a third replica joining mid-run (upscale).
 """
 
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List
@@ -13,7 +12,7 @@ import numpy as np
 import optax
 import pytest
 
-from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer
 from torchft_tpu.local_sgd import DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.process_group import ProcessGroupTCP
@@ -219,30 +218,45 @@ class TestDiLoCoInteg:
         assert_params_equal(results)
 
     def test_diloco_upscale_mid_run(self, lighthouse):
-        # third replica joins after the first two have synced a few times;
-        # inner steps are paced so the join lands mid-run.
+        # Third replica joins after the first two have synced a couple of
+        # times.  The join is gated on OBSERVED fleet progress (lighthouse
+        # ``max_step``), not a wall-clock delay: a fixed sleep assumes the
+        # first two replicas are mid-run when it expires, which a loaded
+        # host breaks in both directions (the load-flake CHANGES PR 3
+        # recorded).  inner_sleep paces every remaining step at >= 0.2 s,
+        # so triggering at max_step >= 2 leaves ~1.6 s of join headroom
+        # regardless of how slowly this test got scheduled.
         runners = [
             DiLoCoRunner(
                 i, lighthouse.address(), outer_syncs=5, inner_sleep=0.05
             )
             for i in range(3)
         ]
-        results = {}
+        status = LighthouseClient(lighthouse.address())
+        join_seen = {}
 
-        def run_delayed(idx, delay):
-            if delay:
-                time.sleep(delay)
-            results[idx] = runners[idx].run()
+        def run_third():
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                doc = status.status(timeout=5.0)
+                if doc.get("max_step", 0) >= 2:
+                    break
+                time.sleep(0.02)
+            join_seen["max_step"] = doc.get("max_step", 0)
+            return runners[2].run()
 
-        threads = [
-            threading.Thread(target=run_delayed, args=(0, 0)),
-            threading.Thread(target=run_delayed, args=(1, 0)),
-            threading.Thread(target=run_delayed, args=(2, 0.5)),
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=180)
-        ordered = [results[i] for i in range(3)]
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            futures = [ex.submit(runners[0].run), ex.submit(runners[1].run)]
+            futures.append(ex.submit(run_third))
+            # one shared deadline: sequential per-future waits would stack
+            # to 3x on a wedge and hold CI for ~11 minutes before failing
+            deadline = time.monotonic() + 180.0
+            ordered = [
+                f.result(timeout=max(0.0, deadline - time.monotonic()))
+                for f in futures
+            ]
+        status.close()
+        # the join landed mid-run: progress had started but not finished
+        assert 2 <= join_seen["max_step"] < 10, join_seen
         assert all(r["manager_state"]["step"] == 10 for r in ordered)
         assert_params_equal(ordered)

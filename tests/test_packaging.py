@@ -48,6 +48,30 @@ class TestPackaging:
         # the baseline data files ship in the wheel
         assert "analysis/baselines/*.txt" in text
 
+    def test_tft_verify_console_entry_callable(self):
+        # tft-verify (model checker + wire-schema lock) ships alongside
+        text = open(os.path.join(REPO, "pyproject.toml")).read()
+        assert 'tft-verify = "torchft_tpu.analysis.verify_cli:main"' in text
+        from torchft_tpu.analysis.verify_cli import main
+
+        with pytest.raises(SystemExit) as e:
+            main(["--help"])
+        assert e.value.code == 0
+
+    def test_protocol_lock_ships_as_package_data(self):
+        # the committed wire-schema lock must ride the wheel: it is the
+        # machine-readable wire contract installed consumers read via
+        # wire_schema.default_lock_path()/load_lock() (the full --drift
+        # cross-check needs the native sources, i.e. a repo checkout)
+        text = open(os.path.join(REPO, "pyproject.toml")).read()
+        assert "analysis/protocol.lock" in text
+        lock = os.path.join(REPO, "torchft_tpu", "analysis", "protocol.lock")
+        assert os.path.isfile(lock)
+        import json
+
+        doc = json.load(open(lock, encoding="utf-8"))
+        assert doc["version"] >= 1 and "servers" in doc and "structs" in doc
+
     def test_native_lib_search_order(self, monkeypatch):
         from torchft_tpu import _native
 

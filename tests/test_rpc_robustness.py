@@ -1,0 +1,479 @@
+"""Framed-JSON RPC robustness: fuzz/negative frames on both sides of the
+wire, and the idempotent/non-idempotent resend contract.
+
+Three suites:
+
+* client vs hostile server — ``_RpcClient._recv_frame``/``_recv_exact``
+  against truncated frames, oversized length prefixes, non-UTF8 and
+  non-object reply payloads: every case must surface a clean
+  ``ConnectionError``/``TimeoutError``/``RpcError``, never hang or leak
+  a desynchronized connection into the next call;
+* native server vs hostile client — the same malformed frames thrown at
+  a real ``LighthouseServer``: the server must drop or error the bad
+  connection and keep serving well-formed requests;
+* resend contract (PR 2's ``idempotent=`` flag) — with a connection that
+  dies after delivery but before the reply, idempotent methods are
+  re-sent exactly once and non-idempotent ``should_commit`` is NOT
+  (the delivery count proves it), plus the native barrier's stale-step
+  vote rejection (the server-side half of the same invariant).
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    RpcError,
+    StoreServer,
+    _MAX_FRAME_BYTES,
+    _RpcClient,
+)
+from torchft_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
+
+
+def _frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def _read_frame(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (length,) = struct.unpack(">I", hdr)
+    buf = b""
+    while len(buf) < length:
+        chunk = sock.recv(length - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+class _FakeServer:
+    """One-thread scripted peer: each accepted connection pops the next
+    handler.  Handlers receive the connected socket and run to completion;
+    ``deliveries`` counts full request frames parsed."""
+
+    def __init__(self, handlers):
+        self.handlers = list(handlers)
+        self.deliveries = []
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while self.handlers:
+            handler = self.handlers.pop(0)
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                handler(self, conn)
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- scripted behaviors -------------------------------------------------
+
+    def recv_request(self, conn) -> dict:
+        req = json.loads(_read_frame(conn))
+        self.deliveries.append(req["method"])
+        return req
+
+    @staticmethod
+    def ok_reply(conn, result=None):
+        conn.sendall(_frame(json.dumps({"ok": True, "result": result or {}}).encode()))
+
+
+def _client(server: "_FakeServer") -> _RpcClient:
+    return _RpcClient(server.addr, connect_timeout=5.0)
+
+
+class TestClientAgainstHostileServer:
+    def test_truncated_reply_then_close(self):
+        def handler(srv, conn):
+            srv.recv_request(conn)
+            conn.sendall(struct.pack(">I", 100) + b"short")
+
+        srv = _FakeServer([handler])
+        c = _client(srv)
+        try:
+            with pytest.raises(ConnectionError):
+                c.call("m", {}, timeout=5.0, idempotent=False)
+        finally:
+            c.close()
+            srv.close()
+
+    def test_truncated_reply_stall_times_out(self):
+        def handler(srv, conn):
+            srv.recv_request(conn)
+            conn.sendall(struct.pack(">I", 100) + b"short")
+            time.sleep(3.0)  # stall mid-frame, connection open
+
+        srv = _FakeServer([handler])
+        c = _client(srv)
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(TimeoutError):
+                c.call("m", {}, timeout=0.5, idempotent=False)
+            assert time.monotonic() - t0 < 2.0  # deadline, not the stall
+        finally:
+            c.close()
+            srv.close()
+
+    def test_oversized_length_prefix_rejected(self):
+        """A reply header claiming > _MAX_FRAME_BYTES must fail cleanly
+        BEFORE the client tries to buffer gigabytes."""
+
+        def handler(srv, conn):
+            srv.recv_request(conn)
+            conn.sendall(struct.pack(">I", _MAX_FRAME_BYTES + 1))
+            time.sleep(1.0)
+
+        srv = _FakeServer([handler])
+        c = _client(srv)
+        try:
+            with pytest.raises(ConnectionError, match="ceiling"):
+                c.call("m", {}, timeout=5.0, idempotent=False)
+        finally:
+            c.close()
+            srv.close()
+
+    def test_non_utf8_reply_is_clean_rpc_error(self):
+        def handler(srv, conn):
+            srv.recv_request(conn)
+            conn.sendall(_frame(b"\xff\xfe{bad utf8"))
+
+        srv = _FakeServer([handler])
+        c = _client(srv)
+        try:
+            with pytest.raises(RpcError, match="malformed"):
+                c.call("m", {}, timeout=5.0, idempotent=False)
+        finally:
+            c.close()
+            srv.close()
+
+    def test_non_object_reply_is_clean_rpc_error(self):
+        def handler(srv, conn):
+            srv.recv_request(conn)
+            conn.sendall(_frame(b"[1, 2, 3]"))
+            srv.recv_request(conn)  # must NOT be reached on same conn
+            _FakeServer.ok_reply(conn)
+
+        srv = _FakeServer([handler, lambda srv, conn: (srv.recv_request(conn), _FakeServer.ok_reply(conn))])
+        c = _client(srv)
+        try:
+            with pytest.raises(RpcError, match="not a JSON object"):
+                c.call("m", {}, timeout=5.0, idempotent=False)
+            # the poisoned connection was dropped: the next call dials fresh
+            assert c.call("m2", {}, timeout=5.0) == {}
+        finally:
+            c.close()
+            srv.close()
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(min_replicas=1, join_timeout_ms=50)
+    yield server
+    server.shutdown()
+
+
+def _raw(addr: str) -> socket.socket:
+    host, port = addr.rsplit(":", 1)
+    s = socket.create_connection((host or "127.0.0.1", int(port)), timeout=5.0)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _rpc(sock: socket.socket, method: str, params: dict) -> dict:
+    sock.sendall(_frame(json.dumps(
+        {"method": method, "params": params, "timeout_ms": 5000}
+    ).encode()))
+    return json.loads(_read_frame(sock))
+
+
+def _assert_server_alive(addr: str):
+    s = _raw(addr)
+    try:
+        resp = _rpc(s, "heartbeat", {"replica_id": "fuzz_alive:x"})
+        assert resp["ok"] is True
+    finally:
+        s.close()
+
+
+class TestNativeServerAgainstHostileClient:
+    def test_oversized_length_prefix_drops_connection(self, lighthouse):
+        s = _raw(lighthouse.address())
+        try:
+            s.sendall(struct.pack(">I", 0xFFFFFFFF))
+            # server must close on us rather than wait for 4 GiB
+            s.settimeout(5.0)
+            assert s.recv(1) == b""
+        finally:
+            s.close()
+        _assert_server_alive(lighthouse.address())
+
+    def test_truncated_frame_then_close(self, lighthouse):
+        s = _raw(lighthouse.address())
+        s.sendall(struct.pack(">I", 100) + b"only ten b")
+        s.close()
+        _assert_server_alive(lighthouse.address())
+
+    def test_non_utf8_payload_errors_cleanly(self, lighthouse):
+        s = _raw(lighthouse.address())
+        try:
+            s.sendall(_frame(b"\xff\xfe\x00garbage"))
+            s.settimeout(5.0)
+            try:
+                resp = json.loads(_read_frame(s))
+                assert resp["ok"] is False
+            except ConnectionError:
+                pass  # dropping the connection is equally clean
+        finally:
+            s.close()
+        _assert_server_alive(lighthouse.address())
+
+    def test_non_object_payload_errors_cleanly(self, lighthouse):
+        s = _raw(lighthouse.address())
+        try:
+            s.sendall(_frame(b"[1, 2, 3]"))
+            resp = json.loads(_read_frame(s))
+            assert resp["ok"] is False
+            # the connection stays usable for a well-formed request
+            resp = _rpc(s, "heartbeat", {"replica_id": "fuzz_obj:x"})
+            assert resp["ok"] is True
+        finally:
+            s.close()
+
+    def test_empty_frame_errors_cleanly(self, lighthouse):
+        s = _raw(lighthouse.address())
+        try:
+            s.sendall(_frame(b""))
+            resp = json.loads(_read_frame(s))
+            assert resp["ok"] is False
+        finally:
+            s.close()
+        _assert_server_alive(lighthouse.address())
+
+    def test_unknown_method_errors_cleanly(self, lighthouse):
+        s = _raw(lighthouse.address())
+        try:
+            resp = _rpc(s, "no_such_method", {})
+            assert resp["ok"] is False and "error" in resp
+        finally:
+            s.close()
+
+    @pytest.mark.slow
+    def test_mid_frame_stall_is_reaped(self, lighthouse):
+        """A half-sent request whose sender stalls (connection open, body
+        never completes) must not pin a server connection thread past the
+        kFrameBodyTimeoutMs (30 s) body deadline — the server closes the
+        connection instead of waiting out the 24 h idle window."""
+        s = _raw(lighthouse.address())
+        try:
+            s.sendall(struct.pack(">I", 64) + b"stalled-half-frame")
+            s.settimeout(40.0)
+            t0 = time.monotonic()
+            assert s.recv(1) == b""  # server reaped us...
+            assert time.monotonic() - t0 < 35.0  # ...within the body window
+        finally:
+            s.close()
+        _assert_server_alive(lighthouse.address())
+
+
+class TestResendContract:
+    """PR 2's ``idempotent=`` flag, proven by delivery counting: the
+    connection dies after the server consumed the request but before the
+    reply — the exact window where a blind resend double-delivers."""
+
+    @staticmethod
+    def _die_after_delivery(srv, conn):
+        srv.recv_request(conn)  # request consumed...
+        conn.close()  # ...connection dies before any reply
+
+    @staticmethod
+    def _serve_one(srv, conn):
+        req = srv.recv_request(conn)
+        result = {"should_commit": True} if req["method"] == "should_commit" else {}
+        _FakeServer.ok_reply(conn, result)
+
+    def test_idempotent_method_is_resent_once(self):
+        srv = _FakeServer([self._die_after_delivery, self._serve_one])
+        c = _RpcClient(srv.addr, connect_timeout=5.0)
+        try:
+            assert c.call("heartbeat", {"replica_id": "r"}, timeout=10.0) == {}
+            assert srv.deliveries == ["heartbeat", "heartbeat"]
+        finally:
+            c.close()
+            srv.close()
+
+    def test_should_commit_is_never_resent(self):
+        srv = _FakeServer([self._die_after_delivery, self._serve_one])
+        mc = ManagerClient(srv.addr, connect_timeout=5.0)
+        try:
+            with pytest.raises(ConnectionError):
+                mc.should_commit(0, step=3, should_commit=True, timeout=10.0)
+            # exactly one delivery: the vote must not reach the barrier twice
+            assert srv.deliveries == ["should_commit"]
+        finally:
+            mc.close()
+            srv.close()
+
+    def test_faults_layer_drop_retries_idempotent_call(self, lighthouse):
+        """The chaos-layer form of the same contract: an injected
+        connection drop on the pooled lighthouse connection is absorbed
+        by the idempotent resend path against the REAL server."""
+        faults.FAULTS.configure(
+            [faults.FaultRule(site="lighthouse.rpc", action="drop", times=1)]
+        )
+        c = LighthouseClient(lighthouse.address())
+        try:
+            resp = c.heartbeat("drop_test:x", timeout=10.0)
+            assert isinstance(resp, dict)
+            assert faults.FAULTS.injected() == 1
+        finally:
+            c.close()
+
+
+class TestBarrierStepValidation:
+    """The native should_commit barrier's stale-vote rejection — the
+    server-side half of the vote-integrity invariant the tft-verify vote
+    sub-model checks (a delivered-then-resent vote carries the OLD step
+    and must not satisfy a later round's tally)."""
+
+    @pytest.fixture
+    def stack(self):
+        lh = LighthouseServer(min_replicas=1, join_timeout_ms=50)
+        store = StoreServer()
+        mgr = ManagerServer(
+            replica_id="barrier_0:a",
+            lighthouse_addr=lh.address(),
+            store_address=store.address(),
+            world_size=2,
+        )
+        yield mgr
+        mgr.shutdown()
+        store.shutdown()
+        lh.shutdown()
+
+    def test_stale_step_vote_is_rejected(self, stack):
+        c0 = ManagerClient(stack.address())
+        c1 = ManagerClient(stack.address())
+        results = {}
+
+        def rank0():
+            results["r0"] = c0.should_commit(0, step=5, should_commit=True,
+                                             timeout=20.0)
+
+        t = threading.Thread(target=rank0)
+        t.start()
+        time.sleep(0.2)  # let rank 0 open the round at step 5
+        try:
+            with pytest.raises(RpcError, match="stale or double-delivered"):
+                c1.should_commit(1, step=4, should_commit=True, timeout=5.0)
+            # a correct-step vote still completes the barrier
+            assert c1.should_commit(1, step=5, should_commit=True,
+                                    timeout=20.0) is True
+            t.join(timeout=20.0)
+            assert results.get("r0") is True
+        finally:
+            c0.close()
+            c1.close()
+
+    def test_timed_out_vote_is_withdrawn(self, stack):
+        """A failed commit retries the SAME step, so a vote whose barrier
+        wait timed out must be withdrawn from the open tally: left behind,
+        it would complete the retry round with only one fresh vote — and
+        an orphaned NO vote would force the retry's decision to False even
+        when every fresh vote is yes."""
+        c0 = ManagerClient(stack.address())
+        c1 = ManagerClient(stack.address())
+        try:
+            # rank 0 votes NO at step 3 and times out waiting for rank 1.
+            # The server's barrier deadline coincides with the client's
+            # socket deadline, so either the server's TimeoutError reply
+            # (RpcError) or the client's own socket timeout can win.
+            with pytest.raises((RpcError, TimeoutError), match="time"):
+                c0.should_commit(0, step=3, should_commit=False, timeout=0.3)
+            # let the server-side handler reach its own deadline and
+            # withdraw the vote before the retry round opens
+            time.sleep(2.0)
+            # the retry round at the SAME step: both ranks vote yes; a
+            # surviving orphan tally would decide False (poisoned) or
+            # strand one voter on a ghost round
+            out = {}
+
+            def vote(c, rank):
+                out[rank] = c.should_commit(rank, step=3, should_commit=True,
+                                            timeout=20.0)
+
+            threads = [
+                threading.Thread(target=vote, args=(c, r))
+                for r, c in enumerate((c0, c1))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20.0)
+            assert out == {0: True, 1: True}
+        finally:
+            c0.close()
+            c1.close()
+
+    def test_next_round_accepts_new_step(self, stack):
+        c0 = ManagerClient(stack.address())
+        c1 = ManagerClient(stack.address())
+
+        def vote(c, rank, step, out):
+            out[rank] = c.should_commit(rank, step=step, should_commit=True,
+                                        timeout=20.0)
+
+        try:
+            for step in (0, 1):
+                out = {}
+                threads = [
+                    threading.Thread(target=vote, args=(c, r, step, out))
+                    for r, c in enumerate((c0, c1))
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=20.0)
+                assert out == {0: True, 1: True}
+        finally:
+            c0.close()
+            c1.close()

@@ -1,0 +1,70 @@
+"""mypy strict gate over the layers that judge the tree.
+
+The analysis code (tft-lint passes, the tft-verify model checker and
+wire-schema extractor) and the utils layer it leans on must themselves
+pass a type checker — a lint suite with type holes is a lint suite you
+cannot trust.  Slow-marked: mypy is a dev/CI dependency, not a runtime
+one, so the gate skips (loudly) where it is not installed instead of
+failing the minimal image.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def _mypy_available() -> bool:
+    if shutil.which("mypy"):
+        return True
+    try:
+        import mypy  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(
+    not _mypy_available(), reason="mypy not installed in this environment"
+)
+class TestStrictTyping:
+    def test_analysis_and_utils_pass_strict_mypy(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "mypy",
+                "--config-file",
+                os.path.join(REPO, "mypy.ini"),
+                os.path.join(REPO, "torchft_tpu", "analysis"),
+                os.path.join(REPO, "torchft_tpu", "utils"),
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, (
+            f"mypy strict gate failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+
+class TestConfigCommitted:
+    def test_mypy_config_exists_and_targets_the_judging_layers(self):
+        """The config is part of the contract even where mypy itself is
+        absent: it must stay committed and keep `strict` on."""
+        path = os.path.join(REPO, "mypy.ini")
+        assert os.path.isfile(path)
+        text = open(path, encoding="utf-8").read()
+        assert "strict = True" in text
+
+    def test_makefile_typecheck_target_wired(self):
+        text = open(os.path.join(REPO, "Makefile"), encoding="utf-8").read()
+        assert "typecheck:" in text and "mypy" in text

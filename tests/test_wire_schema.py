@@ -1,0 +1,309 @@
+"""Wire-schema drift gate (tft-verify leg 2) + conformance tests
+generated from the committed protocol.lock.
+
+The drift gate mirrors tests/test_lint.py: the REAL tree yields zero
+findings, and a seeded drift on each surface — a Python field rename, a
+native field rename, a docs-table omission, a stale lock — is caught.
+The conformance tests don't restate the schema by hand: they are
+parametrized FROM protocol.lock, so the lock file is executable, not
+decorative.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+
+import pytest
+
+from torchft_tpu import coordination
+from torchft_tpu.analysis import wire_schema as ws
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    Quorum,
+    QuorumMember,
+    QuorumResult,
+    StoreClient,
+    StoreServer,
+    compute_quorum_results,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOCK = ws.load_lock(ws.default_lock_path())
+assert LOCK is not None, "torchft_tpu/analysis/protocol.lock must be committed"
+
+_STRUCT_CLASSES = {
+    "QuorumMember": QuorumMember,
+    "Quorum": Quorum,
+    "QuorumResult": QuorumResult,
+}
+
+#: sentinel value per canonical wire type (array stays empty: element
+#: schemas are struct-typed and covered by their own cases)
+_SENTINELS = {
+    "string": "sentinel",
+    "int": 7,
+    "bool": True,
+    "double": 1.5,
+    "object": {},
+    "array": [],
+    "any": "opaque",
+}
+
+
+def _tree_inputs():
+    return ws.gather_inputs(REPO)
+
+
+def _findings(py_source, native_sources, docs_text, lock, **kw):
+    return list(
+        ws.run_checks(py_source, native_sources, docs_text, lock, **kw)
+    )
+
+
+class TestDriftGateClean:
+    def test_tree_has_zero_findings(self):
+        py, native, nfiles, docs, lock, lockfile = _tree_inputs()
+        found = _findings(
+            py, native, docs, lock, native_file_of=nfiles, lock_file=lockfile
+        )
+        assert found == [], "\n".join(f.render() for f in found)
+
+    def test_committed_lock_matches_fresh_build(self):
+        py, native, _nf, _docs, lock, _lf = _tree_inputs()
+        assert ws.build_lock(py, native) == lock
+
+    def test_lock_dump_is_stable(self):
+        """Regenerating an unchanged tree must be byte-stable (sorted
+        keys, trailing newline) so the lock never churns in diffs."""
+        py, native, _nf, _docs, _lock, _lf = _tree_inputs()
+        text = open(ws.default_lock_path(), encoding="utf-8").read()
+        assert ws.dump_lock(ws.build_lock(py, native)) == text
+
+    def test_lock_covers_the_expected_surface(self):
+        servers = LOCK["servers"]
+        assert set(servers) == {"lighthouse", "manager", "store"}
+        assert set(servers["lighthouse"]) == {
+            "quorum", "heartbeat", "status", "timeline",
+        }
+        assert set(servers["manager"]) == {
+            "quorum", "should_commit", "checkpoint_metadata", "kill",
+        }
+        assert set(servers["store"]) == {
+            "set", "get", "delete_prefix", "num_keys",
+        }
+        assert set(LOCK["structs"]) == set(_STRUCT_CLASSES)
+
+
+class TestSeededDrift:
+    """The gate bites: one seeded drift per surface, against the REAL
+    tree sources (not a toy project)."""
+
+    def _codes(self, py=None, native=None, docs=None, lock="keep"):
+        tpy, tnative, nfiles, tdocs, tlock, lockfile = _tree_inputs()
+        return {
+            f.code
+            for f in _findings(
+                py if py is not None else tpy,
+                native if native is not None else tnative,
+                docs if docs is not None else tdocs,
+                tlock if lock == "keep" else lock,
+                native_file_of=nfiles,
+                lock_file=lockfile,
+            )
+        }
+
+    def test_python_field_rename_is_caught(self):
+        py, *_ = _tree_inputs()
+        drifted = py.replace('"store_address": self.store_address', '"store_addr": self.store_address')
+        assert "store_addr" in drifted
+        codes = self._codes(py=drifted)
+        assert "struct-field-missing" in codes or "lock-drift" in codes
+
+    def test_python_param_rename_is_caught(self):
+        py, *_ = _tree_inputs()
+        drifted = py.replace('params["inflight_op"] = inflight_op', 'params["inflight"] = inflight_op')
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert "param-dead" in codes
+
+    def test_native_field_rename_is_caught(self):
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'j["world_size"] = world_size;', 'j["worldsize"] = world_size;'
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert "struct-field-missing" in codes
+
+    def test_native_param_rename_is_caught(self):
+        _py, native, *_ = _tree_inputs()
+        mg = native["manager.cc"]
+        drifted = dict(native)
+        drifted["manager.cc"] = mg.replace(
+            'params.get("group_rank")', 'params.get("grp_rank")'
+        )
+        assert drifted["manager.cc"] != mg
+        codes = self._codes(native=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_doc_omission_is_caught(self):
+        _py, _native, _nf, docs, *_ = _tree_inputs()
+        drifted = docs.replace("| lighthouse | `timeline` |", "| lighthouse |`timeline-x` |")
+        assert drifted != docs
+        codes = self._codes(docs=drifted)
+        assert "method-undocumented" in codes
+
+    def test_stale_lock_is_caught(self):
+        stale = json.loads(json.dumps(LOCK))
+        stale["structs"]["QuorumMember"]["vintage"] = "string"
+        codes = self._codes(lock=stale)
+        assert "lock-drift" in codes
+
+    def test_missing_lock_is_caught(self):
+        codes = self._codes(lock=None)
+        assert "lock-missing" in codes
+
+
+# ---------------------------------------------------------------------------
+# conformance tests GENERATED from the lock
+# ---------------------------------------------------------------------------
+
+
+class TestStructConformance:
+    @pytest.mark.parametrize("struct", sorted(LOCK["structs"]), ids=str)
+    def test_dataclass_fields_match_lock(self, struct):
+        cls = _STRUCT_CLASSES[struct]
+        declared = {f.name for f in dataclasses.fields(cls)}
+        assert declared == set(LOCK["structs"][struct])
+
+    @pytest.mark.parametrize("struct", sorted(LOCK["structs"]), ids=str)
+    def test_from_dict_round_trips_locked_payload(self, struct):
+        """A wire payload carrying exactly the locked fields parses with
+        no field falling back to its wire default."""
+        cls = _STRUCT_CLASSES[struct]
+        payload = {
+            k: _SENTINELS[t] for k, t in LOCK["structs"][struct].items()
+        }
+        obj = cls.from_dict(payload)
+        for k, t in LOCK["structs"][struct].items():
+            if t == "array":
+                continue  # element parsing covered by the member structs
+            assert getattr(obj, k) == payload[k], (
+                f"{struct}.{k} did not survive from_dict (wire default "
+                f"swallowed the payload value — field-name drift)"
+            )
+
+    @pytest.mark.parametrize("struct", sorted(LOCK["structs"]), ids=str)
+    def test_from_dict_is_total_on_empty_payload(self, struct):
+        _STRUCT_CLASSES[struct].from_dict({})
+
+    def test_quorum_member_to_dict_round_trip(self):
+        payload = {
+            k: _SENTINELS[t]
+            for k, t in LOCK["structs"]["QuorumMember"].items()
+        }
+        assert QuorumMember.from_dict(payload).to_dict() == payload
+
+    def test_native_quorum_math_speaks_the_locked_structs(self):
+        """compute_quorum_results: Python Quorum -> native JSON parse ->
+        native QuorumResult -> Python from_dict, end to end."""
+        members = [
+            QuorumMember(replica_id="a:0", address="x:1", store_address="s:1",
+                         step=3, world_size=1),
+            QuorumMember(replica_id="b:0", address="x:2", store_address="s:2",
+                         step=3, world_size=1),
+        ]
+        q = Quorum(quorum_id=9, participants=members, created_ms=1)
+        res = compute_quorum_results("a:0", 0, q)
+        assert isinstance(res, QuorumResult)
+        assert res.quorum_id == 9
+        assert res.max_step == 3
+        assert not res.heal
+
+
+class TestLiveConformance:
+    """Every locked method answers on a real server, and its reply's
+    top-level keys are a subset of the locked result fields — run
+    straight off protocol.lock."""
+
+    @pytest.fixture()
+    def stack(self):
+        lh = LighthouseServer(min_replicas=1, join_timeout_ms=50)
+        store = StoreServer()
+        mgr = ManagerServer(
+            replica_id="conf_0:a",
+            lighthouse_addr=lh.address(),
+            store_address=store.address(),
+            world_size=1,
+        )
+        yield lh, store, mgr
+        mgr.shutdown()
+        store.shutdown()
+        lh.shutdown()
+
+    def _check_result(self, server, method, result):
+        locked = LOCK["servers"][server][method]
+        if isinstance(result, dict) and locked["result_struct"] is None:
+            extra = set(result) - set(locked["result"])
+            assert not extra, (
+                f"{server}.{method} reply carries unlocked field(s) "
+                f"{sorted(extra)} — regenerate protocol.lock"
+            )
+
+    def test_lighthouse_methods(self):
+        # NOT the shared stack: its ManagerServer heartbeats this
+        # lighthouse without joining, so the majority-of-heartbeaters
+        # guard would (correctly!) hold our lone direct joiner at bay —
+        # the exact bystander scenario the tft-verify 'partition' model
+        # proves the guard must block.
+        lh = LighthouseServer(min_replicas=1, join_timeout_ms=50)
+        c = LighthouseClient(lh.address())
+        try:
+            q = c.quorum("live_0:a", timeout=10.0, step=0)
+            assert q.quorum_id >= 1
+            hb = c.heartbeat("live_0:a", step=1, last_step_wall_ms=1,
+                             inflight_op="test")
+            self._check_result("lighthouse", "heartbeat", hb)
+            st = c.status()
+            self._check_result("lighthouse", "status", st)
+            tl = c.timeline()
+            self._check_result("lighthouse", "timeline", tl)
+        finally:
+            c.close()
+            lh.shutdown()
+
+    def test_manager_methods(self, stack):
+        _lh, _store, mgr = stack
+        c = ManagerClient(mgr.address())
+        try:
+            res = c._quorum(
+                group_rank=0, step=0, checkpoint_metadata="meta0",
+                shrink_only=False, timeout=20.0,
+            )
+            assert isinstance(res, QuorumResult)
+            assert c._checkpoint_metadata(rank=0, timeout=5.0) == "meta0"
+            assert c.should_commit(0, step=0, should_commit=True,
+                                   timeout=5.0) is True
+            # kill is locked but deliberately not exercised live (it
+            # makes the remote process exit); its wiring is covered by
+            # the chaos-integration suite
+        finally:
+            c.close()
+
+    def test_store_methods(self, stack):
+        _lh, store, _mgr = stack
+        c = StoreClient(store.address())
+        try:
+            c.set("conformance/k", "v")
+            assert c.get("conformance/k") == "v"
+            assert c.num_keys() >= 1
+            assert c.delete_prefix("conformance/") == 1
+        finally:
+            c.close()

@@ -24,11 +24,21 @@ file for grandfathered findings — all empty):
 ``retry-ban``             no time.sleep retry loops outside utils/retry.py
 ``fault-coverage``        fault sites registered/documented/wired; PG +
                           transport paths feed the flight recorder
+``wire-drift``            framed-JSON wire schema in sync across Python
+                          clients, native servers, docs/protocol.md, and
+                          the committed protocol.lock
 ========================  ==================================================
 
 The runtime complement is ``utils/lockcheck.py`` (TORCHFT_LOCKCHECK=1
 lock-order cycle detection) and the native TSan build
 (``make -C native SANITIZE=thread``) — see docs/static_analysis.md.
+
+The sibling subsystem ``tft-verify`` (``torchft_tpu.analysis.verify_cli``,
+console script ``tft-verify``) is the *dynamic* half of the same
+contract: an executable model of the quorum protocol
+(:mod:`torchft_tpu.analysis.protocol_model`) exhaustively explored by
+:mod:`torchft_tpu.analysis.model_checker`, plus the wire-schema lock
+workflow (``--write-lock`` / ``--drift``).
 """
 
 from torchft_tpu.analysis.core import (  # noqa: F401
@@ -44,6 +54,7 @@ from torchft_tpu.analysis.lock_discipline import PASS as _lock_discipline
 from torchft_tpu.analysis.metrics_cardinality import PASS as _metrics_cardinality
 from torchft_tpu.analysis.metrics_sync import PASS as _metrics_sync
 from torchft_tpu.analysis.retry_ban import PASS as _retry_ban
+from torchft_tpu.analysis.wire_schema import PASS as _wire_drift
 
 #: Every registered pass, in documentation order.
 PASSES = (
@@ -53,6 +64,7 @@ PASSES = (
     _metrics_cardinality,
     _retry_ban,
     _coverage,
+    _wire_drift,
 )
 
 __all__ = [

@@ -165,6 +165,13 @@ class RpcError(RuntimeError):
     pass
 
 
+#: Frame-size ceiling shared with the native side (native/net.h
+#: kMaxFrameBytes): a reply header claiming more is a corrupt or hostile
+#: peer, not a large message — fail the connection instead of trying to
+#: buffer gigabytes.
+_MAX_FRAME_BYTES = 512 * 1024 * 1024
+
+
 # Connect retry: the same curve the old ad-hoc loop used (100ms base,
 # x1.5, 10s cap) plus full jitter so replicas re-dialing a restarted
 # server do not dogpile it in lockstep.  Retryable: any OSError (refused,
@@ -286,7 +293,23 @@ class _RpcClient:
                         ) from e
                     # Broken connection (e.g. server restarted): retry once.
                     continue
-            resp = json.loads(reply)
+            # A reply that does not parse to a JSON object is a protocol
+            # violation (corrupt frame, non-UTF8 bytes, wrong peer): fail
+            # the call cleanly and drop the connection so the next call
+            # starts fresh instead of desynchronizing on this one.
+            try:
+                resp = json.loads(reply)
+            except (UnicodeDecodeError, ValueError) as e:
+                self.close()
+                raise RpcError(
+                    f"rpc {method} to {self._addr}: malformed reply frame: {e}"
+                ) from e
+            if not isinstance(resp, dict):
+                self.close()
+                raise RpcError(
+                    f"rpc {method} to {self._addr}: reply is not a JSON "
+                    f"object: {type(resp).__name__}"
+                )
             if not resp.get("ok"):
                 if resp.get("code") == "timeout":
                     raise TimeoutError(resp.get("error", "timeout"))
@@ -297,6 +320,11 @@ class _RpcClient:
         assert self._sock is not None
         header = self._recv_exact(4, deadline)
         (length,) = struct.unpack(">I", header)
+        if length > _MAX_FRAME_BYTES:
+            raise ConnectionError(
+                f"frame length {length} exceeds the {_MAX_FRAME_BYTES}-byte "
+                f"protocol ceiling (corrupt or non-protocol peer)"
+            )
         return self._recv_exact(length, deadline)
 
     def _recv_exact(self, n: int, deadline: float) -> bytes:

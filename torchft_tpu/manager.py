@@ -63,6 +63,23 @@ T = TypeVar("T")
 MANAGER_ADDR_KEY = "manager_addr"
 REPLICA_ID_KEY = "replica_id"
 
+#: Canonical per-step phase vocabulary recorded by ``_record_phase`` (the
+#: quorum_duration histogram labels, flight-recorder phase records, and
+#: per-phase trace spans all use these names).  The tft-verify protocol
+#: model renders its counterexample traces in the same vocabulary
+#: (analysis/protocol_model.MODEL_PHASE_OPS), pinned by a tier-1 test —
+#: add here BEFORE recording a new phase name.
+PROTOCOL_PHASES = (
+    "quorum_wait",
+    "quorum_rpc",
+    "pg_configure",
+    "heal_send",
+    "heal_recv",
+    "host_sync",
+    "ring",
+    "commit",
+)
+
 TIMEOUT_SEC = env_float("TORCHFT_TIMEOUT_SEC", 60.0)
 QUORUM_TIMEOUT_SEC = env_float("TORCHFT_QUORUM_TIMEOUT_SEC", 60.0)
 CONNECT_TIMEOUT_SEC = env_float("TORCHFT_CONNECT_TIMEOUT_SEC", 10.0)
