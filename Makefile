@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1
+.PHONY: native verify lint typecheck test tier1 bench-wan
 
 native:
 	$(MAKE) -C native
@@ -31,3 +31,9 @@ tier1:
 	$(PYTHON) -m pytest tests/ -m "not slow" -q
 
 test: tier1
+
+# WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
+# 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the
+# same < 1.5 KB compact-summary JSON line as the full bench.
+bench-wan:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --wan

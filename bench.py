@@ -937,6 +937,11 @@ def _bench_diloco_vs_ddp_body(
 def _diloco_sync_leg(
     leg: str, quantize: bool, gbps: "float | None", repeats: int = 2,
     wire_dtype: "Optional[str]" = None,
+    world: int = 2,
+    rtt_ms: "Optional[float]" = 0.0,
+    topology: "Optional[str]" = None,
+    n_fragments: int = DILOCO_FRAGMENTS,
+    device: bool = False,
 ) -> "Dict[str, Any]":
     """Flagship-scale outer sync over the TCP ring at a shaped egress
     bandwidth (None = unshaped loopback), best of ``repeats`` runs (the
@@ -945,55 +950,85 @@ def _diloco_sync_leg(
     wall, wire and codec seconds (codec only on the quantized leg).
     ``wire_dtype``: payload format for the quantized leg (None resolves
     through the collective's default chain: TORCHFT_QUANT_WIRE env, else
-    int8 — format-comparison legs pin it explicitly)."""
+    int8 — format-comparison legs pin it explicitly).
+
+    WAN knobs (the RTT-swept legs): ``rtt_ms`` arms the per-message
+    boundary latency on every PG; ``topology`` picks the REDUCTION PLAN
+    ("flat" or a TORCHFT_TOPOLOGY spec) — the wire model's boundary map
+    always comes from the TORCHFT_TOPOLOGY env the caller sets, so flat
+    and hierarchical legs price the same physical topology.  ``device``:
+    create the fragment on-device and quantize with the Pallas kernel
+    (the ``diloco.int8_device`` leg, TPU only)."""
     if repeats > 1:
         runs = [
             _diloco_sync_leg(
                 f"{leg}_r{i}", quantize, gbps, repeats=1,
-                wire_dtype=wire_dtype,
+                wire_dtype=wire_dtype, world=world, rtt_ms=rtt_ms,
+                topology=topology, n_fragments=n_fragments, device=device,
             )
             for i in range(repeats)
         ]
         return min(runs, key=lambda r: r["sync_s"])
     from torchft_tpu.ops.collectives import allreduce_quantized
 
-    world = 2
     frag_elems = FLAGSHIP_PARAMS // DILOCO_FRAGMENTS
     store = StoreServer()
     barrier = threading.Barrier(world)
     walls: "Dict[int, float]" = {}
     wires: "Dict[int, int]" = {}
+    inters: "Dict[int, int]" = {}
     codecs: "Dict[int, float]" = {}
     pipes: "Dict[int, Dict[str, Any]]" = {}
 
     def worker(rank: int) -> None:
-        pg = ProcessGroupTCP(timeout=300.0, bandwidth_gbps=gbps)
+        pg = ProcessGroupTCP(
+            timeout=300.0, bandwidth_gbps=gbps, rtt_ms=rtt_ms
+        )
         pg.configure(
             f"{store.address()}/diloco_{leg}_{gbps}", f"dl_{rank}", rank, world
         )
         try:
-            rng = np.random.default_rng(rank)
-            frag = rng.standard_normal(frag_elems).astype(np.float32)
+            if device:
+                import jax
+
+                # fragment born ON device (only the PRNG key crosses the
+                # host link; bench.py module docstring: routing f32 grads
+                # through the driver tunnel would measure the tunnel)
+                frag = jax.jit(
+                    lambda k: jax.random.normal(k, (frag_elems,))
+                )(jax.random.PRNGKey(rank))
+                frag.block_until_ready()
+            else:
+                rng = np.random.default_rng(rank)
+                frag = rng.standard_normal(frag_elems).astype(np.float32)
             barrier.wait(timeout=60)
             t0 = time.perf_counter()
             wire = 0
+            inter = 0
             codec = 0.0
             # per-fragment pipeline accounting (quantized legs): sums of
             # the chunked pipeline's busy walls + the efficiency of the
             # worst fragment (the honest overlap headline)
-            pipe = {"wire_busy_s": 0.0, "n_chunks": 0, "effs": []}
-            for _ in range(DILOCO_FRAGMENTS):
+            pipe: "Dict[str, Any]" = {
+                "wire_busy_s": 0.0, "n_chunks": 0, "effs": [], "hops": {},
+            }
+            for _ in range(n_fragments):
                 if quantize:
                     w = allreduce_quantized(
-                        [frag], REDUCE_SUM, pg, wire_dtype=wire_dtype
+                        [frag], REDUCE_SUM, pg, wire_dtype=wire_dtype,
+                        topology=topology,
+                        device_quantize=True if device else None,
                     )
                     w.wait(timeout=600)
                     wire += w.wire_bytes
+                    inter += getattr(w, "inter_wire_bytes", 0) or 0
                     codec += w.codec_s_box[0]
                     stats = w.quant_stats
                     pipe["wire_busy_s"] += stats["wire_s"]
                     pipe["n_chunks"] = stats["n_chunks"]
                     pipe["effs"].append(stats["overlap_efficiency"])
+                    for hop, s in (stats.get("hop_wire_s") or {}).items():
+                        pipe["hops"][hop] = pipe["hops"].get(hop, 0.0) + s
                 else:
                     aw = pg.allreduce([frag], REDUCE_SUM)
                     aw.wait(timeout=600)
@@ -1002,6 +1037,7 @@ def _diloco_sync_leg(
                     wire += aw.wire_bytes
             walls[rank] = time.perf_counter() - t0
             wires[rank] = wire
+            inters[rank] = inter
             codecs[rank] = codec
             pipes[rank] = pipe
         finally:
@@ -1032,6 +1068,14 @@ def _diloco_sync_leg(
         out["overlap_efficiency_mean"] = round(
             sum(pipe["effs"]) / len(pipe["effs"]), 3
         )
+        if pipe["hops"]:
+            out["hop_wire_s"] = {
+                h: round(s, 2) for h, s in sorted(pipe["hops"].items())
+            }
+        if any(inters.values()):
+            # worst leader's inter-host egress — the bytes the WAN
+            # actually carries
+            out["inter_wire_gb"] = round(max(inters.values()) / 1e9, 3)
     return out
 
 
@@ -1126,6 +1170,36 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
             f"{shaped[str(gbps)]['winner']} wins "
             f"{shaped[str(gbps)]['int8_speedup_x']:.2f}x")
     legs["shaped"] = shaped
+    # diloco.int8_device (ROADMAP item 1): the on-chip Pallas quantize
+    # path priced on real hardware — fragment born on device, quantized
+    # in one kernel launch, int8 payload + row scales D2H-copied per
+    # chunk into the wire pipeline.  TPU only: interpret mode on CPU
+    # prices the emulator, not the design point (parity is tested in
+    # tier-1 instead).
+    import jax as _jax
+
+    if _jax.default_backend() == "tpu":
+        try:
+            r = _diloco_sync_leg(
+                "int8_device", True, None, repeats=1, wire_dtype="int8",
+                n_fragments=2, device=True,
+            )
+            scale = DILOCO_FRAGMENTS / 2
+            amortized_ms = r["sync_s"] * scale * 1e3 / DILOCO_SYNC_EVERY
+            legs["int8_device"] = {
+                **r,
+                "fragments_run": 2,
+                "amortized_ms_per_inner_step": round(amortized_ms, 1),
+                "overhead_pct_vs_model_step": round(
+                    100.0 * amortized_ms / model_step_ms, 1
+                ),
+            }
+            log(f"diloco int8_device: {legs['int8_device']}")
+        except Exception as e:  # noqa: BLE001 - never cost the host legs
+            log(f"diloco int8_device leg failed: {e!r}")
+            legs["int8_device"] = {"error": repr(e)}
+    else:
+        legs["int8_device"] = {"skipped": "no TPU backend"}
     legs["wire_reduction_x"] = round(
         legs["f32"]["wire_gb"] / max(legs["int8"]["wire_gb"], 1e-9), 2
     )
@@ -1133,6 +1207,106 @@ def bench_diloco(model_step_ms: float) -> "Dict[str, Any]":
     legs["fragments"] = DILOCO_FRAGMENTS
     legs["sync_every"] = DILOCO_SYNC_EVERY
     return legs
+
+
+# ---------------------------------------------------------------------------
+# 3b. WAN sweep: flat vs hierarchical int8 DiLoCo at simulated RTT
+# ---------------------------------------------------------------------------
+
+WAN_WORLD = 4            # 2 hosts x 2 ranks
+WAN_TOPOLOGY = "hosts:2"
+WAN_GBPS = 0.5           # per-rank shaped egress during the sweep
+WAN_FRAGMENTS = 2        # flagship-scale fragments per leg (wall bound)
+WAN_RTTS_MS = (0.0, 10.0, 50.0)
+
+
+def bench_wan(model_step_ms: float) -> "Dict[str, Any]":
+    """The WAN-grade leg (ROADMAP item 3): flat-ring vs hierarchical
+    int8 DiLoCo outer sync swept over simulated inter-host RTT.
+
+    Both legs run 4 thread-ranks laid out as 2 hosts x 2
+    (``TORCHFT_TOPOLOGY=hosts:2`` is set process-wide so the WIRE model
+    charges ``rtt_ms`` only on messages crossing the host boundary for
+    BOTH schedules — same physical topology, different reduction plan).
+    The flat leg pins ``topology="flat"`` (today's alltoall/allgather
+    interleave, 2*(w-1) serialized inter-host-bearing ops per chunk);
+    the hierarchical leg runs the synthesized plan (2 inter-host
+    sendrecv per chunk).  At 0 ms they should be comparable; at WAN RTT
+    the flat ring's serialized hops dominate and hierarchical must win
+    — the acceptance margin the compact summary carries, next to the
+    per-hop wire telemetry and inter-host byte counts.
+
+    Also re-validates the DiLoCo overhead claim at RTT: each leg's sync
+    wall scales to a full ``DILOCO_FRAGMENTS``-fragment outer sync and
+    amortizes over ``DILOCO_SYNC_EVERY`` inner steps against the
+    flagship model step.
+    """
+    import os as _os
+
+    # the WAN knobs go through the ENV (not ctor args) so every PG a
+    # leg constructs — and anything else that resolves the wire model —
+    # sees one consistent configuration per sweep point
+    prior = {
+        k: _os.environ.get(k)
+        for k in ("TORCHFT_TOPOLOGY", "TORCHFT_WIRE_GBPS",
+                  "TORCHFT_WIRE_RTT_MS")
+    }
+    _os.environ["TORCHFT_TOPOLOGY"] = WAN_TOPOLOGY
+    _os.environ["TORCHFT_WIRE_GBPS"] = str(WAN_GBPS)
+    try:
+        out: "Dict[str, Any]" = {
+            "world": WAN_WORLD,
+            "topology": WAN_TOPOLOGY,
+            "gbps": WAN_GBPS,
+            "fragments_per_leg": WAN_FRAGMENTS,
+        }
+        scale = DILOCO_FRAGMENTS / WAN_FRAGMENTS
+        for rtt in WAN_RTTS_MS:
+            _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
+            flat = _diloco_sync_leg(
+                "wan_flat", True, None, wire_dtype="int8",
+                world=WAN_WORLD, rtt_ms=None, topology="flat",
+                n_fragments=WAN_FRAGMENTS,
+            )
+            hier = _diloco_sync_leg(
+                "wan_hier", True, None, wire_dtype="int8",
+                world=WAN_WORLD, rtt_ms=None, topology=WAN_TOPOLOGY,
+                n_fragments=WAN_FRAGMENTS,
+            )
+            speedup = flat["sync_s"] / max(hier["sync_s"], 1e-9)
+            leg = {
+                "flat_sync_s": flat["sync_s"],
+                "hier_sync_s": hier["sync_s"],
+                "hier_speedup_x": round(speedup, 2),
+                "winner": "hier" if hier["sync_s"] < flat["sync_s"] else "flat",
+                "flat_inter_wire_gb": flat.get("inter_wire_gb"),
+                "hier_inter_wire_gb": hier.get("inter_wire_gb"),
+                "hier_hop_wire_s": hier.get("hop_wire_s"),
+                "flat_hop_wire_s": flat.get("hop_wire_s"),
+                # overhead re-validation at this RTT (no-overlap upper
+                # bound, like bench_diloco's table)
+                "flat_overhead_pct_vs_model_step": round(
+                    100.0 * flat["sync_s"] * scale * 1e3
+                    / DILOCO_SYNC_EVERY / model_step_ms, 1
+                ),
+                "hier_overhead_pct_vs_model_step": round(
+                    100.0 * hier["sync_s"] * scale * 1e3
+                    / DILOCO_SYNC_EVERY / model_step_ms, 1
+                ),
+            }
+            out[f"rtt_{rtt:g}ms"] = leg
+            log(f"wan @rtt={rtt:g}ms {WAN_GBPS}GB/s: flat {flat['sync_s']:.2f}s "
+                f"vs hier {hier['sync_s']:.2f}s -> {leg['winner']} wins "
+                f"{leg['hier_speedup_x']:.2f}x | hier hops "
+                f"{hier.get('hop_wire_s')} | inter GB "
+                f"flat={flat.get('inter_wire_gb')} hier={hier.get('inter_wire_gb')}")
+        return out
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
 
 
 # ---------------------------------------------------------------------------
@@ -1468,6 +1642,22 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         for gbps, leg in sorted((diloco.get("shaped") or {}).items())
         if isinstance(leg, dict)
     }
+    wan = result.get("wan") or {}
+    wan_winners = {
+        key: {
+            "winner": leg.get("winner"),
+            "hier_speedup_x": leg.get("hier_speedup_x"),
+        }
+        for key, leg in sorted(wan.items())
+        if isinstance(leg, dict) and key.startswith("rtt_")
+    }
+    # per-hop wire telemetry of the highest-RTT hierarchical leg — the
+    # acceptance surface (hier must beat flat at 50 ms, hops visible)
+    wan_hops = (
+        (wan.get("rtt_50ms") or {}).get("hier_hop_wire_s")
+        if isinstance(wan.get("rtt_50ms"), dict)
+        else None
+    )
     out: "Dict[str, Any]" = {
         "compact": True,
         "metric": result.get("metric", "recovery_to_healthy_step_latency"),
@@ -1487,14 +1677,17 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "step_ms": model.get("step_ms"),
         "diloco_winners": winners,
         "diloco_wire_reduction_x": diloco.get("wire_reduction_x"),
+        "wan": wan_winners,
+        "wan_hops_50ms": wan_hops,
     }
     if "error" in result:
         out["error"] = str(result["error"])[:200]
     # Enforce the byte budget structurally: drop the least essential
     # fields first rather than shipping an unparseable truncation.
     droppable = [
-        "diloco_wire_reduction_x", "step_ms", "diloco_winners",
-        "crosscheck", "recovery_phases_ms_top", "recovery_cycles_s",
+        "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
+        "diloco_winners", "crosscheck", "recovery_phases_ms_top",
+        "recovery_cycles_s", "wan",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -1532,6 +1725,14 @@ def main() -> None:
     from torchft_tpu.utils import metrics as _metrics
 
     _metrics.maybe_serve_from_env()
+    if "--wan" in sys.argv:
+        # `make bench-wan`: the RTT sweep alone, with the compact tail
+        # (same last-line contract as the full run)
+        wan = bench_wan(262.0)
+        result = {"metric": "wan_rtt_sweep", "wan": wan}
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
     recovery = bench_recovery()
     # Insurance against an external wall-cap killing the process mid-run:
     # emit a parseable JSON line with the PRIMARY metric as soon as it
@@ -1588,6 +1789,11 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"shaped diloco-vs-ddp bench failed: {e!r}")
         diloco["vs_ddp_shaped_0p5gbps"] = {"error": repr(e)}
+    try:
+        wan = bench_wan(model.get("step_ms") or 262.0)
+    except Exception as e:  # noqa: BLE001
+        log(f"wan bench failed: {e!r}")
+        wan = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",
@@ -1597,6 +1803,7 @@ def main() -> None:
         "model_overhead_pct": (model.get("ft") or {}).get("model_overhead_pct"),
         "model": model,
         "diloco": diloco,
+        "wan": wan,
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

@@ -20,6 +20,14 @@ Two fixtures under tests/fixtures/:
   end to end (quantize -> alltoall -> fma-reduce -> requant ->
   allgather -> dequant -> average).
 
+- ``quantized_sync_int8_hier.json``: the same scheme over the
+  HIERARCHICAL reduction plan (4 ranks, topology ``hosts:2``,
+  ops/topology.py).  Requantization at hop boundaries makes the
+  hierarchical numerics intentionally different from the flat ring's —
+  this fixture pins them independently (member quantize -> intra
+  reduce -> inter exchange requant -> reduce -> requant -> gather ->
+  broadcast -> dequant).
+
 Regenerate (after an *intentional* semantics change) with:
     TORCHFT_TPU_REGEN_FIXTURES=1 python -m pytest tests/test_golden_fixtures.py
 """
@@ -242,3 +250,72 @@ class TestQuantizedSyncInt8Golden:
             ],
         }
         _check_or_regen(FIXTURES / "quantized_sync_int8.json", produced)
+
+
+HIER_WORLD = 4
+HIER_TOPOLOGY = "hosts:2"
+
+
+class TestHierarchicalSyncInt8Golden:
+    def test_hier_int8_sync_history_matches_fixture(self, store):  # noqa: F811
+        """Pins the hierarchical-plan numerics end to end: the
+        hop-boundary requantization (ops/topology.py module docstring)
+        legitimately changes results vs the flat ring, so the
+        hierarchical sync gets its own committed golden."""
+        from torchft_tpu.ops.collectives import allreduce_quantized
+
+        pgs = make_group(store, HIER_WORLD, prefix="golden_qh")
+        rng = np.random.default_rng(4321)
+        grads = [
+            [
+                rng.standard_normal(QUANT_SHAPE).astype(np.float32)
+                for _ in range(SYNC_ROUNDS)
+            ]
+            for _ in range(HIER_WORLD)
+        ]
+        params = [
+            np.zeros(QUANT_SHAPE, dtype=np.float32)
+            for _ in range(HIER_WORLD)
+        ]
+
+        def run(rank, _):
+            out = []
+            for rnd in range(SYNC_ROUNDS):
+                work = allreduce_quantized(
+                    [grads[rank][rnd].copy()], REDUCE_AVG, pgs[rank],
+                    topology=HIER_TOPOLOGY,
+                )
+                (avg,) = work.wait(timeout=30)
+                params[rank] -= np.float32(0.1) * avg
+                out.append(params[rank].copy())
+            return out
+
+        results = run_parallel(HIER_WORLD, run)
+        # every rank dequantizes the same reduced-piece bytes: bitwise
+        # identical across ALL ranks every round
+        for rnd in range(SYNC_ROUNDS):
+            for r in range(1, HIER_WORLD):
+                np.testing.assert_array_equal(
+                    results[0][rnd], results[r][rnd]
+                )
+
+        produced = {
+            "wire": "int8",
+            "topology": HIER_TOPOLOGY,
+            "world": HIER_WORLD,
+            "rounds": SYNC_ROUNDS,
+            "shape": list(QUANT_SHAPE),
+            "seed": 4321,
+            "history": [
+                {
+                    "round": rnd,
+                    "first_row": [float(x) for x in results[0][rnd][0]],
+                    "sum": float(np.float64(results[0][rnd].sum(dtype=np.float64))),
+                    "abs_sum": float(
+                        np.float64(np.abs(results[0][rnd]).sum(dtype=np.float64))
+                    ),
+                }
+                for rnd in range(SYNC_ROUNDS)
+            ],
+        }
+        _check_or_regen(FIXTURES / "quantized_sync_int8_hier.json", produced)

@@ -1,0 +1,494 @@
+"""Topology-aware hierarchical collectives + the WAN (RTT) wire model.
+
+Covers ISSUE 8's tentpole surface:
+
+- ``TORCHFT_TOPOLOGY`` parsing and plan synthesis (ops/topology.py);
+- the hierarchical multi-hop quantized allreduce: correctness vs the f32
+  truth, bit-identical results across ALL ranks, chunked-vs-monolithic
+  bit parity, fp8 wire, device (Pallas interpret) path, env-driven
+  topology, pool steady state;
+- the RTT wire model: K pacing chunks pay 1x RTT (latency decoupled from
+  the bandwidth debt), intra-group messages skip it;
+- chaos: an injected ``pg.allreduce.hop`` failure mid-pipeline aborts
+  cleanly on every rank and the SAME process groups complete a clean
+  collective afterwards.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_process_group import make_group, run_parallel, store  # noqa: F401
+from torchft_tpu.ops import quantization as q
+from torchft_tpu.ops import topology as T
+from torchft_tpu.ops.collectives import allreduce_quantized
+from torchft_tpu.parallel.process_group import (
+    REDUCE_AVG,
+    REDUCE_SUM,
+    ProcessGroupTCP,
+)
+
+
+class TestTopologyParse:
+    def test_flat_spellings(self):
+        assert T.parse_topology("", 4) is None
+        assert T.parse_topology("flat", 4) is None
+        assert T.parse_topology("  Flat ", 4) is None
+
+    def test_hosts_k(self):
+        topo = T.parse_topology("hosts:2", 5)
+        assert topo.groups == ((0, 1), (2, 3), (4,))
+        assert topo.leaders() == [0, 2, 4]
+        assert topo.members(0) == [1]
+        assert topo.inter(0, 2) and not topo.inter(2, 3)
+
+    def test_hosts_k_adapts_to_world(self):
+        # elastic shrink re-ranks; hosts:K must keep partitioning cleanly
+        for world in (1, 2, 3, 7):
+            topo = T.parse_topology("hosts:4", world)
+            if topo is not None:
+                assert sorted(r for g in topo.groups for r in g) == list(
+                    range(world)
+                )
+
+    def test_explicit_groups(self):
+        topo = T.parse_topology("0,3;1,2", 4)
+        assert topo.groups == ((0, 3), (1, 2))
+        assert topo.leader(0) == 0 and topo.leader(1) == 1
+        assert topo.group_index(3) == 0
+
+    def test_explicit_world_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="lists 4 ranks"):
+            T.parse_topology("0,1;2,3", 5)
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            T.parse_topology("hosts:zero", 4)
+        with pytest.raises(ValueError):
+            T.parse_topology("hosts:0", 4)
+        with pytest.raises(ValueError):
+            T.parse_topology("0,1;1,2", 4)  # duplicate rank
+        with pytest.raises(ValueError):
+            T.parse_topology("a,b", 2)
+
+    def test_spec_round_trip(self):
+        topo = T.parse_topology("0,1;2,3,4", 5)
+        assert T.parse_topology(topo.describe(), 5).groups == topo.groups
+
+
+class TestPlanSynthesis:
+    def test_leader_and_member_hops(self):
+        topo = T.parse_topology("hosts:2", 4)
+        lead = T.synthesize_plan(topo, 2)
+        memb = T.synthesize_plan(topo, 3)
+        assert lead.is_leader and not memb.is_leader
+        names = [h.name for h in lead.hops]
+        assert names == [
+            "intra.reduce", "inter.exchange", "inter.gather", "intra.bcast"
+        ]
+        assert lead.hops[0].recvs == (3,)
+        assert lead.hops[1].sends == (0,) and lead.hops[1].paired
+        assert lead.hops[3].sends == (3,)
+        assert memb.hops[0].sends == (2,)
+        assert memb.hops[3].recvs == (2,)
+
+    def test_pairwise_offsets_cover_all_leaders(self):
+        topo = T.parse_topology("hosts:1", 5)  # every rank its own host
+        for r in range(5):
+            plan = T.synthesize_plan(topo, r)
+            ex = plan.hops[1]
+            assert sorted(ex.sends) == sorted(x for x in range(5) if x != r)
+            assert sorted(ex.recvs) == sorted(ex.sends)
+            # offset schedule: send at +o pairs with recv at -o, so every
+            # rank's o-th exchange targets a rank whose o-th exchange
+            # targets it back
+            for o, (dst, src) in enumerate(zip(ex.sends, ex.recvs)):
+                peer = T.synthesize_plan(topo, dst).hops[1]
+                assert peer.recvs[o] == r
+
+
+_SHAPES = ((100, 501), (50_000,))
+
+
+def _data(world, seed=5):
+    rng = np.random.default_rng(seed)
+    return [
+        [rng.standard_normal(s).astype(np.float32) for s in _SHAPES]
+        for _ in range(world)
+    ]
+
+
+def _run_hier(pgs, data, topo, op=REDUCE_AVG, wire_dtype=None, **kw):
+    def run(rank, _):
+        w = allreduce_quantized(
+            data[rank], op, pgs[rank], topology=topo, wire_dtype=wire_dtype,
+            **kw,
+        )
+        out = w.wait(timeout=60)
+        return out, dict(w.quant_stats), w.wire_bytes, w.inter_wire_bytes
+
+    return run_parallel(len(pgs), run)
+
+
+class TestHierarchicalAllreduce:
+    def test_correct_and_bitwise_identical_across_ranks(self, store):  # noqa: F811
+        world = 4
+        pgs = make_group(store, world, prefix="hier4")
+        data = _data(world)
+        expected = [sum(d[i] for d in data) / world for i in range(len(_SHAPES))]
+        results = _run_hier(pgs, data, "hosts:2")
+        for out, stats, _, _ in results:
+            assert stats["topology"] == "0,1;2,3"
+            for got, want in zip(out, expected):
+                rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+                assert rel < 0.05, rel
+        # per-hop wire telemetry covers this rank's plan hops: leaders
+        # run all four; members only touch the wire on the intra hops
+        for r, (_, stats, _, _) in enumerate(results):
+            want_hops = (
+                {"intra.reduce", "inter.exchange", "inter.gather",
+                 "intra.bcast"}
+                if r in (0, 2)
+                else {"intra.reduce", "intra.bcast"}
+            )
+            assert set(stats["hop_wire_s"]) == want_hops, (r, stats)
+        # every rank dequantizes the same reduced-piece bytes
+        for i in range(len(_SHAPES)):
+            for r in range(1, world):
+                np.testing.assert_array_equal(
+                    results[0][0][i], results[r][0][i]
+                )
+        # members pay no inter-host egress; leaders pay both inter hops
+        for r, (_, _, wire, inter) in enumerate(results):
+            if r in (0, 2):
+                assert inter > 0 and wire > inter
+            else:
+                assert inter == 0 and wire > 0
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_uneven_groups_and_sum(self, store):  # noqa: F811
+        world = 5  # hosts:2 -> {0,1},{2,3},{4}: a solo-leader group
+        pgs = make_group(store, world, prefix="hier5")
+        data = _data(world, seed=9)
+        expected = [sum(d[i] for d in data) for i in range(len(_SHAPES))]
+        results = _run_hier(pgs, data, "hosts:2", op=REDUCE_SUM)
+        for out, _, _, _ in results:
+            for got, want in zip(out, expected):
+                rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+                assert rel < 0.05, rel
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_single_group_topology(self, store):  # noqa: F811
+        # one host: no inter hops at all, pure intra reduce + bcast
+        world = 3
+        pgs = make_group(store, world, prefix="hier1g")
+        data = _data(world, seed=3)
+        expected = [sum(d[i] for d in data) / world for i in range(len(_SHAPES))]
+        results = _run_hier(pgs, data, "0,1,2")
+        for out, stats, _, inter in results:
+            assert inter == 0
+            assert "inter.exchange" not in stats["hop_wire_s"]
+            for got, want in zip(out, expected):
+                rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+                assert rel < 0.05, rel
+        for pg in pgs:
+            pg.shutdown()
+
+    @pytest.mark.parametrize("wire_dtype", [q.WIRE_INT8, q.WIRE_FP8])
+    def test_chunked_bitwise_parity(
+        self, store, monkeypatch, wire_dtype  # noqa: F811
+    ):
+        """Chunked vs monolithic hierarchical output must be BIT-identical
+        for both wire formats (per-row codec + row chunking, same
+        argument as the flat pipeline's parity)."""
+        world = 4
+        data = _data(world, seed=11)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix=f"hm{wire_dtype}")
+        mono = _run_hier(pgs, data, "hosts:2", wire_dtype=wire_dtype)
+        for pg in pgs:
+            pg.shutdown()
+        assert mono[0][1]["n_chunks"] == 1
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "4")
+        pgs = make_group(store, world, prefix=f"hc{wire_dtype}")
+        chunked = _run_hier(pgs, data, "hosts:2", wire_dtype=wire_dtype)
+        for pg in pgs:
+            pg.shutdown()
+        assert chunked[0][1]["n_chunks"] > 2
+        for (mo, _, _, _), (co, _, _, _) in zip(mono, chunked):
+            for a, b in zip(mo, co):
+                np.testing.assert_array_equal(a, b)
+
+    def test_env_topology_drives_plan(self, store, monkeypatch):  # noqa: F811
+        monkeypatch.setenv("TORCHFT_TOPOLOGY", "hosts:2")
+        world = 4
+        pgs = make_group(store, world, prefix="hienv")
+        data = _data(world, seed=2)
+        results = _run_hier(pgs, data, None)  # None -> env default
+        assert results[0][1]["topology"] == "0,1;2,3"
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_device_path_parity(self, store, monkeypatch):  # noqa: F811
+        """Pallas (interpret-mode) device quantize through the
+        hierarchical chunked pipeline: bit-identical to the monolithic
+        device run, ~quantization-error close to the f32 truth."""
+        import jax.numpy as jnp
+
+        world = 4
+        data = _data(world, seed=13)
+
+        def run_dev(pgs):
+            def run(rank, _):
+                arrays = [jnp.asarray(a) for a in data[rank]]
+                w = allreduce_quantized(
+                    arrays, REDUCE_SUM, pgs[rank], device_quantize=True,
+                    topology="hosts:2",
+                )
+                return w.wait(timeout=90), dict(w.quant_stats)
+
+            return run_parallel(world, run)
+
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", str(10**9))
+        pgs = make_group(store, world, prefix="hdm")
+        mono = run_dev(pgs)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs2 = make_group(store, world, prefix="hdc")
+        chunked = run_dev(pgs2)
+        for pg in pgs + pgs2:
+            pg.shutdown()
+        assert chunked[0][1]["n_chunks"] > 1
+        for (mo, _), (co, _) in zip(mono, chunked):
+            for a, b in zip(mo, co):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        expected = [sum(d[i] for d in data) for i in range(len(_SHAPES))]
+        for got, want in zip(mono[0][0], expected):
+            rel = np.abs(np.asarray(got) - want).max() / (
+                np.abs(want).max() + 1e-9
+            )
+            assert rel < 0.05, rel
+
+    def test_pool_steady_state(self, store, monkeypatch):  # noqa: F811
+        """A repeat hierarchical collective of the same shape takes every
+        staging buffer — stage-1 stacks, accumulators, exchange bufs,
+        pieces, broadcast bundles, pool-backed receives — from the pool:
+        no new allocations in steady state (also catches double-gives,
+        which corrupt parity)."""
+        from torchft_tpu.utils.bufpool import POOL
+
+        world = 4
+        data = _data(world, seed=6)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs = make_group(store, world, prefix="hpool")
+        # two warm rounds: the 4 thread-ranks share ONE process pool, so
+        # a run's peak concurrent footprint varies a little with give/take
+        # interleaving across ranks — the second round covers the spread
+        _run_hier(pgs, data, "hosts:2")
+        _run_hier(pgs, data, "hosts:2")
+        misses_before = POOL.misses
+        results = _run_hier(pgs, data, "hosts:2")
+        misses_after = POOL.misses
+        for pg in pgs:
+            pg.shutdown()
+        assert results[0][1]["n_chunks"] > 2
+        # a LEAK (buffer never given back) or a double-give would grow
+        # misses by O(chunks x ranks) per run; cross-rank timing jitter
+        # is at most a couple of takes racing their gives
+        assert misses_after - misses_before <= 3, (
+            f"steady-state pool misses grew: {misses_before} -> {misses_after}"
+        )
+
+    def test_topology_world_mismatch_fails_loudly(self, store):  # noqa: F811
+        pgs = make_group(store, 2, prefix="hmis")
+        topo = T.parse_topology("0,1;2,3", 4)
+        with pytest.raises(ValueError, match="topology"):
+            allreduce_quantized(
+                [np.ones((8, 8), np.float32)], REDUCE_SUM, pgs[0],
+                topology=topo,
+            )
+        for pg in pgs:
+            pg.shutdown()
+
+
+class TestSendRecv:
+    def test_pairwise_exchange(self, store):  # noqa: F811
+        world = 3
+        pgs = make_group(store, world, prefix="srx")
+
+        def run(rank, _):
+            out = []
+            for off in range(1, world):
+                dst = (rank + off) % world
+                src = (rank - off) % world
+                got = pgs[rank].sendrecv(
+                    np.full(64, float(rank), np.float32), dst, src, tag=off
+                ).wait(timeout=20)
+                out.append((src, got))
+            return out
+
+        for rank, pairs in enumerate(run_parallel(world, run)):
+            for src, got in pairs:
+                np.testing.assert_array_equal(
+                    got, np.full(64, float(src), np.float32)
+                )
+        for pg in pgs:
+            pg.shutdown()
+
+
+class TestWanWireModel:
+    RTT_MS = 120.0
+
+    def test_rtt_and_bandwidth_compose_once_per_message(self, store):  # noqa: F811
+        """A 4 MiB message paced in 4 x 1 MiB token-bucket chunks pays
+        ONE first-byte RTT plus the serialization time — never K x RTT
+        (the decoupling the WAN model promises)."""
+        world = 2
+        # serialization at 0.2 GB/s for 4 MiB ~ 21 ms << RTT
+        pgs = [
+            ProcessGroupTCP(
+                timeout=20.0, bandwidth_gbps=0.2, rtt_ms=self.RTT_MS
+            )
+            for _ in range(world)
+        ]
+
+        def cfg(rank, _):
+            pgs[rank].configure(
+                f"{store.address()}/rttc", f"r{rank}", rank, world
+            )
+
+        run_parallel(world, cfg)
+        payload = np.ones(1 << 20, dtype=np.float32)  # 4 MiB
+
+        def run(rank, _):
+            if rank == 0:
+                t0 = time.perf_counter()
+                pgs[0].send(payload, 1, tag=7).wait(timeout=20)
+                return time.perf_counter() - t0
+            pgs[1].recv(0, tag=7).wait(timeout=20)
+            return 0.0
+
+        wall = max(run_parallel(world, run))
+        rtt_s = self.RTT_MS / 1e3
+        assert wall >= rtt_s, f"first-byte delay missing: {wall}"
+        assert wall < 2.5 * rtt_s, (
+            f"pacing chunks multiplied RTT: wall={wall:.3f}s"
+        )
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_intra_group_messages_skip_rtt(self, store, monkeypatch):  # noqa: F811
+        monkeypatch.setenv("TORCHFT_TOPOLOGY", "0,1")
+        world = 2
+        pgs = [
+            ProcessGroupTCP(timeout=20.0, rtt_ms=self.RTT_MS)
+            for _ in range(world)
+        ]
+
+        def cfg(rank, _):
+            pgs[rank].configure(
+                f"{store.address()}/rtti", f"r{rank}", rank, world
+            )
+
+        run_parallel(world, cfg)
+        payload = np.ones(1024, dtype=np.float32)
+
+        def run(rank, _):
+            if rank == 0:
+                t0 = time.perf_counter()
+                pgs[0].send(payload, 1, tag=3).wait(timeout=20)
+                return time.perf_counter() - t0
+            pgs[1].recv(0, tag=3).wait(timeout=20)
+            return 0.0
+
+        wall = max(run_parallel(world, run))
+        assert wall < self.RTT_MS / 1e3 / 2, (
+            f"intra-group message paid the boundary RTT: {wall:.3f}s"
+        )
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_flat_topology_charges_every_peer(self, store):  # noqa: F811
+        # no TORCHFT_TOPOLOGY: the multi-region flat premise — every
+        # peer is across a boundary
+        world = 2
+        pgs = [
+            ProcessGroupTCP(timeout=20.0, rtt_ms=80.0) for _ in range(world)
+        ]
+
+        def cfg(rank, _):
+            pgs[rank].configure(
+                f"{store.address()}/rttf", f"r{rank}", rank, world
+            )
+
+        run_parallel(world, cfg)
+
+        def run(rank, _):
+            if rank == 0:
+                t0 = time.perf_counter()
+                pgs[0].send(
+                    np.ones(16, np.float32), 1, tag=1
+                ).wait(timeout=20)
+                return time.perf_counter() - t0
+            pgs[1].recv(0, tag=1).wait(timeout=20)
+            return 0.0
+
+        wall = max(run_parallel(world, run))
+        assert wall >= 0.08, f"flat-topology RTT not charged: {wall:.3f}s"
+        for pg in pgs:
+            pg.shutdown()
+
+
+class TestHopChaos:
+    def test_inter_hop_fault_aborts_cleanly_and_pg_reuses(
+        self, store, monkeypatch  # noqa: F811
+    ):
+        """An injected ``pg.allreduce.hop`` failure (step = chunk 1, i.e.
+        after chunk 0's inter hops are on the wire) must fail the Work
+        promptly on EVERY rank — all drivers stop at the same submission
+        point — and the same PGs must complete a clean hierarchical
+        collective afterwards (docs/robustness.md)."""
+        from torchft_tpu.utils import faults
+        from torchft_tpu.utils.faults import FaultRule, InjectedFault
+
+        world = 4
+        data = _data(world, seed=8)
+        monkeypatch.setenv("TORCHFT_QUANT_CHUNK_ROWS", "8")
+        pgs = make_group(store, world, prefix="hchaos")
+        faults.FAULTS.configure(
+            [FaultRule(site="pg.allreduce.hop", step=1, times=world)],
+            seed=1,
+        )
+
+        def run(rank, _):
+            w = allreduce_quantized(
+                [data[rank][1]], REDUCE_SUM, pgs[rank], topology="hosts:2"
+            )
+            t0 = time.perf_counter()
+            try:
+                w.wait(timeout=30)
+                return None, 0.0
+            except Exception as e:  # noqa: BLE001
+                return e, time.perf_counter() - t0
+
+        results = run_parallel(world, run)
+        for exc, elapsed in results:
+            assert isinstance(exc, InjectedFault), exc
+            assert elapsed < 20.0, "mid-pipeline hop abort did not drain"
+        assert faults.FAULTS.injected("pg.allreduce.hop") == world
+
+        faults.FAULTS.configure([], seed=0)
+        expected = sum(d[1] for d in data)
+
+        def clean(rank, _):
+            return allreduce_quantized(
+                [data[rank][1]], REDUCE_SUM, pgs[rank], topology="hosts:2"
+            ).wait(timeout=30)
+
+        for (out,) in run_parallel(world, clean):
+            rel = np.abs(out - expected).max() / (np.abs(expected).max() + 1e-9)
+            assert rel < 0.05, rel
+        for pg in pgs:
+            pg.shutdown()

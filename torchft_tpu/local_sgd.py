@@ -149,6 +149,7 @@ class _Fragment:
         outer_optimizer: optax.GradientTransformation,
         should_quantize: bool,
         fragment_update_alpha: float,
+        device_quantize: "Optional[bool]" = None,
     ) -> None:
         self._manager = manager
         self._fragment_id = fragment_id
@@ -157,6 +158,7 @@ class _Fragment:
         self._set_params = set_params
         self._outer = outer_optimizer
         self._should_quantize = should_quantize
+        self._device_quantize = device_quantize
         self._alpha = fragment_update_alpha
 
         # host ("global") backup of this fragment's params
@@ -205,27 +207,59 @@ class _Fragment:
 
         self._manager.register_state_dict_fn(key, load_fn, save_fn)
 
+    def _device_pseudograds(self) -> bool:
+        """True when this fragment's pseudogradients should stay on
+        device for the quantized sync: explicit ``device_quantize``
+        wins, else auto — quantized leg + TPU backend (ROADMAP item 1:
+        the Pallas int8 kernel quantizes on-chip and only the int8
+        payload + row scales cross the device→host boundary, the D2H
+        copies riding the chunk queue of the wire pipeline)."""
+        if not self._should_quantize:
+            return False
+        if self._device_quantize is not None:
+            return self._device_quantize
+        return jax.default_backend() == "tpu"
+
     def prepare_sync(self) -> None:
         """Pseudograds = backup - local; kick off the async allreduce
         (reference :402-421)."""
-        local = _to_host(self._fragment_params())
-        pseudograds = jax.tree_util.tree_map(
-            lambda g, l: g.astype(np.float32) - l.astype(np.float32),
-            self.original_parameters,
-            local,
-        )
+        if self._device_pseudograds():
+            # compute backup - local ON DEVICE (one H2D of the host
+            # backup) so the quantized collective takes the Pallas
+            # device-quantize path: the f32 pseudograds never cross PCIe
+            import jax.numpy as jnp
+
+            local = self._fragment_params()
+            pseudograds = jax.tree_util.tree_map(
+                lambda g, l: jnp.asarray(g, dtype=jnp.float32)
+                - jnp.asarray(l, dtype=jnp.float32),
+                self.original_parameters,
+                local,
+            )
+        else:
+            local = _to_host(self._fragment_params())
+            pseudograds = jax.tree_util.tree_map(
+                lambda g, l: g.astype(np.float32) - l.astype(np.float32),
+                self.original_parameters,
+                local,
+            )
         # payload-byte fallback for the wire gauge: both the quantized
         # pipeline AND the unquantized TCP ring now report measured
         # wire_bytes on the Work (f32 vs int8 traffic compares honestly in
         # bench/diagnose), so this only covers PG backends without ring
-        # accounting (e.g. test fakes)
+        # accounting (e.g. test fakes).  Computed from size*itemsize, not
+        # np.asarray — device leaves must not be pulled to host here.
         self._payload_bytes = sum(
-            np.asarray(v).nbytes
+            int(v.size) * np.dtype(v.dtype).itemsize
             for v in jax.tree_util.tree_leaves(pseudograds)
         )
         assert not self._allreduce_work
         self._allreduce_work.append(
-            self._manager.allreduce(pseudograds, should_quantize=self._should_quantize)
+            self._manager.allreduce(
+                pseudograds,
+                should_quantize=self._should_quantize,
+                device_quantize=self._device_quantize,
+            )
         )
 
     def discard_pending_work(self) -> None:
@@ -318,6 +352,11 @@ class DiLoCo:
         fragment_sync_delay: inner steps between kicking off a fragment's
             allreduce and blocking on it ("tau" in Streaming DiLoCo).
         fragment_update_alpha: local/global mixing factor.
+        device_quantize: quantized leg only — compute pseudogradients on
+            device and quantize with the Pallas kernel before the D2H
+            copy.  ``None`` = auto (on for TPU backends); ``False``
+            forces the host codec; ``True`` forces the device path (used
+            by the CPU interpret-mode parity test).
     """
 
     def __init__(
@@ -331,6 +370,7 @@ class DiLoCo:
         should_quantize: bool = False,
         fragment_sync_delay: int = 0,
         fragment_update_alpha: float = 0.0,
+        device_quantize: "Optional[bool]" = None,
     ) -> None:
         if manager._use_async_quorum:
             raise ValueError(
@@ -369,6 +409,7 @@ class DiLoCo:
                 outers[i],
                 should_quantize,
                 fragment_update_alpha,
+                device_quantize=device_quantize,
             )
             for i, keys in enumerate(fragments)
         ]

@@ -666,7 +666,11 @@ class Manager:
     # ------------------------------------------------------------------
 
     def allreduce(
-        self, value: Any, should_quantize: bool = False, reduce_op: str = REDUCE_AVG
+        self,
+        value: Any,
+        should_quantize: bool = False,
+        reduce_op: str = REDUCE_AVG,
+        device_quantize: "Optional[bool]" = None,
     ) -> Work:
         """Fault-tolerant allreduce of an array or pytree of arrays.
 
@@ -674,6 +678,11 @@ class Manager:
         replicas) contribute zeros.  On error the Work completes *cleanly*
         with the input (zeroed) value and the error is tracked for
         ``should_commit`` (reference manager.py:385-467).
+
+        ``device_quantize`` (quantized path only): quantize on-chip with
+        the Pallas kernel before the device→host copy; ``None`` = auto
+        (on when every leaf is a jax array on a TPU backend) — forwarded
+        to :func:`~torchft_tpu.ops.collectives.allreduce_quantized`.
         """
         if self.errored():
             return completed_work(value)
@@ -730,7 +739,10 @@ class Manager:
             if should_quantize:
                 from torchft_tpu.ops.collectives import allreduce_quantized
 
-                work = allreduce_quantized(send_leaves, pg_reduce_op, self._pg)
+                work = allreduce_quantized(
+                    send_leaves, pg_reduce_op, self._pg,
+                    device_quantize=device_quantize,
+                )
             else:
                 work = self._pg.allreduce(send_leaves, pg_reduce_op)
 

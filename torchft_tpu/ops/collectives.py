@@ -81,6 +81,7 @@ import jax.numpy as jnp
 
 from torchft_tpu.ops import codec_pool as _cpool
 from torchft_tpu.ops import quantization as q
+from torchft_tpu.ops import topology as _topo
 from torchft_tpu.parallel.process_group import (
     ProcessGroup,
     REDUCE_AVG,
@@ -109,7 +110,13 @@ def _resolve_chunk_rows(slice_rows: int, cols: int) -> int:
     (>0), else auto from the wire-buffer size target.  Clamped to
     [ceil(slice_rows/_MAX_CHUNKS), slice_rows].  Like
     ``TORCHFT_QUANT_WIRE``, the knob must agree across ranks — divergent
-    chunking desyncs the op streams and fails loudly mid-collective."""
+    chunking desyncs the op streams and fails loudly mid-collective.
+    The auto target is deliberately NOT scaled to the WAN
+    bandwidth-delay product: growing chunks to hide per-message RTT
+    also serializes the codec behind the wire (the overlap r5 built the
+    pipeline for), and the latency bill is the hierarchical plan's to
+    cut — by sending fewer inter-host messages, not bigger ones
+    (docs/benchmarks.md §3d)."""
     rows = env_int("TORCHFT_QUANT_CHUNK_ROWS", 0, minimum=0)
     if rows <= 0:
         rows = max(_AUTO_CHUNK_PAYLOAD_BYTES // max(cols, 1), 1)
@@ -228,6 +235,9 @@ class _ChunkPipeline:
         self.error: "Optional[BaseException]" = None
         self._latch_lock = _lockcheck.lock("quant.pipeline_latch")
         self._last_wire_done: "Optional[float]" = None
+        # per-hop wire-busy accounting (PG worker thread only — the
+        # single-worker FIFO serializes every completion callback)
+        self.hop_wire_s: "Dict[str, float]" = {}
         self.t_call = time.perf_counter()
         # per-wait budget: each PG op enforces its own deadline
         # (pg._timeout), so a stage future unresolved past that plus grace
@@ -257,7 +267,7 @@ class _ChunkPipeline:
             chunks=len(self.chunks),
             error=repr(exc),
         )
-        for futs in (self.ready, self.reduce_done, self.dequant_done):
+        for futs in self._stage_future_lists():
             for f in futs:
                 try:
                     f.set_exception(exc)
@@ -267,6 +277,11 @@ class _ChunkPipeline:
             self.out_fut.set_exception(exc)
         except Exception:  # noqa: BLE001 - already resolved
             pass
+
+    def _stage_future_lists(self) -> "Tuple[List[Future], ...]":
+        """Every stage-future list ``abort`` must fail so no waiter
+        hangs; plan pipelines extend this with their hop stages."""
+        return (self.ready, self.reduce_done, self.dequant_done)
 
     def _await(self, fut: Future) -> None:
         try:
@@ -315,12 +330,15 @@ class _ChunkPipeline:
             f.add_done_callback(_one)
 
     def submit_wire(
-        self, hop: str, k: int, work: Work, nbytes: int, submit_t: float,
-        on_ok: "Callable[[Any], None]",
+        self, op: str, hop: str, k: int, work: Work, nbytes: int,
+        submit_t: float, on_ok: "Callable[[Any], None]",
     ) -> None:
         """Attach the wire-accounting completion callback to a PG op: the
         op's *execution* interval is [max(submit, previous completion),
-        completion] — exact under the PG's single-worker FIFO."""
+        completion] — exact under the PG's single-worker FIFO.  ``op`` is
+        the PG primitive (alltoall/allgather/send/recv/sendrecv), ``hop``
+        the reduction-plan stage it serves (``flat`` on the flat
+        schedule; ``intra.*``/``inter.*`` on hierarchical plans)."""
 
         def _cb(f: Future) -> None:
             t1 = time.perf_counter()
@@ -330,14 +348,16 @@ class _ChunkPipeline:
             wire_s = max(t1 - t0, 0.0)
             if t1 > t0:
                 self.trace.add_wire(t0, t1)
+            self.hop_wire_s[hop] = self.hop_wire_s.get(hop, 0.0) + wire_s
             _metrics.QUANT_WIRE_SECONDS.labels(
-                op=hop, wire=self.wire_dtype
+                op=op, hop=hop, wire=self.wire_dtype
             ).observe(wire_s)
             exc = f.exception()
             _flightrec.record(
                 "quant.chunk",
                 status="ok" if exc is None else "error",
                 collective=self.collective,
+                pg_op=op,
                 hop=hop,
                 chunk=k,
                 chunks=len(self.chunks),
@@ -365,7 +385,7 @@ class _ChunkPipeline:
         )
         t = time.perf_counter()
         self.submit_wire(
-            "alltoall", k, self.pg.alltoall(bufs), nbytes, t,
+            "alltoall", "flat", k, self.pg.alltoall(bufs), nbytes, t,
             lambda received: self.on_alltoall(k, received),
         )
 
@@ -440,7 +460,7 @@ class _ChunkPipeline:
         nbytes = (self.world - 1) * piece.nbytes
         t = time.perf_counter()
         self.submit_wire(
-            "allgather", k, self.pg.allgather(piece), nbytes, t,
+            "allgather", "flat", k, self.pg.allgather(piece), nbytes, t,
             lambda gathered: self.on_allgather(k, gathered, full_mat, bounds),
         )
 
@@ -665,6 +685,9 @@ class _ChunkPipeline:
             codec_s=codec_s,
             wire_s=wire_s,
             overlap_efficiency=efficiency,
+            hop_wire_s={
+                h: round(v, 6) for h, v in sorted(self.hop_wire_s.items())
+            },
         )
         _metrics.QUANT_OVERLAP_EFFICIENCY.labels(wire=self.wire_dtype).set(
             efficiency
@@ -679,6 +702,470 @@ class _ChunkPipeline:
             wire_s=round(wire_s, 6),
             overlap_efficiency=round(efficiency, 4),
         )
+
+
+class _HierPipeline(_ChunkPipeline):
+    """Topology-aware multi-hop pipeline: executes a synthesized
+    :class:`~torchft_tpu.ops.topology.ReductionPlan` per chunk instead of
+    the flat alltoall/allgather schedule.
+
+    Rows are sliced per *group* (slice ``j`` owned by group ``j``'s
+    leader); a chunk covers rows ``[a, b)`` of every slice at once, so
+    one chunk's working set is a stacked ``(m*ck, cols)`` block.  Hops
+    per chunk (ops/topology.py module docstring): ``intra.reduce`` →
+    ``inter.exchange`` → ``inter.gather`` → ``intra.bcast``, with
+    requantization at each hop boundary.  The driver staggers hops
+    across chunks (intra hops of chunk k overlap inter wire of chunk
+    k-1), submitting every rank's ops in the same global (chunk, hop)
+    interleave so per-socket op streams stay consistent.
+
+    All ranks dequantize the same reduced-piece bytes at the end, so the
+    result is bit-identical across every rank of the collective — the
+    property the hierarchical golden fixture pins.
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        wire_dtype: str,
+        divisor: int,
+        cols: int,
+        chunks: "List[Tuple[int, int]]",
+        plan: Any,
+        bounds: "List[Tuple[int, int]]",
+        full_mat: np.ndarray,
+    ) -> None:
+        super().__init__(pg, "allreduce", wire_dtype, divisor, cols, chunks)
+        self.plan = plan
+        self.topo = plan.topology
+        self.m = self.topo.n_groups
+        self.gidx = plan.group_index
+        self.is_leader = plan.is_leader
+        self.leader_rank = self.topo.leader(self.gidx)
+        self.bounds = bounds
+        self.full_mat = full_mat
+        k = len(chunks)
+        # hop-stage futures (the driver's gates); abort fails them all
+        self.s1 = [Future() for _ in range(k)]  # intra reduce complete
+        self.s2 = [Future() for _ in range(k)]  # own slice reduced+requant
+        self.s3 = [Future() for _ in range(k)]  # all pieces held
+        self.s4 = [Future() for _ in range(k)]  # chunk dequantized
+        self._s1_bufs: "List[List[Optional[np.ndarray]]]" = [[] for _ in range(k)]
+        self._s1_remaining = [0] * k
+        self._exch_recv: "List[List[Optional[np.ndarray]]]" = [[] for _ in range(k)]
+        self._s2_remaining = [0] * k
+        self._pieces_all: "List[List[Optional[np.ndarray]]]" = [
+            [None] * self.m for _ in range(k)
+        ]
+        self._s3_remaining = [0] * k
+        self._s4_parts = [0] * k
+        self._s4_send_remaining = [0] * k
+        self.stats["topology"] = self.topo.describe()
+        self.stats["plan"] = plan.describe()
+
+    def _stage_future_lists(self) -> "Tuple[List[Future], ...]":
+        return super()._stage_future_lists() + (
+            self.s1, self.s2, self.s3, self.s4,
+        )
+
+    # -- hop 1: intra.reduce ---------------------------------------------
+
+    def submit_intra_reduce(self, k: int) -> None:
+        a, b = self.chunks[k]
+        ck = b - a
+        rows = self.m * ck
+        if not self.is_leader:
+            bufs = self.send_bufs[k]
+            assert bufs is not None
+            buf = bufs[0]
+            t = time.perf_counter()
+            self.submit_wire(
+                "send", "intra.reduce", k,
+                self.pg.send(buf, self.leader_rank, tag=4 * k),
+                buf.nbytes, t,
+                lambda _res, k=k, buf=buf: self._intra_send_done(k, buf),
+            )
+            return
+        members = self.plan.hops[0].recvs
+        if not members:
+            self._intra_reduce_ready(k, [])
+            return
+        with self._latch_lock:
+            self._s1_remaining[k] = len(members)
+            self._s1_bufs[k] = [None] * len(members)
+        nbytes = q.packed_nbytes(rows, self.cols)
+        for i, rm in enumerate(members):
+            t = time.perf_counter()
+            self.submit_wire(
+                "recv", "intra.reduce", k, self.pg.recv(rm, tag=4 * k),
+                nbytes, t,
+                lambda buf, k=k, i=i: self._intra_recv_one(k, i, buf),
+            )
+
+    def _intra_send_done(self, k: int, buf: np.ndarray) -> None:
+        _POOL.give(buf)
+        self.send_bufs[k] = None
+        self.s1[k].set_result(None)
+
+    def _intra_recv_one(self, k: int, i: int, buf: np.ndarray) -> None:
+        q.validate_packed(buf, self.wire_dtype)
+        with self._latch_lock:
+            self._s1_bufs[k][i] = buf
+            self._s1_remaining[k] -= 1
+            last = self._s1_remaining[k] == 0
+        if last:
+            # single codec batch over ALL member bufs once the last one
+            # landed: recvs serialize on the PG worker anyway, and one
+            # batch keeps concurrent += off overlapping acc rows
+            self._intra_reduce_ready(k, list(self._s1_bufs[k]))
+            self._s1_bufs[k] = []
+
+    def _intra_reduce_ready(
+        self, k: int, member_bufs: "List[Optional[np.ndarray]]"
+    ) -> None:
+        a, b = self.chunks[k]
+        ck = b - a
+        rows = self.m * ck
+        acc = self.accs[k]
+        own_bufs: "List[np.ndarray]" = []
+        if acc is None:
+            # device-quantize path: the leader's own contribution is a
+            # packed wire buffer too (quantized on-chip in one launch)
+            own_bufs = list(self.send_bufs[k] or [])
+            self.send_bufs[k] = None
+            acc = _POOL.take((rows, self.cols), np.float32)
+            self.accs[k] = acc
+            overwrite_first = True
+        else:
+            overwrite_first = False
+        bufs = own_bufs + [m for m in member_bufs if m is not None]
+        if not bufs:
+            self.s1[k].set_result(None)
+            return
+        t_red = time.perf_counter()
+
+        def block(r0: int, r1: int) -> None:
+            ow = overwrite_first
+            for buf in bufs:
+                q.fma_rows_packed(
+                    buf, rows, self.cols, r0, r1, self.wire_dtype,
+                    acc, r0, overwrite=ow,
+                )
+                ow = False
+
+        futs = _cpool.run_blocks(rows, block, self.trace, lane="rx")
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="reduce", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_red)
+            for buf in bufs:
+                _POOL.give(buf)
+
+        self.chain(futs, done, self.s1[k])
+
+    # -- hop 2: inter.exchange -------------------------------------------
+
+    def submit_inter_exchange(self, k: int) -> None:
+        if not self.is_leader:
+            self.s2[k].set_result(None)
+            return
+        if self.m == 1:
+            self._finalize_own_slice(k, [])
+            return
+        a, b = self.chunks[k]
+        ck = b - a
+        acc = self.accs[k]
+        assert acc is not None
+        # requantize each foreign group's slice of the partial sum (the
+        # hop-boundary requant), then pairwise-exchange with the other
+        # leaders in the plan's offset order
+        ex_bufs: "Dict[int, np.ndarray]" = {}
+        futs_by_g: "Dict[int, List[Future]]" = {}
+        t_q = time.perf_counter()
+        for j in range(self.m):
+            if j == self.gidx:
+                continue
+            buf = q.new_packed(ck, self.cols, self.wire_dtype, pool=_POOL)
+            ex_bufs[j] = buf
+
+            def requant(r0: int, r1: int, buf=buf, off=j * ck) -> None:
+                q.quantize_rows_packed(
+                    acc, off + r0, buf, ck, self.cols, r0, r1,
+                    self.wire_dtype,
+                )
+
+            futs_by_g[j] = _cpool.run_blocks(ck, requant, self.trace)
+        self.chain(
+            [f for fs in futs_by_g.values() for f in fs],
+            lambda: _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="quantize", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_q),
+            Future(),
+        )
+        hop = self.plan.hops[1]
+        with self._latch_lock:
+            self._s2_remaining[k] = self.m - 1
+            self._exch_recv[k] = [None] * (self.m - 1)
+        for o, (dst, src) in enumerate(zip(hop.sends, hop.recvs)):
+            dst_g = self.topo.group_index(dst)
+            self.wait_captured(futs_by_g[dst_g])
+            buf = ex_bufs[dst_g]
+            t = time.perf_counter()
+            self.submit_wire(
+                "sendrecv", "inter.exchange", k,
+                self.pg.sendrecv(buf, dst, src, tag=4 * k + 1),
+                buf.nbytes, t,
+                lambda rbuf, k=k, o=o, sbuf=buf: self._exch_one(
+                    k, o, sbuf, rbuf
+                ),
+            )
+
+    def _exch_one(
+        self, k: int, o: int, sent: np.ndarray, rbuf: np.ndarray
+    ) -> None:
+        if rbuf is not sent:  # degraded PGs may alias the input back
+            _POOL.give(sent)
+        q.validate_packed(rbuf, self.wire_dtype)
+        with self._latch_lock:
+            self._exch_recv[k][o] = rbuf
+            self._s2_remaining[k] -= 1
+            last = self._s2_remaining[k] == 0
+        if last:
+            self._finalize_own_slice(
+                k, [x for x in self._exch_recv[k] if x is not None]
+            )
+            self._exch_recv[k] = []
+
+    def _finalize_own_slice(
+        self, k: int, rbufs: "List[np.ndarray]"
+    ) -> None:
+        """Fold peer leaders' partial sums into the own slice, divide
+        (AVG fusion), requantize into the broadcast piece."""
+        a, b = self.chunks[k]
+        ck = b - a
+        g = self.gidx
+        acc = self.accs[k]
+        assert acc is not None
+        piece = q.new_packed(ck, self.cols, self.wire_dtype, pool=_POOL)
+        self.pieces[k] = piece
+        t_red = time.perf_counter()
+
+        def block(r0: int, r1: int) -> None:
+            for rbuf in rbufs:
+                q.fma_rows_packed(
+                    rbuf, ck, self.cols, r0, r1, self.wire_dtype,
+                    acc, g * ck + r0, overwrite=False,
+                )
+            if self.divisor:
+                q.div_rows(acc, g * ck + r0, g * ck + r1, self.divisor)
+            q.quantize_rows_packed(
+                acc, g * ck + r0, piece, ck, self.cols, r0, r1,
+                self.wire_dtype,
+            )
+
+        futs = _cpool.run_blocks(ck, block, self.trace, lane="rx")
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="reduce", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_red)
+            seen = set()
+            for rbuf in rbufs:
+                if id(rbuf) not in seen:
+                    seen.add(id(rbuf))
+                    _POOL.give(rbuf)
+            # every slice is now either requantized (sent or piece) —
+            # the f32 accumulator is scratch from here
+            _POOL.give(acc)
+            self.accs[k] = None
+
+        self.chain(futs, done, self.s2[k])
+
+    # -- hop 3: inter.gather ---------------------------------------------
+
+    def submit_inter_gather(self, k: int) -> None:
+        if not self.is_leader:
+            self.s3[k].set_result(None)
+            return
+        piece = self.pieces[k]
+        assert piece is not None
+        self._pieces_all[k][self.gidx] = piece
+        if self.m == 1:
+            self.s3[k].set_result(None)
+            return
+        hop = self.plan.hops[2]
+        with self._latch_lock:
+            self._s3_remaining[k] = self.m - 1
+        for dst, src in zip(hop.sends, hop.recvs):
+            src_g = self.topo.group_index(src)
+            t = time.perf_counter()
+            self.submit_wire(
+                "sendrecv", "inter.gather", k,
+                self.pg.sendrecv(piece, dst, src, tag=4 * k + 2),
+                piece.nbytes, t,
+                lambda rbuf, k=k, src_g=src_g: self._gather_one(
+                    k, src_g, rbuf
+                ),
+            )
+
+    def _gather_one(self, k: int, src_g: int, rbuf: np.ndarray) -> None:
+        q.validate_packed(rbuf, self.wire_dtype)
+        with self._latch_lock:
+            self._pieces_all[k][src_g] = rbuf
+            self._s3_remaining[k] -= 1
+            last = self._s3_remaining[k] == 0
+        if last:
+            self.s3[k].set_result(None)
+
+    # -- hop 4: intra.bcast ----------------------------------------------
+
+    def _s4_part_done(self, k: int) -> None:
+        with self._latch_lock:
+            self._s4_parts[k] -= 1
+            last = self._s4_parts[k] == 0
+        if last:
+            self.s4[k].set_result(None)
+
+    def submit_intra_bcast(self, k: int) -> None:
+        a, b = self.chunks[k]
+        ck = b - a
+        pn = q.packed_nbytes(ck, self.cols)
+        if not self.is_leader:
+            t = time.perf_counter()
+            with self._latch_lock:
+                self._s4_parts[k] = 1
+            self.submit_wire(
+                "recv", "intra.bcast", k,
+                self.pg.recv(self.leader_rank, tag=4 * k + 3),
+                self.m * pn, t,
+                lambda bundle, k=k: self._bcast_recv(k, bundle),
+            )
+            return
+        pieces = self._pieces_all[k]
+        assert all(p is not None for p in pieces)
+        members = self.plan.hops[3].sends
+        with self._latch_lock:
+            self._s4_parts[k] = 1 + (1 if members else 0)
+            self._s4_send_remaining[k] = len(members)
+        if members:
+            bundle = _POOL.take(self.m * pn, np.uint8)
+            for j, p in enumerate(pieces):
+                bundle[j * pn : (j + 1) * pn] = p
+            for rm in members:
+                t = time.perf_counter()
+                self.submit_wire(
+                    "send", "intra.bcast", k,
+                    self.pg.send(bundle, rm, tag=4 * k + 3),
+                    bundle.nbytes, t,
+                    lambda _res, k=k, bundle=bundle: self._bcast_send_done(
+                        k, bundle
+                    ),
+                )
+        self._dequant_pieces(k, list(pieces), give=pieces, owner=True)
+
+    def _bcast_send_done(self, k: int, bundle: np.ndarray) -> None:
+        with self._latch_lock:
+            self._s4_send_remaining[k] -= 1
+            last = self._s4_send_remaining[k] == 0
+        if last:
+            _POOL.give(bundle)
+            self._s4_part_done(k)
+
+    def _bcast_recv(self, k: int, bundle: np.ndarray) -> None:
+        a, b = self.chunks[k]
+        ck = b - a
+        pn = q.packed_nbytes(ck, self.cols)
+        pieces = [bundle[j * pn : (j + 1) * pn] for j in range(self.m)]
+        self._dequant_pieces(k, pieces, give=[bundle], owner=False)
+
+    def _dequant_pieces(
+        self,
+        k: int,
+        pieces: "List[np.ndarray]",
+        give: "List[Optional[np.ndarray]]",
+        owner: bool,
+    ) -> None:
+        """Dequantize every slice's reduced piece straight into its
+        offset of the full output matrix (same bytes on every rank →
+        bit-identical results across the collective)."""
+        a, b = self.chunks[k]
+        ck = b - a
+        for p in pieces:
+            q.validate_packed(p, self.wire_dtype)
+        t_dq = time.perf_counter()
+        futs: "List[Future]" = []
+        for j, p in enumerate(pieces):
+            base = self.bounds[j][0] + a
+
+            def blk(r0: int, r1: int, p=p, base=base) -> None:
+                q.dequant_rows_into(
+                    p, ck, self.cols, r0, r1, self.wire_dtype,
+                    self.full_mat, base + r0,
+                )
+
+            futs += _cpool.run_blocks(ck, blk, self.trace, lane="rx")
+
+        def done() -> None:
+            _metrics.QUANT_CODEC_SECONDS.labels(
+                stage="dequant", wire=self.wire_dtype
+            ).observe(time.perf_counter() - t_dq)
+            seen = set()
+            for buf in give:
+                if buf is not None and id(buf) not in seen:
+                    seen.add(id(buf))
+                    _POOL.give(buf)
+            if owner:
+                self.pieces[k] = None
+                self._pieces_all[k] = [None] * self.m
+            self._s4_part_done(k)
+
+        self.chain(futs, done, Future())
+
+    # -- driver ----------------------------------------------------------
+
+    def drive(
+        self,
+        on_finish: "Callable[[], Any]",
+        full_mat: "Optional[np.ndarray]" = None,
+        bounds: "Optional[List[Tuple[int, int]]]" = None,
+    ) -> None:
+        """Plan-driven driver: tick t submits intra.reduce(t),
+        inter.exchange(t-1), inter.gather(t-2), intra.bcast(t-3) — the
+        stagger that overlaps chunk k's intra hops with chunk k-1's
+        inter-host wire.  Every rank runs the identical loop, so the
+        global submission interleave is uniform (per-socket stream
+        consistency) and a chaos abort leaves all ranks at the same
+        stream position (PG reuse after a mid-pipeline fault)."""
+        try:
+            n = len(self.chunks)
+            for t in range(n + 3):
+                if self.error is not None:
+                    return
+                if t < n:
+                    # same chaos contract as the flat driver, per chunk
+                    _faults.check("pg.allreduce")
+                    _faults.check("pg.allreduce.chunk", step=t)
+                    self._await(self.ready[t])
+                    self.submit_intra_reduce(t)
+                if 0 <= t - 1 < n:
+                    self._await(self.s1[t - 1])
+                    # per-hop chaos: fired before the inter-host hops of
+                    # this chunk are submitted (step = chunk index)
+                    _faults.check("pg.allreduce.hop", step=t - 1)
+                    self.submit_inter_exchange(t - 1)
+                if 0 <= t - 2 < n:
+                    self._await(self.s2[t - 2])
+                    self.submit_inter_gather(t - 2)
+                if 0 <= t - 3 < n:
+                    self._await(self.s3[t - 3])
+                    self.submit_intra_bcast(t - 3)
+            for fut in self.s4:
+                self._await(fut)
+            self.finish_stats()
+            self.out_fut.set_result(on_finish())
+        except BaseException as e:  # noqa: BLE001 - funnel
+            self.abort(e)
 
 
 def _attach_accounting(
@@ -697,6 +1184,23 @@ def _attach_accounting(
     return work
 
 
+def _resolve_topology(
+    topology: "None | str | _topo.Topology", world: int
+) -> "Optional[_topo.Topology]":
+    """Explicit Topology object, spec string, or (None) the
+    ``TORCHFT_TOPOLOGY`` env default — ``None`` result = flat."""
+    if isinstance(topology, _topo.Topology):
+        if topology.world != world:
+            raise ValueError(
+                f"topology describes {topology.world} ranks, "
+                f"collective world is {world}"
+            )
+        return topology
+    if isinstance(topology, str):
+        return _topo.parse_topology(topology, world)
+    return _topo.resolve_topology(world)
+
+
 def allreduce_quantized(
     arrays: "List[Any]",
     op: str,
@@ -704,6 +1208,7 @@ def allreduce_quantized(
     average_by: "int | None" = None,
     device_quantize: "Optional[bool]" = None,
     wire_dtype: "Optional[str]" = None,
+    topology: "None | str | _topo.Topology" = None,
 ) -> Work:
     """8-bit quantized allreduce of a list of float arrays.
 
@@ -727,6 +1232,14 @@ def allreduce_quantized(
             format on the DCN wire (same byte count either way; the
             reference's fp8e4nv/int8 pair, torchft/quantization.py:30-41).
             Defaults to ``TORCHFT_QUANT_WIRE`` when set.
+        topology: wire topology selecting the reduction plan — a
+            :class:`~torchft_tpu.ops.topology.Topology`, a spec string
+            (``TORCHFT_TOPOLOGY`` grammar), or None for the env default.
+            Flat (unset) runs today's alltoall/allgather schedule
+            bit-identically; a grouped topology runs the hierarchical
+            multi-hop plan (intra-host reduce → inter-host leader
+            exchange → intra-host broadcast, requantizing at hop
+            boundaries).  Must agree across ranks.
     """
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized allreduce supports sum/avg, got {op}")
@@ -773,6 +1286,12 @@ def allreduce_quantized(
         )
         return _attach_accounting(solo, None, 0, 0, wire_dtype)
     cols = 2048 if total >= 2048 else max(total, 1)
+    topo = _resolve_topology(topology, world)
+    if topo is not None:
+        return _allreduce_hier(
+            arrays, pg, topo, divisor, device_quantize, wire_dtype,
+            shapes, sizes, out_dtypes, total, cols,
+        )
     rows = -(-total // cols)
     # pad rows to a multiple of world so row-slices are even
     rows = -(-rows // world) * world
@@ -891,6 +1410,184 @@ def allreduce_quantized(
     )
 
 
+def _allreduce_hier(
+    arrays: "List[Any]",
+    pg: ProcessGroup,
+    topo: "_topo.Topology",
+    divisor: int,
+    device_quantize: bool,
+    wire_dtype: str,
+    shapes: "List[Tuple[int, ...]]",
+    sizes: "List[int]",
+    out_dtypes: "List[Any]",
+    total: int,
+    cols: int,
+) -> Work:
+    """Hierarchical-plan body of :func:`allreduce_quantized`: rows are
+    sliced per GROUP (padded to a multiple of the group count) and the
+    synthesized plan runs per chunk on a :class:`_HierPipeline`."""
+    rank = pg.rank()
+    m = topo.n_groups
+    rows = -(-total // cols)
+    # pad rows to a multiple of the group count so group slices are even
+    rows = -(-rows // m) * m
+    bounds = _slice_rows(rows, m)
+    slice_rows = rows // m
+    chunks = _chunk_bounds(slice_rows, _resolve_chunk_rows(slice_rows, cols))
+    plan = _topo.synthesize_plan(topo, rank)
+    # The full output matrix escapes to the caller as views — never pooled.
+    full_mat = np.empty((rows, cols), dtype=np.float32)
+    pipe = _HierPipeline(
+        pg, wire_dtype, divisor, cols, chunks, plan, bounds, full_mat
+    )
+
+    capture_futs: "List[Future]" = []
+    if device_quantize:
+        from torchft_tpu.ops import pallas_quant as pq
+
+        flat_dev = jnp.concatenate(
+            [jnp.ravel(a).astype(jnp.float32) for a in arrays]
+        )
+        mat = (
+            jnp.zeros((rows * cols,), jnp.float32)
+            .at[: flat_dev.size]
+            .set(flat_dev)
+        )
+        scales_dev, payload_dev = pq.fused_quantize_into_int8(
+            mat.reshape(rows, cols)
+        )
+        for k, (a, b) in enumerate(chunks):
+            ck = b - a
+            t_cap = time.perf_counter()
+            buf = q.new_packed(m * ck, cols, wire_dtype, pool=_POOL)
+            pipe.send_bufs[k] = [buf]
+            futs_k: "List[Future]" = []
+            for j in range(m):
+                g0 = bounds[j][0] + a
+
+                def copy_chunk(
+                    r0: int, r1: int, g0=g0, buf=buf, off=j * ck, ck=ck
+                ) -> None:
+                    # device→host hop of this chunk's slice rows, stacked
+                    # at the slice's offset of the packed stage-1 buffer
+                    sc, pl = q._packed_views(buf, m * ck, cols, wire_dtype)
+                    sc[off + r0 : off + r1] = np.asarray(
+                        scales_dev[g0 + r0 : g0 + r1]
+                    )
+                    pl[off + r0 : off + r1] = np.asarray(
+                        payload_dev[g0 + r0 : g0 + r1]
+                    )
+
+                futs_k += _cpool.run_blocks(
+                    ck, copy_chunk, pipe.trace, min_rows=ck
+                )
+            pipe.capture_chunk(k, futs_k, [], t_cap)
+            capture_futs += futs_k
+    else:
+        np_arrays = [np.asarray(a) for a in arrays]
+        if (
+            len(np_arrays) == 1
+            and np_arrays[0].dtype == np.float32
+            and np_arrays[0].flags.c_contiguous
+        ):
+            src = np_arrays[0].ravel()
+        else:
+            src = np.concatenate(
+                [a.astype(np.float32, copy=False).ravel() for a in np_arrays]
+            )
+        full_rows = src.size // cols
+        src2d = src[: full_rows * cols].reshape(full_rows, cols)
+        for k, (a, b) in enumerate(chunks):
+            ck = b - a
+            t_cap = time.perf_counter()
+            futs_k = []
+            give_after: "List[np.ndarray]" = []
+            if pipe.is_leader:
+                # leader contribution stays raw f32 (zero codec time and
+                # zero quantization error on own data, like the flat
+                # pipeline's own slice)
+                acc = _POOL.take((m * ck, cols), np.float32)
+                pipe.accs[k] = acc
+            else:
+                buf = q.new_packed(m * ck, cols, wire_dtype, pool=_POOL)
+                pipe.send_bufs[k] = [buf]
+            for j in range(m):
+                g0 = bounds[j][0] + a
+                if g0 + ck > full_rows:
+                    tail = _POOL.take((ck, cols), np.float32)
+                    give_after.append(tail)
+                    _fill_tail(src, tail, g0, cols)
+                    block_src, row0 = tail, 0
+                else:
+                    block_src, row0 = src2d, g0
+                if pipe.is_leader:
+
+                    def copy_own(
+                        r0: int, r1: int, acc=acc, bs=block_src,
+                        row0=row0, off=j * ck,
+                    ) -> None:
+                        np.copyto(
+                            acc[off + r0 : off + r1],
+                            bs[row0 + r0 : row0 + r1],
+                        )
+
+                    futs_k += _cpool.run_blocks(ck, copy_own, pipe.trace)
+                else:
+
+                    def quant_member(
+                        r0: int, r1: int, buf=buf, bs=block_src,
+                        row0=row0, off=j * ck, ck=ck,
+                    ) -> None:
+                        q.quantize_rows_packed(
+                            bs, row0 + r0, buf, m * ck, cols,
+                            off + r0, off + r1, wire_dtype,
+                        )
+
+                    futs_k += _cpool.run_blocks(ck, quant_member, pipe.trace)
+            pipe.capture_chunk(k, futs_k, give_after, t_cap)
+            capture_futs += futs_k
+
+    def assemble() -> "List[np.ndarray]":
+        full = full_mat.ravel()[:total]
+        out = []
+        offset = 0
+        for shape, size, dtype in zip(shapes, sizes, out_dtypes):
+            out.append(
+                np.asarray(
+                    full[offset : offset + size].reshape(shape), dtype=dtype
+                )
+            )
+            offset += size
+        return out
+
+    pipe.start_driver(assemble)
+    pipe.wait_captured(capture_futs)
+
+    out_work = Work(pipe.out_fut)
+    # Egress accounting from the plan (live buffers recycle as the
+    # pipeline drains): members ship one stacked quantized copy up;
+    # leaders pay the two inter-host hops plus the member broadcast.
+    packed_slice = sum(q.packed_nbytes(b - a, cols) for a, b in chunks)
+    packed_stacked = sum(
+        q.packed_nbytes(m * (b - a), cols) for a, b in chunks
+    )
+    if pipe.is_leader:
+        n_members = len(topo.members(pipe.gidx))
+        inter = 2 * (m - 1) * packed_slice
+        wire_bytes = inter + n_members * m * packed_slice
+    else:
+        inter = 0
+        wire_bytes = packed_stacked
+    work = _attach_accounting(
+        out_work, pipe, wire_bytes, 4 * total, wire_dtype,
+        device_quantized=bool(device_quantize),
+    )
+    # inter-host egress alone — the bytes the WAN RTT/bandwidth model
+    # actually charges for; bench reports it next to the hop telemetry
+    work.inter_wire_bytes = inter
+    return work
+
+
 def reduce_scatter_quantized(
     array: Any, op: str, pg: ProcessGroup, wire_dtype: "Optional[str]" = None
 ) -> Work:
@@ -898,7 +1595,10 @@ def reduce_scatter_quantized(
     pipeline without the allgather (reference collectives.py:159-294).
     Resolves to this rank's dequantized row-slice of the reduction.
     ``wire_dtype`` defaults to ``TORCHFT_QUANT_WIRE`` like the allreduce
-    (one env knob, both collectives)."""
+    (one env knob, both collectives).  Always runs the flat plan:
+    reduce-scatter's output contract is per-RANK row slices, which a
+    group-sliced hierarchical plan would redefine — ``TORCHFT_TOPOLOGY``
+    applies to the allreduce only (docs/architecture.md)."""
     if op not in (REDUCE_SUM, REDUCE_AVG):
         raise ValueError(f"quantized reduce_scatter supports sum/avg, got {op}")
     wire_dtype = q.resolve_wire(wire_dtype)

@@ -202,6 +202,18 @@ class ProcessGroup(ABC):
     def alltoall(self, arrays: "List[Any]") -> Work:
         """Exchange: sends arrays[i] to rank i; resolves to received list."""
 
+    def sendrecv(self, array: Any, dst: int, src: int, tag: int = 0) -> Work:
+        """Simultaneous send-to-``dst`` + receive-from-``src`` as ONE op;
+        resolves to the received array.  The deadlock-free pairwise
+        exchange primitive multi-hop reduction plans are built from
+        (ops/topology.py): both directions drain concurrently even when
+        payloads exceed socket buffers, which two serialized send/recv
+        ops on the single worker cannot guarantee.  Backends without a
+        native implementation reject it."""
+        return failed_work(
+            RuntimeError(f"{type(self).__name__} does not support sendrecv")
+        )
+
     @abstractmethod
     def send(self, array: Any, dst: int, tag: int = 0) -> Work: ...
 
@@ -354,6 +366,7 @@ class ProcessGroupTCP(ProcessGroup):
         self,
         timeout: float = 60.0,
         bandwidth_gbps: "Optional[float]" = None,
+        rtt_ms: "Optional[float]" = None,
     ) -> None:
         super().__init__(timeout)
         self._rank = -1
@@ -375,6 +388,27 @@ class ProcessGroupTCP(ProcessGroup):
         self._bucket: "Optional[_TokenBucket]" = (
             _TokenBucket(bandwidth_gbps * 1e9) if bandwidth_gbps else None
         )
+        # WAN latency model (TORCHFT_WIRE_RTT_MS): per-MESSAGE first-byte
+        # delay on the shaped path, charged only on sends that cross a
+        # host/slice boundary of the TORCHFT_TOPOLOGY descriptor (flat /
+        # unset topology = every peer is across a boundary, the
+        # multi-region flat-ring premise).  Deliberately decoupled from
+        # the token bucket: the bucket paces PAYLOAD CHUNKS (bandwidth
+        # debt accumulates per byte), while latency is paid once per
+        # message no matter how many pacing chunks it splits into — so a
+        # K-chunk message costs rtt + bytes/rate, never K*rtt
+        # (tests/test_topology.py pins the composition).  The token
+        # bucket is boundary-scoped the same way: with a declared
+        # topology, BOTH shaping legs model the WAN boundary and
+        # intra-host messages ride the (loopback/ICI-fast) local fabric
+        # unshaped; with flat/unset topology every peer is across the
+        # boundary, so existing shaped setups behave byte-identically.
+        if rtt_ms is None:
+            rtt_ms = env_float("TORCHFT_WIRE_RTT_MS", 0.0)
+        self._rtt_s = max(rtt_ms, 0.0) / 1e3
+        # ranks whose messages cross a topology boundary (computed per
+        # configure from TORCHFT_TOPOLOGY; empty while unconfigured)
+        self._inter_peers: "frozenset[int]" = frozenset()
         # In-flight op handle in the process-wide flight recorder
         # (utils/flightrecorder.py; subsumes the old ad-hoc ``_flight``
         # dict).  The FlightOp serializes its own updates (worker + sender
@@ -398,6 +432,31 @@ class ProcessGroupTCP(ProcessGroup):
         old rate."""
         self._bucket = _TokenBucket(gbps * 1e9) if gbps else None
 
+    def set_rtt(self, rtt_ms: "Optional[float]") -> None:
+        """(Re)set the modeled per-message boundary latency; None/0
+        removes it.  Takes effect from the next send; boundary membership
+        re-derives at the next configure."""
+        self._rtt_s = max(rtt_ms or 0.0, 0.0) / 1e3
+
+    def _boundary_peers(self, rank: int, world: int) -> "frozenset[int]":
+        """Peers across a TORCHFT_TOPOLOGY host/slice boundary — the set
+        BOTH wire-model legs (RTT and token bucket) charge on.
+        Flat/unset topology: every peer (a flat ring spanning regions
+        pays the boundary on every hop — and pre-topology shaped setups
+        keep their exact behavior).  Computed unconditionally per
+        configure: ``set_bandwidth``/``set_rtt`` may arm shaping AFTER
+        membership forms."""
+        if world <= 1:
+            return frozenset()
+        from torchft_tpu.ops.topology import resolve_topology
+
+        topo = resolve_topology(world)
+        if topo is None:
+            return frozenset(r for r in range(world) if r != rank)
+        return frozenset(
+            r for r in range(world) if r != rank and topo.inter(rank, r)
+        )
+
     # -- lifecycle ---------------------------------------------------------
 
     def configure(
@@ -418,6 +477,7 @@ class ProcessGroupTCP(ProcessGroup):
             gen = self._generation
         self._rank = rank
         self._world = world_size
+        self._inter_peers = self._boundary_peers(rank, world_size)
 
         if world_size == 1:
             self._peers = {}
@@ -722,7 +782,18 @@ class ProcessGroupTCP(ProcessGroup):
             send_peer=dst, send_tag=tag, send_bytes=array.nbytes,
             deadline_mono=deadline,
         )
-        bucket = self._bucket
+        wan = dst in self._inter_peers
+        if wan and self._rtt_s > 0.0:
+            # First-byte latency of the WAN model: once per MESSAGE,
+            # before any byte moves, independent of the bandwidth debt
+            # the pacing loop below accrues (K pacing chunks still pay
+            # 1x RTT).  Charged in the sender so a blocked receiver
+            # observes the first byte RTT late, like a real WAN socket.
+            time.sleep(self._rtt_s)
+        # boundary-scoped shaping: only messages crossing the declared
+        # topology boundary ride the modeled WAN link (flat/unset
+        # topology: every peer — see __init__)
+        bucket = self._bucket if wan else None
         if bucket is not None:
             bucket.consume(8 + len(header))
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
@@ -1136,6 +1207,24 @@ class ProcessGroupTCP(ProcessGroup):
 
         return self._submit(run, op="alltoall")
 
+    def sendrecv(self, array: Any, dst: int, src: int, tag: int = 0) -> Work:
+        np_array = _as_numpy(array)
+        deadline_budget = self._timeout
+
+        def run() -> np.ndarray:
+            deadline = time.monotonic() + deadline_budget
+            if dst == self._rank and src == self._rank:
+                return np.ascontiguousarray(np_array).copy()
+            # the same concurrent send+recv primitive the ring steps use:
+            # the send drains on the sender thread while this worker
+            # blocks on the receive, so paired exchanges never deadlock
+            # on full TCP buffers
+            return self._exchange(
+                dst, 2000 + tag, np_array, src, 2000 + tag, deadline
+            )
+
+        return self._submit(run, op="sendrecv")
+
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         np_array = _as_numpy(array)
         deadline_budget = self._timeout
@@ -1218,6 +1307,14 @@ class ProcessGroupWrapper(ProcessGroup):
     def alltoall(self, arrays: "List[Any]") -> Work:
         return self._wrap(
             self._pg.alltoall(arrays), lambda: [_as_numpy(a) for a in arrays]
+        )
+
+    def sendrecv(self, array: Any, dst: int, src: int, tag: int = 0) -> Work:
+        # fallback shaped like the success path: plan exchanges are
+        # same-shape both directions, so the sent array stands in
+        return self._wrap(
+            self._pg.sendrecv(array, dst, src, tag),
+            lambda: _as_numpy(array),
         )
 
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
@@ -1962,6 +2059,9 @@ class ProcessGroupBaby(ProcessGroup):
 
     def alltoall(self, arrays: "List[Any]") -> Work:
         return self._submit("alltoall", [_as_numpy(a) for a in arrays])
+
+    def sendrecv(self, array: Any, dst: int, src: int, tag: int = 0) -> Work:
+        return self._submit("sendrecv", _as_numpy(array), dst, src, tag)
 
     def send(self, array: Any, dst: int, tag: int = 0) -> Work:
         return self._submit("send", _as_numpy(array), dst, tag)

@@ -24,7 +24,12 @@ site                  fires in
 ``manager.heal``      ``Manager._async_quorum`` heal send/recv branches
 ``pg.reconfigure``    ``ProcessGroupTCP.configure`` /
                       ``ProcessGroupBaby.configure``
-``pg.allreduce``      ``Manager.allreduce`` before collective submission
+``pg.allreduce``      ``Manager.allreduce`` before collective submission;
+                      also per chunk in the quantized pipeline drivers
+``pg.allreduce.chunk``  quantized pipeline drivers, per chunk
+                      (``step`` = chunk index)
+``pg.allreduce.hop``  hierarchical plan driver before each chunk's
+                      inter-host hops (``step`` = chunk index)
 ``transport.send``    ``send_checkpoint`` of both checkpoint transports
 ``transport.recv``    ``recv_checkpoint`` of both checkpoint transports
 ``store.barrier``     blocking ``StoreClient.get(wait=True)`` (the
@@ -103,6 +108,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "pg.reconfigure",
     "pg.allreduce",
     "pg.allreduce.chunk",
+    "pg.allreduce.hop",
     "transport.send",
     "transport.recv",
     "store.barrier",

@@ -956,8 +956,10 @@ QUANT_CODEC_SECONDS = histogram(
 QUANT_WIRE_SECONDS = histogram(
     "torchft_quant_wire_seconds",
     "Quantized-collective wire-op execution seconds per pipeline chunk "
-    "by hop (alltoall/allgather) and wire format",
-    ("op", "wire"),
+    "by PG op (alltoall/allgather/send/recv/sendrecv), reduction-plan "
+    "hop (flat, or intra.reduce/inter.exchange/inter.gather/intra.bcast "
+    "on hierarchical plans) and wire format",
+    ("op", "hop", "wire"),
 )
 QUANT_OVERLAP_EFFICIENCY = gauge(
     "torchft_quant_overlap_efficiency",
