@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke
 
 native:
 	$(MAKE) -C native
@@ -31,6 +31,13 @@ tier1:
 	$(PYTHON) -m pytest tests/ -m "not slow" -q
 
 test: tier1
+
+# Distributed-tracing round trip alone: live 2-replica + lighthouse run
+# with a forced heal against the TORCHFT_TRACE_FILE span sink, ONE trace
+# id per step across the fleet, and the diagnose critical-path ledger
+# (docs/observability.md "Distributed tracing").
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tracing_integ.py -q -m "not slow"
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from torchft_tpu.coordination import LighthouseServer, StoreServer
+from torchft_tpu.diagnose import dominant_contributor
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.process_group import (
     REDUCE_SUM,
@@ -79,9 +80,9 @@ OVERHEAD_STEPS = 30
 
 def _phase_delta(manager, prev: "Dict[str, float]"):
     """Per-step phase delta from the NON-destructive ``phase_times()``
-    snapshot (``pop_phase_times`` is deprecated — a destructive drain
-    corrupts any concurrent scraper).  Returns ``(delta, new_snapshot)``;
-    thread the snapshot through the loop."""
+    snapshot (a destructive drain would corrupt any concurrent scraper).
+    Returns ``(delta, new_snapshot)``; thread the snapshot through the
+    loop."""
     cur = manager.phase_times()
     return {k: v - prev.get(k, 0.0) for k, v in cur.items()}, cur
 
@@ -341,6 +342,9 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
             k: round(v / 1e3, 4) for k, v in phase_median.items()
         },
         "recovery_phases_ms": phase_median,
+        # critical-path ledger vocabulary (torchft_tpu/diagnose.py): which
+        # cost category dominated the recovery path this run
+        "recovery_dominant": dominant_contributor(phase_median),
         "steady_step_ms": round(
             statistics.median([r["steady_step_ms"] for r in cycle_results]), 1
         ),
@@ -598,6 +602,9 @@ def bench_overhead(rounds: int = 5) -> "Dict[str, Any]":
         "nonft_step_ms": round(bare_ms, 3),
         "twin_ratio_pct": round(twin_ratio_pct, 2),
         "phases_ms_per_step": phase_ms,
+        # per-leg dominant-ledger-contributor (diagnose.PHASE_CATEGORY);
+        # prefixed because this dict is merged into the top-level result
+        "overhead_dominant": dominant_contributor(phase_ms),
     }
 
 
@@ -1076,6 +1083,12 @@ def _diloco_sync_leg(
             # worst leader's inter-host egress — the bytes the WAN
             # actually carries
             out["inter_wire_gb"] = round(max(inters.values()) / 1e9, 3)
+    # per-leg dominant-ledger-contributor: codec vs wire busy time (the
+    # unquantized leg has no codec, so its sync wall IS wire)
+    wire_est = out.get(
+        "wire_busy_s", max(out["sync_s"] - out["codec_s"], 0.0)
+    )
+    out["dominant"] = "codec" if out["codec_s"] > wire_est else "wire"
     return out
 
 
@@ -1679,6 +1692,21 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x": diloco.get("wire_reduction_x"),
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
+        # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
+        # PHASE_CATEGORY vocabulary): which cost category ate each leg
+        "dominant": {
+            k: v
+            for k, v in {
+                "recovery": result.get("recovery_dominant"),
+                "overhead": result.get("overhead_dominant"),
+                **{
+                    f"diloco.{leg}": legd.get("dominant")
+                    for leg, legd in sorted(diloco.items())
+                    if isinstance(legd, dict) and legd.get("dominant")
+                },
+            }.items()
+            if v
+        },
     }
     if "error" in result:
         out["error"] = str(result["error"])[:200]
@@ -1686,8 +1714,8 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     # fields first rather than shipping an unparseable truncation.
     droppable = [
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
-        "diloco_winners", "crosscheck", "recovery_phases_ms_top",
-        "recovery_cycles_s", "wan",
+        "diloco_winners", "dominant", "crosscheck",
+        "recovery_phases_ms_top", "recovery_cycles_s", "wan",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable

@@ -163,6 +163,16 @@ int tft_lighthouse_set_metrics_provider(int64_t h,
   return 0;
 }
 
+// Install (or clear, with NULL) the process-wide span sink: the native
+// servers' rpc.<method> spans (and any other native emit_span caller) are
+// relayed as one JSON object per span to this callback — the Python side
+// registers a ctypes function that forwards into its trace exporter
+// (torchft_tpu/utils/tracing.py install_native_span_sink).
+int tft_set_span_sink(void (*sink)(const char*)) {
+  tft::set_span_sink(sink);
+  return 0;
+}
+
 // Record a replica group's training progress on its manager server; the
 // heartbeat loop piggybacks it on lighthouse heartbeats (straggler
 // telemetry — see ManagerServer::report_progress).

@@ -97,6 +97,7 @@ class LighthouseServer : public RpcServer {
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
+  const char* server_kind() const override { return "lighthouse"; }
   void handle_http(int fd, const std::string& request_head) override;
   void wake_blocked() override;
 

@@ -268,9 +268,14 @@ Json ManagerServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
       latest_quorum_.reset();
       quorum_error_.clear();
       // The last-arriving rank's request parameters drive the cluster call
-      // (parity with reference src/manager.rs:365-383).
+      // (parity with reference src/manager.rs:365-383).  The detached
+      // thread inherits this request's trace context so the lighthouse
+      // quorum RPC lands in the same per-step trace as the Python
+      // client's round (the thread-local does not cross std::thread).
       inflight_quorums_.fetch_add(1);
-      std::thread([this, member, timeout_ms] {
+      TraceCtx tctx = current_trace();
+      std::thread([this, member, timeout_ms, tctx] {
+        current_trace() = tctx;
         run_quorum(member, timeout_ms);
         inflight_quorums_.fetch_sub(1);
       }).detach();

@@ -90,6 +90,7 @@ class ManagerServer : public RpcServer {
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
+  const char* server_kind() const override { return "manager"; }
   void wake_blocked() override;
 
  private:

@@ -11,6 +11,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdio>
 #include <cstdlib>
 #include <mutex>
 #include <random>
@@ -21,6 +22,94 @@ int64_t now_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+int64_t wall_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---- distributed tracing -------------------------------------------------
+
+TraceCtx& current_trace() {
+  static thread_local TraceCtx ctx;
+  return ctx;
+}
+
+TraceCtx parse_traceparent(const std::string& tp) {
+  // "00-<32 hex>-<16 hex>-<2 hex flags>"; anything malformed parses to an
+  // invalid (ignored) context — a hostile peer must not break the server.
+  TraceCtx out;
+  if (tp.size() != 2 + 1 + 32 + 1 + 16 + 1 + 2) return out;
+  if (tp[2] != '-' || tp[35] != '-' || tp[52] != '-') return out;
+  auto is_hex = [](const std::string& s, size_t off, size_t n) {
+    for (size_t i = off; i < off + n; ++i) {
+      char c = s[i];
+      if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+            (c >= 'A' && c <= 'F')))
+        return false;
+    }
+    return true;
+  };
+  if (!is_hex(tp, 3, 32) || !is_hex(tp, 36, 16) || !is_hex(tp, 53, 2))
+    return out;
+  out.trace_id = tp.substr(3, 32);
+  out.parent_span_id = tp.substr(36, 16);
+  out.sampled = tp.substr(53, 2) != "00";
+  return out;
+}
+
+std::string format_traceparent(const TraceCtx& ctx) {
+  return "00-" + ctx.trace_id + "-" + ctx.parent_span_id + "-" +
+         (ctx.sampled ? "01" : "00");
+}
+
+std::string new_span_id() {
+  static thread_local std::mt19937_64 rng(
+      std::random_device{}() ^
+      static_cast<uint64_t>(
+          std::chrono::steady_clock::now().time_since_epoch().count()));
+  uint64_t v = rng();
+  char buf[17];
+  snprintf(buf, sizeof(buf), "%016llx",
+           static_cast<unsigned long long>(v));
+  return std::string(buf, 16);
+}
+
+namespace {
+std::mutex g_span_sink_mu;
+SpanSink g_span_sink = nullptr;
+std::atomic<bool> g_span_sink_set{false};
+}  // namespace
+
+void set_span_sink(SpanSink sink) {
+  std::lock_guard<std::mutex> g(g_span_sink_mu);
+  g_span_sink = sink;
+  g_span_sink_set.store(sink != nullptr);
+}
+
+bool span_sink_active() { return g_span_sink_set.load(); }
+
+void emit_span(const std::string& name, const TraceCtx& ctx,
+               int64_t start_ns, int64_t end_ns, bool ok,
+               const Json& attributes) {
+  if (!ctx.valid()) return;
+  Json span = Json::object();
+  span["name"] = name;
+  span["trace_id"] = ctx.trace_id;
+  span["span_id"] = new_span_id();
+  span["parent_span_id"] = ctx.parent_span_id;
+  span["start_ns"] = start_ns;
+  span["end_ns"] = end_ns;
+  span["ok"] = ok;
+  span["attributes"] = attributes;
+  std::string doc = span.dump();
+  // Hold the mutex across the call: the Python side clears the sink
+  // before releasing its callback object, and a cleared sink must mean
+  // "no in-flight invocation either".
+  std::lock_guard<std::mutex> g(g_span_sink_mu);
+  if (g_span_sink != nullptr) g_span_sink(doc.c_str());
 }
 
 namespace {
@@ -239,6 +328,8 @@ bool call_rpc(const std::string& addr, const std::string& method,
   req["method"] = method;
   req["params"] = params;
   req["timeout_ms"] = timeout_ms;
+  if (current_trace().valid())
+    req["traceparent"] = format_traceparent(current_trace());
   bool ok = send_frame(fd, req.dump(), deadline, err);
   std::string reply;
   if (ok) ok = recv_frame(fd, &reply, deadline, err);
@@ -281,6 +372,10 @@ Json RpcClient::call(const std::string& method, const Json& params,
     req["method"] = method;
     req["params"] = params;
     req["timeout_ms"] = std::max<int64_t>(deadline - now_ms(), 1);
+    // Propagate this thread's trace context downstream (e.g. the native
+    // manager's lighthouse call continuing the Python client's round).
+    if (current_trace().valid())
+      req["traceparent"] = format_traceparent(current_trace());
     std::string reply;
     if (send_frame(fd_, req.dump(), deadline, &err) &&
         recv_frame(fd_, &reply, deadline, &err)) {
@@ -452,11 +547,24 @@ void RpcServer::serve_conn(int fd) {
                     kFrameBodyTimeoutMs))
       break;
     Json reply = Json::object();
+    // Distributed tracing: continue the request envelope's traceparent —
+    // the handler runs with it bound thread-locally (downstream native
+    // RPC clients re-inject it), and one rpc.<method> span wraps the
+    // handler when a sink is registered.  No context, no cost.
+    std::string span_method;
+    TraceCtx span_ctx;
+    int64_t span_t0 = 0;
     try {
       Json req = Json::parse(payload);
       int64_t timeout_ms = req.get("timeout_ms").as_int(60000);
-      Json result =
-          handle(req.get("method").as_string(), req.get("params"), timeout_ms);
+      std::string method = req.get("method").as_string();
+      span_ctx = parse_traceparent(req.get("traceparent").as_string());
+      if (span_ctx.valid() && span_sink_active()) {
+        span_method = method;
+        span_t0 = wall_ns();
+      }
+      current_trace() = span_ctx;
+      Json result = handle(method, req.get("params"), timeout_ms);
       reply["ok"] = true;
       reply["result"] = result;
     } catch (const TimeoutError& e) {
@@ -466,6 +574,14 @@ void RpcServer::serve_conn(int fd) {
     } catch (const std::exception& e) {
       reply["ok"] = false;
       reply["error"] = std::string(e.what());
+    }
+    current_trace() = TraceCtx{};
+    if (span_t0 != 0) {
+      Json attrs = Json::object();
+      attrs["server"] = server_kind();
+      attrs["method"] = span_method;
+      emit_span("rpc." + span_method, span_ctx, span_t0, wall_ns(),
+                reply.get("ok").as_bool(), attrs);
     }
     std::string out = reply.dump();
     if (!send_frame(fd, out, now_ms() + 60000, nullptr)) break;

@@ -97,6 +97,43 @@ class CondVar {
   pthread_cond_t cv_;
 };
 
+// ---- distributed tracing -------------------------------------------------
+// The framed-JSON request envelope may carry a W3C-traceparent-style
+// context ("traceparent": "00-<32hex trace>-<16hex span>-<flags>").  The
+// server continues it: the handler runs with the parsed context bound
+// thread-locally (so downstream native RPC clients re-inject it), and one
+// "rpc.<method>" span around the handler is emitted through the process
+// span sink — a C callback the Python side registers (tft_set_span_sink)
+// to relay native spans into its exporter, the same provider-callback
+// idiom as the lighthouse /metrics supplement.  Everything here is
+// zero-cost when no context arrives and no sink is registered.
+
+struct TraceCtx {
+  std::string trace_id;        // 32 lowercase hex chars
+  std::string parent_span_id;  // 16 lowercase hex chars
+  bool sampled = false;
+
+  bool valid() const { return sampled && trace_id.size() == 32; }
+};
+
+// This thread's current trace position (request-scoped on server handler
+// threads; explicitly copied onto detached protocol threads).
+TraceCtx& current_trace();
+
+TraceCtx parse_traceparent(const std::string& tp);
+std::string format_traceparent(const TraceCtx& ctx);
+std::string new_span_id();
+int64_t wall_ns();  // unix-epoch wall clock, matches Python time.time_ns()
+
+using SpanSink = void (*)(const char* span_json);
+void set_span_sink(SpanSink sink);
+bool span_sink_active();
+// Emit one finished span (name, parent = ctx, [start_ns, end_ns], status,
+// flat attribute object) to the registered sink; no-op without one.
+void emit_span(const std::string& name, const TraceCtx& ctx,
+               int64_t start_ns, int64_t end_ns, bool ok,
+               const Json& attributes);
+
 // ---- framed message I/O --------------------------------------------------
 // Wire format: 4-byte big-endian length, then that many bytes of UTF-8 JSON.
 
@@ -182,6 +219,9 @@ class RpcServer {
   // TimeoutError produces code "timeout".
   virtual Json handle(const std::string& method, const Json& params,
                       int64_t timeout_ms) = 0;
+  // Label stamped on this server's rpc.* spans ("lighthouse"/"manager"/
+  // "store") so the trace ledger can attribute server time.
+  virtual const char* server_kind() const { return "server"; }
   virtual void handle_http(int fd, const std::string& request_head);
   // Called during shutdown after stopping_ is set and connection fds are
   // closed, before joining connection threads: wake any handler blocked on

@@ -23,6 +23,7 @@ class StoreServer : public RpcServer {
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
+  const char* server_kind() const override { return "store"; }
   void wake_blocked() override;
 
  private:

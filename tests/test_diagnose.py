@@ -549,3 +549,108 @@ class TestDiagnoseChaosSmoke:
         ]
         assert dead_score and dead_score[0] >= 1.0, scores
         lighthouse.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# trace ledger (torchft-diagnose --trace)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, trace, sid, parent, t0_ms, t1_ms, ok=True, **attrs):
+    return {
+        "name": name, "trace_id": trace, "span_id": sid,
+        "parent_span_id": parent, "start_ns": t0_ms * 1_000_000,
+        "end_ns": t1_ms * 1_000_000, "attributes": attrs, "ok": ok,
+    }
+
+
+class TestTraceLedger:
+    """analyze_trace over synthetic span files: category attribution,
+    the quant.pipeline codec/wire substitution, the lighthouse
+    straggler-wait refinement, and the CLI with --trace as the ONLY
+    input."""
+
+    def _write(self, tmp_path, spans):
+        path = tmp_path / "trace.jsonl"
+        path.write_text("".join(json.dumps(s) + "\n" for s in spans))
+        return path
+
+    def test_categories_and_critical_path(self, tmp_path):
+        T = "a" * 32
+        spans = [
+            _span("quorum_round", T, "ra" + "0" * 14, None, 0, 1000,
+                  replica_id="rep_a", step=5, quorum_id=2),
+            _span("quorum_rpc", T, "p1" + "0" * 14, "ra" + "0" * 14, 0, 100,
+                  replica_id="rep_a", step=5),
+            # quant.pipeline REPLACES ring in the sums
+            _span("ring", T, "p2" + "0" * 14, "ra" + "0" * 14, 100, 900,
+                  replica_id="rep_a", step=5),
+            _span("quant.pipeline", T, "p3" + "0" * 14, "ra" + "0" * 14,
+                  100, 900, collective="allreduce", codec_s=0.25,
+                  wire_s=0.55),
+            # faster replica, protocol-dominant
+            _span("quorum_round", T, "rb" + "0" * 14, None, 0, 400,
+                  replica_id="rep_b", step=5, quorum_id=2),
+            _span("commit", T, "p4" + "0" * 14, "rb" + "0" * 14, 0, 300,
+                  replica_id="rep_b", step=5),
+        ]
+        report = diagnose.analyze_trace(spans)
+        assert len(report["steps"]) == 1
+        row = report["steps"][0]
+        assert row["step"] == 5 and row["quorum_id"] == 2
+        assert row["critical_replica"] == "rep_a"
+        a = row["replicas"]["rep_a"]
+        # ring (0.8s) replaced by pipeline codec 0.25 + wire 0.55
+        assert a["categories"]["codec"] == pytest.approx(0.25)
+        assert a["categories"]["wire"] == pytest.approx(0.55)
+        assert a["categories"]["protocol"] == pytest.approx(0.1)
+        assert a["dominant"] == "wire" and row["dominant"] == "wire"
+        assert row["replicas"]["rep_b"]["dominant"] == "protocol"
+        assert report["culprit"] is None
+
+    def test_lighthouse_span_refines_straggler_wait(self, tmp_path):
+        T = "b" * 32
+        spans = [
+            _span("quorum_round", T, "r0" + "0" * 14, None, 0, 1000,
+                  replica_id="rep_a", step=1, quorum_id=1),
+            # the caller blocked 0.9 s; the lighthouse says 0.7 s of that
+            # was waiting for the quorum to form
+            _span("quorum_wait", T, "w0" + "0" * 14, "r0" + "0" * 14, 0, 900,
+                  replica_id="rep_a", step=1),
+            _span("rpc.quorum", T, "l0" + "0" * 14, "r0" + "0" * 14, 0, 700,
+                  server="lighthouse", method="quorum"),
+        ]
+        report = diagnose.analyze_trace(spans)
+        cats = report["steps"][0]["replicas"]["rep_a"]["categories"]
+        # 0.7 measured + 0.2 excess quorum_wait = 0.9 total, not 1.6
+        assert cats["straggler-wait"] == pytest.approx(0.9)
+
+    def test_cli_trace_only_names_culprit(self, tmp_path, capsys):
+        T = "c" * 32
+        spans = [
+            _span("quorum_round", T, "r0" + "0" * 14, None, 0, 500,
+                  replica_id="rep_a", step=2, quorum_id=1),
+            _span("quorum_round", T, "r1" + "0" * 14, None, 0, 400, ok=False,
+                  replica_id="rep_bad", step=2, quorum_id=1),
+        ]
+        path = self._write(tmp_path, spans)
+        rc = diagnose.main(["--trace", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "critical-path ledger" in out
+        # the verdict block names the failed replica, trace-only input
+        assert "LIKELY CULPRIT: rep_bad" in out
+        assert "[trace_error]" in out
+
+    def test_bench_vocabulary_matches(self):
+        """bench.py's per-leg dominant field uses this module's mapping —
+        pin the vocabulary so the tail stays joinable with the ledger."""
+        assert diagnose.dominant_contributor(
+            {"quorum_rpc": 1.0, "ring": 5.0}
+        ) == "wire"
+        assert diagnose.dominant_contributor(
+            {"quorum_wait": 9.0, "commit": 1.0}
+        ) == "straggler-wait"
+        assert diagnose.dominant_contributor({}) is None
+        for cat in diagnose.PHASE_CATEGORY.values():
+            assert cat in diagnose.LEDGER_CATEGORIES

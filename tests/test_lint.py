@@ -116,6 +116,16 @@ _SEEDED = {
             'faults.check("pg.not_a_site")\n'
         ),
     },
+    "span-vocab": {
+        "pkg/manager.py": 'PROTOCOL_PHASES = ("ring", "commit")\n',
+        "pkg/bad.py": textwrap.dedent(
+            """
+            def emit(tracer):
+                # off-vocabulary name AND no flight-recorder reach
+                tracer.export_span("made_up_phase", "t", 0, 1)
+            """
+        ),
+    },
 }
 
 

@@ -86,12 +86,9 @@ def test_metrics_scrape_smoke():
     snap1 = manager.phase_times()
     snap2 = manager.phase_times()
     assert snap1 == snap2 and "commit" in snap1
-    # the destructive drain still works for back-compat, but now warns
-    # (satellite: pop_phase_times deprecation — new code reads
-    # phase_times() or the quorum-duration histogram)
-    with pytest.warns(DeprecationWarning):
-        assert manager.pop_phase_times() == snap1
-    assert manager.phase_times() == {}
+    # pop_phase_times (the destructive drain, deprecated in PR 3) is
+    # gone: phase_times()/the histogram are the only phase surfaces
+    assert not hasattr(manager, "pop_phase_times")
 
 
 class _FakeOTLPCollector:
@@ -179,7 +176,17 @@ def test_otlp_metrics_and_traces_for_full_quorum_round(monkeypatch):
     ]
     names = {s["name"] for s in children}
     assert "quorum_rpc" in names and "commit" in names
-    for s in children + [root]:
+    # phase children (and the root) carry the step/quorum_id correlation
+    # attributes; native rpc.* server spans are legitimate children too
+    # but carry server/method instead (distributed-tracing leg)
+    phase_children = [
+        s for s in children if not s["name"].startswith("rpc.")
+    ]
+    for s in phase_children + [root]:
         attrs = {a["key"] for a in s["attributes"]}
         assert {"step", "quorum_id", "replica_id"} <= attrs
         assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    for s in children:
+        if s["name"].startswith("rpc."):
+            attrs = {a["key"] for a in s["attributes"]}
+            assert {"server", "method"} <= attrs

@@ -1,0 +1,217 @@
+"""Unit tests for the distributed-tracing layer (utils/tracing.py):
+context encoding, deterministic per-step trace ids, sampling, the JSONL
+file sink, thread-local propagation state, and the zero-cost budget of
+the disabled path (same bar discipline as the flight recorder's)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    tracing.uninstall_tracer()
+    yield
+    tracing.uninstall_tracer()
+
+
+class TestTraceContext:
+    def test_traceparent_round_trip(self):
+        ctx = tracing.TraceContext(
+            tracing.new_trace_id(), tracing.new_span_id(), True
+        )
+        tp = ctx.to_traceparent()
+        assert tp.startswith("00-") and tp.endswith("-01")
+        back = tracing.TraceContext.from_traceparent(tp)
+        assert back == ctx
+
+    def test_unsampled_flag(self):
+        ctx = tracing.TraceContext("a" * 32, "b" * 16, sampled=False)
+        assert ctx.to_traceparent().endswith("-00")
+        back = tracing.TraceContext.from_traceparent(ctx.to_traceparent())
+        assert back is not None and not back.sampled
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-span-01",
+            "00-" + "x" * 32 + "-" + "b" * 16 + "-01",  # non-hex trace
+            "00-" + "a" * 31 + "_" + "-" + "b" * 16 + "-01",  # underscore
+            "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span
+            "00-" + "a" * 32 + "-" + "b" * 16 + "-0",  # short flags
+            "00-" + "a" * 32 + "-" + "b" * 16 + "-zz",  # non-hex flags
+            "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",
+            42,
+        ],
+    )
+    def test_malformed_traceparent_parses_to_none(self, bad):
+        assert tracing.TraceContext.from_traceparent(bad) is None
+
+    def test_child_keeps_trace_changes_span(self):
+        ctx = tracing.TraceContext("a" * 32, "b" * 16)
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+
+    def test_step_trace_id_deterministic_and_distinct(self):
+        assert tracing.step_trace_id(7) == tracing.step_trace_id(7)
+        assert tracing.step_trace_id(7) != tracing.step_trace_id(8)
+        assert tracing.step_trace_id(7, "jobA") != tracing.step_trace_id(
+            7, "jobB"
+        )
+        assert len(tracing.step_trace_id(0)) == 32
+        int(tracing.step_trace_id(0), 16)  # valid hex
+
+
+class TestSampling:
+    def test_extremes(self):
+        always = tracing.Tracer(sample=1.0)
+        never = tracing.Tracer(sample=0.0)
+        assert all(always.sample_step(s) for s in range(50))
+        assert not any(never.sample_step(s) for s in range(50))
+
+    def test_deterministic_across_instances(self):
+        """Every replica must make the SAME per-step decision — a sampled
+        step's trace is complete or absent, never partial."""
+        a = tracing.Tracer(sample=0.5)
+        b = tracing.Tracer(sample=0.5)
+        decisions = [a.sample_step(s, "job") for s in range(200)]
+        assert decisions == [b.sample_step(s, "job") for s in range(200)]
+        # a half-rate sampler actually samples some and skips some
+        assert 20 < sum(decisions) < 180
+
+
+class TestFileSpanSink:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        tracer = tracing.Tracer(sink=tracing.FileSpanSink(str(path)))
+        sid = tracer.export_span(
+            "ring", "a" * 32, 100, 200,
+            parent_span_id="b" * 16,
+            attributes={"step": 3, "replica_id": "r0"},
+        )
+        tracer.export_span("commit", "a" * 32, 200, 300, ok=False)
+        tracer.close()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["name"] == "ring"
+        assert lines[0]["span_id"] == sid
+        assert lines[0]["parent_span_id"] == "b" * 16
+        assert lines[0]["attributes"]["step"] == 3
+        assert lines[1]["ok"] is False
+
+    def test_append_across_sinks(self, tmp_path):
+        """Two sinks on one path (≈ two processes sharing the file) must
+        append, not clobber — the O_APPEND contract."""
+        path = tmp_path / "trace.jsonl"
+        for i in range(2):
+            sink = tracing.FileSpanSink(str(path))
+            sink.export({"name": f"s{i}", "trace_id": "t", "span_id": "x",
+                         "start_ns": 0, "end_ns": 1, "ok": True})
+            sink.close()
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+
+    def test_closed_sink_drops_instead_of_reopening(self, tmp_path):
+        """A racing emitter that grabbed the tracer before uninstall must
+        not resurrect the file after close() (that fd would leak)."""
+        path = tmp_path / "trace.jsonl"
+        sink = tracing.FileSpanSink(str(path))
+        sink.export({"name": "ring", "trace_id": "t", "span_id": "s",
+                     "start_ns": 0, "end_ns": 1, "ok": True})
+        sink.close()
+        sink.export({"name": "late", "trace_id": "t", "span_id": "s2",
+                     "start_ns": 0, "end_ns": 1, "ok": True})
+        assert len(path.read_text().splitlines()) == 1
+
+    def test_env_install(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHFT_TRACE_FILE", str(tmp_path / "t.jsonl"))
+        monkeypatch.setenv("TORCHFT_TRACE_SAMPLE", "0.25")
+        tracer = tracing.maybe_install_from_env()
+        assert tracer is not None
+        assert tracer.sink is not None and tracer.exporter is None
+        assert tracer.sample == 0.25
+        assert tracing.get_tracer() is tracer
+
+    def test_env_disabled(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_TRACE_FILE", raising=False)
+        monkeypatch.delenv("TORCHFT_USE_OTEL", raising=False)
+        assert tracing.maybe_install_from_env() is None
+
+
+class TestCurrentContext:
+    def test_no_tracer_means_no_context(self):
+        tracing.set_current(tracing.TraceContext("a" * 32, "b" * 16))
+        try:
+            # fast path: without an installed tracer nothing propagates
+            assert tracing.get_current() is None
+            assert tracing.current_traceparent() is None
+        finally:
+            tracing.set_current(None)
+
+    def test_thread_local(self, tmp_path):
+        tracing.install_tracer(
+            tracing.Tracer(sink=tracing.FileSpanSink(str(tmp_path / "t")))
+        )
+        ctx = tracing.TraceContext("a" * 32, "b" * 16)
+        tracing.set_current(ctx)
+        seen = {}
+
+        def other():
+            seen["ctx"] = tracing.get_current()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert seen["ctx"] is None  # contexts do not leak across threads
+        assert tracing.get_current() == ctx
+        assert tracing.current_traceparent() == ctx.to_traceparent()
+        tracing.set_current(None)
+
+    def test_unsampled_context_not_injected(self, tmp_path):
+        tracing.install_tracer(
+            tracing.Tracer(sink=tracing.FileSpanSink(str(tmp_path / "t")))
+        )
+        tracing.set_current(
+            tracing.TraceContext("a" * 32, "b" * 16, sampled=False)
+        )
+        assert tracing.current_traceparent() is None
+        tracing.set_current(None)
+
+
+class TestDisabledPathBudget:
+    def test_disabled_injection_is_zero_cost(self):
+        """Acceptance bar: the disabled hot path (no tracer installed) —
+        exactly what every RPC call and collective submit runs — must be
+        a single module-global check, ≤ the flight recorder's record()
+        budget (2.5 us; this is ~50 ns in practice).  Best-of-batches so
+        a loaded CI host doesn't flake the measurement."""
+        assert tracing.get_tracer() is None
+        n = 50_000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tracing.current_traceparent()
+                tracing.get_current()
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best <= 2.5e-6, f"disabled trace path {best * 1e9:.0f} ns/call"
+
+    def test_disabled_sampling_check_is_cheap(self):
+        """Manager.start_quorum's disabled path is one get_tracer() call."""
+        assert tracing.get_tracer() is None
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if tracing.get_tracer() is not None:  # pragma: no cover
+                raise AssertionError
+        per = (time.perf_counter() - t0) / n
+        assert per <= 1e-6, f"get_tracer {per * 1e9:.0f} ns/call"

@@ -97,6 +97,12 @@ class TestDriftGateClean:
             "set", "get", "delete_prefix", "num_keys",
         }
         assert set(LOCK["structs"]) == set(_STRUCT_CLASSES)
+        # the request envelope (incl. the distributed-tracing field) is
+        # part of the locked surface
+        assert LOCK["envelope"] == [
+            "method", "params", "timeout_ms", "traceparent",
+        ]
+        assert '"traceparent"?' in LOCK["framing"]
 
 
 class TestSeededDrift:
@@ -159,6 +165,32 @@ class TestSeededDrift:
         assert drifted != docs
         codes = self._codes(docs=drifted)
         assert "method-undocumented" in codes
+
+    def test_python_traceparent_rename_is_caught(self):
+        """The tracing envelope field is machine-checked on the PYTHON
+        side: renaming the injected key means the native server never
+        sees a context again — the gate must bite."""
+        py, *_ = _tree_inputs()
+        drifted = py.replace(
+            'req["traceparent"] = traceparent',
+            'req["trace_parent"] = traceparent',
+        )
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert {"envelope-field-dead", "envelope-field-missing"} <= codes
+
+    def test_native_traceparent_rename_is_caught(self):
+        """...and on the NATIVE side: renaming serve_conn's read breaks
+        continuation (and orphans the native client's own write)."""
+        _py, native, *_ = _tree_inputs()
+        net = native["net.cc"]
+        drifted = dict(native)
+        drifted["net.cc"] = net.replace(
+            'req.get("traceparent")', 'req.get("trace_parent")'
+        )
+        assert drifted["net.cc"] != net
+        codes = self._codes(native=drifted)
+        assert {"envelope-field-dead", "envelope-field-missing"} <= codes
 
     def test_stale_lock_is_caught(self):
         stale = json.loads(json.dumps(LOCK))

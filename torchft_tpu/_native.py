@@ -28,6 +28,19 @@ METRICS_PROVIDER_CFUNC = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.POINTER(ctypes.c_char), ctypes.c_int
 )
 
+# Signature of the distributed-tracing span sink: receives one finished
+# native span as a JSON C string (tracing.py forwards it to the Python
+# exporter).  Called from native RPC handler threads — ctypes acquires
+# the GIL around the Python callable automatically.
+SPAN_SINK_CFUNC = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+
+
+def loaded() -> bool:
+    """True when the native library has already been loaded in this
+    process — lets optional wiring (the tracing span sink) avoid
+    triggering a native build as an import side effect."""
+    return _lib is not None
+
 
 def _build() -> None:
     result = subprocess.run(
@@ -134,6 +147,9 @@ def get_lib() -> ctypes.CDLL:
         lib.tft_lighthouse_set_metrics_provider.argtypes = [
             ctypes.c_int64, METRICS_PROVIDER_CFUNC,
         ]
+
+        lib.tft_set_span_sink.restype = ctypes.c_int
+        lib.tft_set_span_sink.argtypes = [SPAN_SINK_CFUNC]
 
         lib.tft_manager_report_progress.restype = ctypes.c_int
         lib.tft_manager_report_progress.argtypes = [

@@ -27,6 +27,9 @@ file for grandfathered findings — all empty):
 ``wire-drift``            framed-JSON wire schema in sync across Python
                           clients, native servers, docs/protocol.md, and
                           the committed protocol.lock
+``span-vocab``            trace-span names from PROTOCOL_PHASES /
+                          quant.* / heal.* / rpc.*; every span emitter
+                          also feeds the flight recorder
 ========================  ==================================================
 
 The runtime complement is ``utils/lockcheck.py`` (TORCHFT_LOCKCHECK=1
@@ -54,6 +57,7 @@ from torchft_tpu.analysis.lock_discipline import PASS as _lock_discipline
 from torchft_tpu.analysis.metrics_cardinality import PASS as _metrics_cardinality
 from torchft_tpu.analysis.metrics_sync import PASS as _metrics_sync
 from torchft_tpu.analysis.retry_ban import PASS as _retry_ban
+from torchft_tpu.analysis.span_vocab import PASS as _span_vocab
 from torchft_tpu.analysis.wire_schema import PASS as _wire_drift
 
 #: Every registered pass, in documentation order.
@@ -65,6 +69,7 @@ PASSES = (
     _retry_ban,
     _coverage,
     _wire_drift,
+    _span_vocab,
 )
 
 __all__ = [

@@ -66,8 +66,8 @@ LOCK_VERSION = 1
 #: itself a lock drift (coordination.py module docstring + native/net.h).
 WIRE_FRAMING = (
     "4-byte big-endian length + UTF-8 JSON; request "
-    '{"method","params","timeout_ms"}; reply {"ok","result"} | '
-    '{"ok","error","code"?}; max frame 512 MiB'
+    '{"method","params","timeout_ms","traceparent"?}; reply '
+    '{"ok","result"} | {"ok","error","code"?}; max frame 512 MiB'
 )
 
 #: canonical wire types
@@ -348,6 +348,84 @@ def _extract_py_client_method(
             m["result_struct"] = node.func.value.id
 
 
+def extract_py_envelope(source: str) -> "Set[str]":
+    """Request-envelope fields the Python ``_RpcClient`` sends: string
+    keys of dict literals passed to ``json.dumps`` inside
+    ``_RpcClient.call`` plus ``<var>["k"] = ...`` build-up there.  Empty
+    when the source has no ``_RpcClient`` (mini projects)."""
+    fields: "Set[str]" = set()
+    tree = ast.parse(source)
+    for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+        if cls.name != "_RpcClient":
+            continue
+        for fn in ast.walk(cls):
+            if not (
+                isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "call"
+            ):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "dumps"
+                    and node.args
+                ):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Dict):
+                        for key in arg.keys:
+                            if isinstance(key, ast.Constant) and isinstance(
+                                key.value, str
+                            ):
+                                fields.add(key.value)
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].slice, ast.Constant)
+                    and isinstance(node.targets[0].slice.value, str)
+                ):
+                    fields.add(node.targets[0].slice.value)
+                # seed dict of the variable later dumped (req = {...})
+                if (
+                    isinstance(node, ast.AnnAssign)
+                    and isinstance(node.value, ast.Dict)
+                ) or (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            fields.add(key.value)
+    return fields
+
+
+_ENVELOPE_READ_RE = re.compile(r'\breq\.get\("(\w+)"\)')
+_ENVELOPE_WRITE_RE = re.compile(r'\breq\["(\w+)"\]\s*=')
+
+
+def extract_native_envelope(
+    sources: Dict[str, str]
+) -> "Tuple[Set[str], Set[str]]":
+    """(reads, writes) of the request envelope on the native side:
+    ``req.get("k")`` in the server's ``serve_conn`` and ``req["k"] =``
+    in the clients (``RpcClient::call`` / ``call_rpc``) — all in
+    net.cc, where the one framed-envelope implementation lives."""
+    reads: "Set[str]" = set()
+    writes: "Set[str]" = set()
+    for fname, text in sources.items():
+        if os.path.basename(fname) != "net.cc":
+            continue
+        body = _function_body(text, "serve_conn")
+        for m in _ENVELOPE_READ_RE.finditer(body or text):
+            reads.add(m.group(1))
+        for m in _ENVELOPE_WRITE_RE.finditer(text):
+            writes.add(m.group(1))
+    return reads, writes
+
+
 # ---------------------------------------------------------------------------
 # native extraction
 # ---------------------------------------------------------------------------
@@ -549,9 +627,15 @@ def build_lock(
             k: _merge_types(nf.get(k, "any"), pf.get(k, "any"))
             for k in sorted(set(nf) | set(pf))
         }
+    # request-envelope surface (method/params/timeout_ms + the tracing
+    # traceparent field): union of what the Python client sends and the
+    # native server reads — per-side drift is flagged by run_checks.
+    py_env = extract_py_envelope(py_source)
+    n_reads, n_writes = extract_native_envelope(native_sources)
     return {
         "version": LOCK_VERSION,
         "framing": WIRE_FRAMING,
+        "envelope": sorted(py_env | n_reads | n_writes),
         "servers": servers,
         "structs": structs,
     }
@@ -780,6 +864,50 @@ def run_checks(
                     f"{name}.{k}",
                 )
 
+    # ---- request envelope (method/params/timeout_ms/traceparent) --------
+    py_env = extract_py_envelope(py_source)
+    n_reads, n_writes = extract_native_envelope(native_sources)
+    if py_env and n_reads:
+        # an empty side means this project form has no envelope surface
+        # (mini/selftest trees, wheel installs) — nothing to cross-check
+        for field_name in sorted(py_env - n_reads):
+            yield finding(
+                "envelope-field-dead",
+                native_file_of.get("net.cc", "native/net.cc"),
+                f"request-envelope field {field_name!r} is sent by the "
+                f"Python client but never read by the native server "
+                f"(serve_conn)",
+                f"envelope.{field_name}",
+            )
+        for field_name in sorted(n_reads - py_env):
+            yield finding(
+                "envelope-field-missing",
+                py_file,
+                f"request-envelope field {field_name!r} is read by the "
+                f"native server but never sent by the Python client",
+                f"envelope.{field_name}",
+            )
+        for field_name in sorted(n_writes - n_reads):
+            yield finding(
+                "envelope-field-dead",
+                native_file_of.get("net.cc", "native/net.cc"),
+                f"request-envelope field {field_name!r} is written by the "
+                f"native client but never read by the native server",
+                f"envelope.{field_name}",
+            )
+        if docs_text:
+            for field_name in sorted(py_env | n_reads):
+                if not re.search(
+                    "[`\"]" + re.escape(field_name) + "[`\"]", docs_text
+                ):
+                    yield finding(
+                        "envelope-undocumented",
+                        docs_file,
+                        f"request-envelope field {field_name!r} is not "
+                        f"documented in {docs_file} (backticked or quoted)",
+                        f"envelope.{field_name}",
+                    )
+
     # ---- docs ------------------------------------------------------------
     for server, methods in fresh["servers"].items():
         for method in methods:
@@ -832,7 +960,7 @@ def _lock_diff(a: Dict[str, Any], b: Dict[str, Any], prefix: str = "") -> List[s
 # LintPass wiring
 # ---------------------------------------------------------------------------
 
-_NATIVE_FILES = ("lighthouse.cc", "manager.cc", "store.cc", "capi.cc")
+_NATIVE_FILES = ("lighthouse.cc", "manager.cc", "store.cc", "capi.cc", "net.cc")
 
 
 def gather_inputs(
@@ -986,6 +1114,46 @@ MINI_DOCS = """\
 | lighthouse | heartbeat | liveness + progress |
 """
 
+#: Envelope-surface mini project (the selftest's second half): a Python
+#: _RpcClient building the request envelope and the native serve_conn /
+#: RpcClient reading+writing it.
+MINI_ENVELOPE_PY = '''\
+import json
+
+
+class _RpcClient:
+    def call(self, method, params, timeout):
+        req = {"method": method, "params": params, "timeout_ms": 1}
+        req["traceparent"] = "00-x-y-01"
+        payload = json.dumps(req).encode()
+        return payload
+'''
+
+MINI_NET_CC = '''\
+void RpcServer::serve_conn(int fd) {
+  Json req = Json::parse(payload);
+  int64_t timeout_ms = req.get("timeout_ms").as_int(60000);
+  std::string method = req.get("method").as_string();
+  TraceCtx ctx = parse_traceparent(req.get("traceparent").as_string());
+  handle(method, req.get("params"), timeout_ms);
+}
+
+Json RpcClient::call(const std::string& method, const Json& params,
+                     int64_t timeout_ms) {
+  Json req = Json::object();
+  req["method"] = method;
+  req["params"] = params;
+  req["timeout_ms"] = timeout_ms;
+  req["traceparent"] = format_traceparent(current_trace());
+  return req;
+}
+'''
+
+MINI_ENVELOPE_DOCS = (
+    MINI_DOCS
+    + '\nEnvelope fields: `method`, `params`, `timeout_ms`, `traceparent`.\n'
+)
+
 
 def selftest() -> None:
     native = {"lighthouse.cc": MINI_CC}
@@ -1065,6 +1233,53 @@ def selftest() -> None:
         raise SelftestError(f"stale committed lock not caught (got {sorted(got)})")
     if "lock-missing" not in codes(committed=None):
         raise SelftestError("missing committed lock not caught")
+
+    # ---- request-envelope surface ---------------------------------------
+    env_py = MINI_PY + MINI_ENVELOPE_PY
+    env_native = {"lighthouse.cc": MINI_CC, "net.cc": MINI_NET_CC}
+    env_lock = build_lock(env_py, env_native)
+    if env_lock["envelope"] != ["method", "params", "timeout_ms", "traceparent"]:
+        raise SelftestError(
+            f"envelope extraction wrong: {env_lock['envelope']}"
+        )
+
+    def env_codes(py: str = env_py, net: str = MINI_NET_CC) -> Set[str]:
+        native = {"lighthouse.cc": MINI_CC, "net.cc": net}
+        return {
+            f.code
+            for f in run_checks(
+                py, native, MINI_ENVELOPE_DOCS,
+                build_lock(py, native),
+            )
+        }
+
+    clean = env_codes()
+    if clean:
+        raise SelftestError(f"clean envelope project yields findings: {clean}")
+    got = env_codes(py=env_py.replace('req["traceparent"]', 'req["trace_parent"]'))
+    if "envelope-field-dead" not in got or "envelope-field-missing" not in got:
+        raise SelftestError(
+            f"python-side envelope rename not caught (got {sorted(got)})"
+        )
+    got = env_codes(
+        net=MINI_NET_CC.replace(
+            'req.get("traceparent")', 'req.get("trace_parent")'
+        )
+    )
+    if "envelope-field-dead" not in got or "envelope-field-missing" not in got:
+        raise SelftestError(
+            f"native-side envelope rename not caught (got {sorted(got)})"
+        )
+    docs_missing = {
+        f.code
+        for f in run_checks(
+            env_py, env_native, MINI_DOCS, env_lock
+        )
+    }
+    if "envelope-undocumented" not in docs_missing:
+        raise SelftestError(
+            f"undocumented envelope field not caught (got {sorted(docs_missing)})"
+        )
 
 
 PASS = LintPass(

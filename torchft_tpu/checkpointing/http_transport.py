@@ -27,6 +27,7 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
 
@@ -132,6 +133,30 @@ class _Handler(BaseHTTPRequestHandler):
                     "checkpoint.http.send", start_ns=t0_ns, step=step,
                     bytes=total, resource=what,
                 )
+                # Distributed tracing: the healing destination sends its
+                # round context as a ``traceparent`` header; the source's
+                # serve lands as a heal.send span IN THE DESTINATION'S
+                # TRACE — source and destination of one heal share a
+                # trace (docs/observability.md "Distributed tracing").
+                tracer = _tracing.get_tracer()
+                if tracer is not None:
+                    ctx = _tracing.TraceContext.from_traceparent(
+                        self.headers.get("traceparent")
+                    )
+                    if ctx is not None and ctx.sampled:
+                        tracer.export_span(
+                            name="heal.send",
+                            trace_id=ctx.trace_id,
+                            parent_span_id=ctx.span_id,
+                            start_ns=t0_ns,
+                            end_ns=time.time_ns(),
+                            attributes={
+                                "transport": "http",
+                                "step": step,
+                                "bytes": total,
+                                "resource": what,
+                            },
+                        )
         except TimeoutError:
             self.send_error(503, "checkpoint busy")
         except BrokenPipeError:
@@ -232,12 +257,23 @@ class HTTPTransport(CheckpointTransport[Any]):
             except Exception:  # noqa: BLE001 - fall back to fresh alloc
                 into = None
 
+        # Trace propagation: the destination's round context rides a
+        # ``traceparent`` header so the SOURCE's serve spans join this
+        # replica's per-step trace (None when tracing is off/unsampled).
+        traceparent = _tracing.current_traceparent()
+
         def fetch(path: str):
             # Retry/backoff policy: _FETCH_POLICY (module top) — retryable
             # 503s and connection errors poll until the receiver's deadline.
             def attempt(budget: "Optional[float]"):
                 t = max(budget if budget is not None else 0.001, 0.001)
-                with urllib.request.urlopen(f"{base}/{path}", timeout=t) as resp:
+                req = urllib.request.Request(
+                    f"{base}/{path}",
+                    headers=(
+                        {"traceparent": traceparent} if traceparent else {}
+                    ),
+                )
+                with urllib.request.urlopen(req, timeout=t) as resp:
                     _metrics.CHECKPOINT_BYTES.labels(
                         transport="http", direction="recv"
                     ).inc(int(resp.headers.get("Content-Length") or 0))

@@ -23,6 +23,7 @@ from torchft_tpu.parallel.process_group import ProcessGroup
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.futures import context_timeout
 
 logger = logging.getLogger(__name__)
@@ -72,8 +73,16 @@ class PGTransport(CheckpointTransport[Any]):
             meta, arr = _leaf_meta(leaf)
             metas.append(meta)
             arrays.append(arr)
+        # Trace propagation: the source's round context rides the metadata
+        # frame, so the destination's receive span joins the SOURCE's
+        # per-step trace — both endpoints of one heal in one trace (the
+        # HTTP transport does the same with a traceparent header).
+        header_doc = {"step": step, "skeleton": skeleton, "leaves": metas}
+        traceparent = _tracing.current_traceparent()
+        if traceparent is not None:
+            header_doc["traceparent"] = traceparent
         header = np.frombuffer(
-            pickle.dumps({"step": step, "skeleton": skeleton, "leaves": metas}),
+            pickle.dumps(header_doc),
             dtype=np.uint8,
         )
         t0 = time.perf_counter()
@@ -196,12 +205,44 @@ class PGTransport(CheckpointTransport[Any]):
             # latches the error and reconfigures at the next quorum.
             self._pg.abort()
             raise
+        nbytes = header_bytes.nbytes + sum(
+            l.nbytes for l in leaves if isinstance(l, np.ndarray)
+        )
         _metrics.CHECKPOINT_BYTES.labels(transport="pg", direction="recv").inc(
-            header_bytes.nbytes
-            + sum(l.nbytes for l in leaves if isinstance(l, np.ndarray))
+            nbytes
         )
         _metrics.CHECKPOINT_DURATION.labels(
             transport="pg", direction="recv"
         ).observe(time.perf_counter() - t0)
+        # Distributed tracing: continue the source's context from the
+        # metadata frame — this receive lands as a heal.recv span in the
+        # SOURCE's per-step trace, next to its heal_send phase.  The
+        # mirrored flight record keeps the traced phase visible in
+        # post-mortem dumps too (span-vocab lint's 2-hop flight rule).
+        tracer = _tracing.get_tracer()
+        if tracer is not None:
+            ctx = _tracing.TraceContext.from_traceparent(
+                header.get("traceparent")
+            )
+            if ctx is not None and ctx.sampled:
+                end_ns = time.time_ns()
+                start_ns = end_ns - int((time.perf_counter() - t0) * 1e9)
+                _flightrec.record(
+                    "heal.recv", start_ns=start_ns, step=step,
+                    src_rank=src_rank, bytes=nbytes,
+                )
+                tracer.export_span(
+                    name="heal.recv",
+                    trace_id=ctx.trace_id,
+                    parent_span_id=ctx.span_id,
+                    start_ns=start_ns,
+                    end_ns=end_ns,
+                    attributes={
+                        "transport": "pg",
+                        "step": step,
+                        "src_rank": src_rank,
+                        "bytes": nbytes,
+                    },
+                )
         treedef = jax.tree_util.tree_structure(header["skeleton"])
         return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -8,7 +8,11 @@ Python — socket waits release the GIL, mirroring the reference's
 GIL-releasing PyO3 calls (reference: src/lib.rs:153-281).
 
 Wire format: 4-byte big-endian length + UTF-8 JSON.
-Request: ``{"method": ..., "params": {...}, "timeout_ms": N}``.
+Request: ``{"method": ..., "params": {...}, "timeout_ms": N,
+"traceparent": "00-<trace>-<span>-<flags>"?}`` — the optional
+``traceparent`` envelope field carries the distributed-tracing context
+(utils/tracing.py); servers continue it into one ``rpc.<method>`` span
+per request and propagate it on their own downstream RPCs.
 Response: ``{"ok": true, "result": {...}}`` or
 ``{"ok": false, "error": msg, "code": "timeout"?}``.
 """
@@ -26,6 +30,7 @@ from typing import Any, Dict, List, Optional
 
 from torchft_tpu import _native
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.retry import RetryPolicy
 
 __all__ = [
@@ -258,19 +263,25 @@ class _RpcClient:
         # the contract; callers queue on the round trip by design, and
         # every socket op under it is deadline-bounded (settimeout above
         # each send/recv) — hence the lint waiver.
+        # Distributed tracing: the current context (bound by the Manager
+        # around its round) rides the request envelope; None when tracing
+        # is off or the step is unsampled — the disabled path is one
+        # module-global check (budget-tested in tests/test_tracing.py).
+        traceparent = _tracing.current_traceparent()
         with self._lock:  # tft-lint: allow(lock-discipline)
             for attempt in range(attempts):
                 if self._sock is None:
                     self._sock = self._connect(
                         min(deadline, time.monotonic() + self._connect_timeout)
                     )
-                payload = json.dumps(
-                    {
-                        "method": method,
-                        "params": params,
-                        "timeout_ms": max(int((deadline - time.monotonic()) * 1000), 1),
-                    }
-                ).encode()
+                req: "Dict[str, Any]" = {
+                    "method": method,
+                    "params": params,
+                    "timeout_ms": max(int((deadline - time.monotonic()) * 1000), 1),
+                }
+                if traceparent is not None:
+                    req["traceparent"] = traceparent
+                payload = json.dumps(req).encode()
                 try:
                     if self._fault_site is not None:
                         _faults.check(self._fault_site)
@@ -360,6 +371,10 @@ class _NativeServer:
         self._address = _native.take_string(
             _native.get_lib().tft_server_address(handle)
         )
+        # A native server exists, so its rpc.* spans have somewhere to go:
+        # register the process span sink (idempotent; no-op when no tracer
+        # is installed).  force_load is safe — the lib is loaded by now.
+        _tracing.install_native_span_sink(force_load=True)
 
     def address(self) -> str:
         """``host:port`` the server is listening on (resolves port 0)."""

@@ -24,6 +24,18 @@ answers "what was the whole fleet doing at step N"; its worst-K
 straggler snapshot names a culprit (signal ``timeline_straggler``) even
 when no flight dumps were collected at all.
 
+``--trace <TORCHFT_TRACE_FILE>`` reads the distributed-tracing span sink
+(utils/tracing.py) and reconstructs the **cross-replica critical path**
+per step: trace ids are deterministic per step, every replica's
+``quorum_round`` root plus its phase / native ``rpc.*`` / heal /
+quantized-pipeline children land in one trace, and the ledger attributes
+the slowest replica's wall time to ``compute`` / ``codec`` / ``wire`` /
+``protocol`` / ``straggler-wait`` — naming the dominant contributor per
+step and per replica, and (signal ``trace_error``) the replica whose
+span failed, from the trace file alone.  All three inputs join on
+``step``/``quorum_id``, so dumps + timeline + trace compose into one
+report.
+
 Output is a human timeline + verdict (default) or ``--json`` for machines.
 ``--selftest`` generates a synthetic two-replica dump pair in a temp dir
 and checks culprit attribution end to end — wired into the test suite so
@@ -47,10 +59,15 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "load_records",
     "load_timeline",
+    "load_spans",
     "analyze",
     "analyze_timeline",
+    "analyze_trace",
+    "ledger_categories",
+    "dominant_contributor",
     "render_text",
     "render_timeline_text",
+    "render_trace_text",
     "selftest",
     "main",
 ]
@@ -64,6 +81,48 @@ RETRY_STORM_THRESHOLD = 3
 # a straggler score this far past typical (~1.0) in the lighthouse
 # timeline snapshot is a culprit signal of its own
 TIMELINE_STRAGGLER_SCORE = 4.0
+
+#: protocol-phase name -> critical-path ledger cost category.  The same
+#: mapping bench.py uses for its per-leg dominant-contributor field, so
+#: the bench tail and the trace ledger speak one vocabulary.
+PHASE_CATEGORY = {
+    "quorum_wait": "straggler-wait",
+    "quorum_rpc": "protocol",
+    "pg_configure": "protocol",
+    "commit": "protocol",
+    "host_sync": "compute",
+    "ring": "wire",
+    "heal_send": "wire",
+    "heal_recv": "wire",
+}
+
+#: the ledger's full category vocabulary, in render order
+LEDGER_CATEGORIES = ("compute", "codec", "wire", "protocol", "straggler-wait")
+
+
+def ledger_categories(phase_times: "Dict[str, Any]") -> "Dict[str, float]":
+    """Fold a phase->duration mapping (``Manager.phase_times`` deltas, or
+    a timeline bucket's ``phase_ms``) into ledger categories.  Unknown
+    phase names count as ``protocol`` (they are protocol bookkeeping by
+    construction — every traced phase is in ``manager.PROTOCOL_PHASES``)."""
+    out: "Dict[str, float]" = {}
+    for name, dur in phase_times.items():
+        try:
+            v = float(dur)
+        except (TypeError, ValueError):
+            continue
+        cat = PHASE_CATEGORY.get(name, "protocol")
+        out[cat] = out.get(cat, 0.0) + v
+    return out
+
+
+def dominant_contributor(phase_times: "Dict[str, Any]") -> "Optional[str]":
+    """The ledger category that ate the most time, or None on empty/zero
+    input — the one-word answer bench legs and the per-step ledger give."""
+    cats = ledger_categories(phase_times)
+    if not cats or max(cats.values()) <= 0.0:
+        return None
+    return max(cats.items(), key=lambda kv: kv[1])[0]
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +254,41 @@ def load_timeline(src: str) -> "Dict[str, Any]":
     if not isinstance(doc, dict) or "steps" not in doc:
         raise ValueError(f"{src}: not a /timeline.json document")
     return doc
+
+
+def load_spans(path: str) -> "Tuple[List[Dict[str, Any]], List[str]]":
+    """Parse a ``TORCHFT_TRACE_FILE`` JSONL span sink.  Returns (spans,
+    warnings); a span is any object with ``trace_id``/``span_id``/``name``
+    (the exact schema ``Tracer.export_span`` writes)."""
+    spans: "List[Dict[str, Any]]" = []
+    warnings: "List[str]" = []
+    try:
+        fh = open(path, "r", encoding="utf-8")
+    except OSError as e:
+        return [], [f"{path}: unreadable ({e})"]
+    bad = 0
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if (
+                isinstance(obj, dict)
+                and "trace_id" in obj
+                and "span_id" in obj
+                and "name" in obj
+            ):
+                spans.append(obj)
+            else:
+                bad += 1
+    if bad:
+        warnings.append(f"{path}: skipped {bad} non-span line(s)")
+    return spans, warnings
 
 
 # ---------------------------------------------------------------------------
@@ -505,6 +599,185 @@ def analyze_timeline(timeline: "Dict[str, Any]") -> "Dict[str, Any]":
     }
 
 
+def _span_dur_s(span: "Dict[str, Any]") -> float:
+    try:
+        return max(
+            (int(span.get("end_ns") or 0) - int(span.get("start_ns") or 0))
+            / 1e9,
+            0.0,
+        )
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def analyze_trace(spans: "List[Dict[str, Any]]") -> "Dict[str, Any]":
+    """The per-step critical-path ledger from a span-sink file.
+
+    One trace == one training step (ids are deterministic per step), with
+    one ``quorum_round`` root per replica and every other span a child of
+    some replica's root (phase spans, native ``rpc.*`` server spans, heal
+    spans, the quantized-pipeline spans).  Per replica the ledger sums:
+
+    - the **phase spans** (the Manager's own non-overlapping accounting)
+      through :data:`PHASE_CATEGORY`;
+    - ``quant.pipeline``'s ``codec_s``/``wire_s`` attributes, which
+      REPLACE the ``ring`` phase when present (ring wraps the pipeline —
+      counting both would double-bill the wire);
+    - the lighthouse's ``rpc.quorum`` server span, which REFINES
+      straggler-wait (it measures exactly the block-until-quorum-forms
+      wait; the ``quorum_wait`` phase then only contributes any excess).
+
+    Mirror spans (``heal.send``/``heal.recv``, per-chunk ``quant.chunk``,
+    manager/store ``rpc.*``) join endpoints causally but are excluded
+    from the sums — their cost is already inside a phase.  The step's
+    critical path is the slowest replica's root; its dominant category is
+    the step's answer to "what ate this step".  Any ``ok=false`` span
+    names a culprit (signal ``trace_error``) with no other input needed.
+    """
+    by_trace: "Dict[str, List[Dict[str, Any]]]" = defaultdict(list)
+    for s in spans:
+        by_trace[str(s.get("trace_id"))].append(s)
+
+    steps: "List[Dict[str, Any]]" = []
+    culprit: "Optional[Dict[str, Any]]" = None
+    for trace_id, sp in by_trace.items():
+        roots = [s for s in sp if s.get("name") == "quorum_round"]
+        if not roots:
+            continue
+        step = (roots[0].get("attributes") or {}).get("step")
+        quorum_id = (roots[0].get("attributes") or {}).get("quorum_id")
+        root_ids = {s.get("span_id"): s for s in roots}
+        children: "Dict[str, List[Dict[str, Any]]]" = defaultdict(list)
+        for s in sp:
+            parent = s.get("parent_span_id")
+            if parent in root_ids and s.get("name") != "quorum_round":
+                children[parent].append(s)
+
+        replicas: "Dict[str, Dict[str, Any]]" = {}
+        for root in roots:
+            attrs = root.get("attributes") or {}
+            rid = str(attrs.get("replica_id", "?"))
+            info = replicas.setdefault(
+                rid,
+                {
+                    "wall_s": 0.0,
+                    "categories": {},
+                    "ok": True,
+                    "spans": 0,
+                    "failed_span": None,
+                },
+            )
+            info["wall_s"] += _span_dur_s(root)
+            if not root.get("ok", True):
+                info["ok"] = False
+                info["failed_span"] = info["failed_span"] or "quorum_round"
+            cats: "Dict[str, float]" = info["categories"]
+            phase_sums: "Dict[str, float]" = {}
+            quant_seen = False
+            lighthouse_wait = 0.0
+            kids = children.get(root.get("span_id"), [])
+            info["spans"] += 1 + len(kids)
+            for c in kids:
+                name = str(c.get("name"))
+                cattrs = c.get("attributes") or {}
+                if not c.get("ok", True):
+                    info["ok"] = False
+                    info["failed_span"] = info["failed_span"] or name
+                if name in PHASE_CATEGORY:
+                    phase_sums[name] = phase_sums.get(name, 0.0) + _span_dur_s(c)
+                elif name == "quant.pipeline":
+                    quant_seen = True
+                    cats["codec"] = cats.get("codec", 0.0) + float(
+                        cattrs.get("codec_s") or 0.0
+                    )
+                    cats["wire"] = cats.get("wire", 0.0) + float(
+                        cattrs.get("wire_s") or 0.0
+                    )
+                elif name == "rpc.quorum" and cattrs.get("server") == "lighthouse":
+                    lighthouse_wait += _span_dur_s(c)
+                # mirror spans (heal.*, quant.chunk, other rpc.*): causal
+                # join only — their cost is inside a phase already
+            if quant_seen:
+                phase_sums.pop("ring", None)
+            if lighthouse_wait > 0.0:
+                # the measured block-until-quorum wait replaces the phase;
+                # quorum_wait only contributes any excess beyond it
+                excess = max(phase_sums.get("quorum_wait", 0.0) - lighthouse_wait, 0.0)
+                phase_sums["quorum_wait"] = excess
+                cats["straggler-wait"] = (
+                    cats.get("straggler-wait", 0.0) + lighthouse_wait
+                )
+            for cat, v in ledger_categories(phase_sums).items():
+                cats[cat] = cats.get(cat, 0.0) + v
+
+        for rid, info in replicas.items():
+            # argmax over the already-categorized sums (NOT through
+            # dominant_contributor, which maps phase names to categories)
+            info["dominant"] = (
+                max(info["categories"].items(), key=lambda kv: kv[1])[0]
+                if info["categories"]
+                and max(info["categories"].values()) > 0.0
+                else None
+            )
+            info["categories"] = {
+                k: round(v, 6) for k, v in sorted(info["categories"].items())
+            }
+            info["wall_s"] = round(info["wall_s"], 6)
+
+        slowest = max(replicas.items(), key=lambda kv: kv[1]["wall_s"])
+        # the slowest replica IS the step's critical path; its dominant
+        # category answers "what ate this step" (same >0 guard as the
+        # per-replica dominant — all-zero sums name nothing)
+        dominant = (
+            max(slowest[1]["categories"].items(), key=lambda kv: kv[1])[0]
+            if slowest[1]["categories"]
+            and max(slowest[1]["categories"].values()) > 0.0
+            else None
+        )
+        starts = [int(s.get("start_ns") or 0) for s in roots]
+        ends = [int(s.get("end_ns") or 0) for s in roots]
+        steps.append(
+            {
+                "step": step,
+                "quorum_id": quorum_id,
+                "trace_id": trace_id,
+                "wall_s": round((max(ends) - min(starts)) / 1e9, 6),
+                "replicas": replicas,
+                "critical_replica": slowest[0],
+                "dominant": dominant,
+            }
+        )
+    steps.sort(key=lambda s: (s["step"] is None, s["step"]))
+    for s in steps:
+        failed = [
+            (rid, info)
+            for rid, info in s["replicas"].items()
+            if not info["ok"]
+        ]
+        if failed and culprit is None:
+            # earliest failing step wins (later failures are cascade)
+            rid, info = failed[0]
+            culprit = {
+                "replica_id": rid,
+                "reason": (
+                    f"trace: span {info['failed_span']!r} failed (ok=false) "
+                    f"at step {s['step']}"
+                ),
+                "signal": "trace_error",
+            }
+    dominants = [s["dominant"] for s in steps if s["dominant"]]
+    overall = (
+        max(set(dominants), key=dominants.count) if dominants else None
+    )
+    return {
+        "steps": steps,
+        "spans": len(spans),
+        "traces": len(by_trace),
+        "dominant_overall": overall,
+        "culprit": culprit,
+    }
+
+
 # ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
@@ -620,6 +893,52 @@ def render_timeline_text(
                 f"{'STALE' if row.get('stale') else 'fresh'} "
                 f"op={row.get('inflight_op') or '-'}"
             )
+    return "\n".join(out)
+
+
+def render_trace_text(trace_report: "Dict[str, Any]", max_rows: int = 30) -> str:
+    """The per-step critical-path ledger as a text section: one row per
+    step (wall, critical replica, dominant category, category split) plus
+    per-replica dominants."""
+    out: "List[str]" = []
+    steps = trace_report.get("steps") or []
+    out.append(
+        f"critical-path ledger ({min(len(steps), max_rows)} of {len(steps)} "
+        f"steps, {trace_report.get('spans')} spans):"
+    )
+    if trace_report.get("dominant_overall"):
+        out.append(
+            f"  dominant contributor overall: "
+            f"{trace_report['dominant_overall']}"
+        )
+    for s in steps[-max_rows:]:
+        cats = s["replicas"][s["critical_replica"]]["categories"]
+        split = " ".join(
+            f"{c}={cats.get(c, 0.0) * 1e3:.1f}ms"
+            for c in LEDGER_CATEGORIES
+            if cats.get(c)
+        )
+        out.append(
+            f"  step {s['step']!s:<6} wall={s['wall_s'] * 1e3:8.1f}ms "
+            f"critical={s['critical_replica'][:28]:28s} "
+            f"dominant={s['dominant'] or '-':<14} {split}"
+        )
+        for rid, info in sorted(s["replicas"].items()):
+            marker = " " if info["ok"] else "!"
+            out.append(
+                f"   {marker}  {rid[:30]:30s} wall={info['wall_s'] * 1e3:8.1f}ms "
+                f"dominant={info['dominant'] or '-'}"
+                + (
+                    f" FAILED in {info['failed_span']}"
+                    if not info["ok"]
+                    else ""
+                )
+            )
+    culprit = trace_report.get("culprit")
+    if culprit:
+        out.append(
+            f"  trace culprit: {culprit['replica_id']} — {culprit['reason']}"
+        )
     return "\n".join(out)
 
 
@@ -744,6 +1063,13 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         "into the report — names a straggler culprit even without dumps",
     )
     parser.add_argument(
+        "--trace", default=None, metavar="TRACE_FILE",
+        help="distributed-tracing span sink (TORCHFT_TRACE_FILE JSONL): "
+        "reconstructs the per-step cross-replica critical-path ledger "
+        "(compute/codec/wire/protocol/straggler-wait) and names failing "
+        "replicas from ok=false spans",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="machine-readable JSON report"
     )
     parser.add_argument(
@@ -758,7 +1084,7 @@ def main(argv: "Optional[List[str]]" = None) -> int:
 
     if args.selftest:
         return 0 if selftest() else 1
-    if not args.dumps and not args.events and not args.timeline:
+    if not args.dumps and not args.events and not args.timeline and not args.trace:
         parser.print_usage(sys.stderr)
         print("torchft-diagnose: no input files", file=sys.stderr)
         return 2
@@ -772,20 +1098,36 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         except Exception as e:  # noqa: BLE001 - report, don't die mid-postmortem
             print(f"warning: --timeline {args.timeline}: {e}", file=sys.stderr)
 
+    trace_report: "Optional[Dict[str, Any]]" = None
+    trace_warnings: "List[str]" = []
+    if args.trace:
+        spans, trace_warnings = load_spans(args.trace)
+        if spans:
+            trace_report = analyze_trace(spans)
+        elif not trace_warnings:
+            trace_warnings = [f"{args.trace}: no spans"]
+
     entries, warnings = load_records(list(args.dumps), list(args.events))
-    if not entries and timeline_report is None:
+    warnings.extend(trace_warnings)
+    if not entries and timeline_report is None and trace_report is None:
         for w in warnings:
             print(f"warning: {w}", file=sys.stderr)
         print("torchft-diagnose: no parseable records", file=sys.stderr)
         return 1
     report = analyze(entries)
-    # The flight-record signals see INSIDE a replica and win when present;
-    # the lighthouse timeline sees the fleet from outside and fills the
-    # gap when no dump implicates anyone (or none were collected).
+    # Culprit precedence: flight-record signals see INSIDE a replica and
+    # win when present; the trace ledger's ok=false spans are next (they
+    # also see inside, but dumps carry the fault tags); the lighthouse
+    # timeline sees the fleet from outside and fills the remaining gap.
+    # All three join on step/quorum_id — one report.
+    if report["culprit"] is None and trace_report is not None:
+        report["culprit"] = trace_report["culprit"]
     if report["culprit"] is None and timeline_report is not None:
         report["culprit"] = timeline_report["culprit"]
     if timeline_report is not None:
         report["cluster_timeline"] = timeline_report
+    if trace_report is not None:
+        report["trace_ledger"] = trace_report
     if args.json:
         payload = dict(report)
         payload["warnings"] = warnings
@@ -795,6 +1137,8 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         print(render_text(entries, report, warnings, max_rows=args.max_rows))
         if cluster_timeline is not None:
             print(render_timeline_text(cluster_timeline))
+        if trace_report is not None:
+            print(render_trace_text(trace_report))
     return 0
 
 

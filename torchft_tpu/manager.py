@@ -34,7 +34,6 @@ import socket
 import threading
 import time
 import uuid
-import warnings
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from enum import Enum
@@ -215,18 +214,23 @@ class Manager:
         self._commit_failures = 0
         self._quorum_id = -1
 
-        # Wall-clock spent in each protocol phase since the last
-        # ``pop_phase_times`` — the FT-overhead observability surface
-        # (the reference only exposes these as profiler spans,
-        # torchft/manager.py:385,591,790).  ``_record_phase`` additionally
-        # feeds the non-destructive telemetry layer: the
-        # torchft_quorum_duration_seconds histogram and, when a tracer is
-        # installed, one child span per phase under the round's root span.
+        # Wall-clock accumulated per protocol phase — the FT-overhead
+        # observability surface (the reference only exposes these as
+        # profiler spans, torchft/manager.py:385,591,790); consumers read
+        # the non-destructive ``phase_times`` snapshot.  ``_record_phase``
+        # additionally feeds the torchft_quorum_duration_seconds histogram
+        # and, when a tracer is installed, one child span per phase under
+        # the round's root span.
         self._phase_acc: Dict[str, float] = {}
         self._phase_lock = threading.Lock()
-        # (trace_id, root_span_id, start_ns) of the in-flight quorum round,
-        # None when no tracer is installed or no round is open.
-        self._round_trace: "Optional[tuple[str, str, int]]" = None
+        # Trace context of the in-flight quorum round (None when tracing
+        # is off or the step is unsampled).  The trace id is DERIVED FROM
+        # THE STEP (tracing.step_trace_id), so every replica group, the
+        # lighthouse, and both heal endpoints of one training step share
+        # one trace with zero coordination.
+        self._round_ctx: "Optional[tracing.TraceContext]" = None
+        self._round_start_ns = 0
+        self._round_step = 0
 
         # --- coordination wiring (reference manager.py:277-325) -----------
         lighthouse_addr = lighthouse_addr or env_str("TORCHFT_LIGHTHOUSE") or None
@@ -425,11 +429,20 @@ class Manager:
         self._report_progress("quorum")
 
         tracer = tracing.get_tracer()
-        self._round_trace = (
-            (tracing.new_trace_id(), tracing.new_span_id(), time.time_ns())
-            if tracer is not None
-            else None
-        )
+        ctx: "Optional[tracing.TraceContext]" = None
+        if tracer is not None and tracer.sample_step(self._step):
+            # Deterministic per-step trace id: every replica at this step
+            # derives the same one (and the same sampling decision), so a
+            # sampled step's trace is complete across the whole fleet.
+            ctx = tracing.TraceContext(
+                tracing.step_trace_id(self._step), tracing.new_span_id()
+            )
+        self._round_ctx = ctx
+        self._round_start_ns = time.time_ns() if ctx is not None else 0
+        self._round_step = self._step
+        # Bind on the caller thread too: the allreduce submit and the
+        # should_commit RPC run here and must inject the same context.
+        tracing.set_current(ctx)
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -456,6 +469,11 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
+        # The executor thread is where the quorum RPC, pg configure, and
+        # the heal transfers run: bind the round's trace context so every
+        # outbound RPC (manager quorum, store barriers) and the heal
+        # transports carry it.
+        tracing.set_current(self._round_ctx)
         try:
             t_rpc = time.perf_counter()
             with jax.profiler.TraceAnnotation("torchft::manager::_client::_quorum"):
@@ -898,6 +916,10 @@ class Manager:
 
         self._checkpoint_transport.disallow_checkpoint()
 
+        # Raised AFTER the round's root span closes below: the terminally
+        # failed round is exactly the one a post-mortem trace needs, and
+        # the thread-local context must not leak past the raise.
+        retries_exhausted: "Optional[RuntimeError]" = None
         if should_commit:
             self._step += 1
             self._batches_committed += self.num_participants()
@@ -913,7 +935,7 @@ class Manager:
                     f"consecutively, exceeding max_retries={self._max_retries}"
                 )
                 self._logger.exception(msg)
-                raise RuntimeError(msg)
+                retries_exhausted = RuntimeError(msg)
         self._m_step.set(self._step)
         # step (possibly) advanced: refresh the heartbeat-piggybacked
         # progress so lighthouse step-lag tracking follows commits, not
@@ -923,27 +945,32 @@ class Manager:
         self._report_step_summary()
 
         # Close the quorum round's root span (children were emitted per
-        # phase from _record_phase); trace joins to the structured events
-        # on the shared step/quorum_id attributes.
+        # phase from _record_phase, native rpc.* server spans joined via
+        # the shared trace id); the ``step`` attribute is the step the
+        # round RAN, matching the trace-id derivation, so the diagnose
+        # ledger joins spans, flight dumps, and the lighthouse timeline
+        # on one key.
         tracer = tracing.get_tracer()
-        rt, self._round_trace = self._round_trace, None
-        if tracer is not None and rt is not None:
-            trace_id, root_span_id, start_ns = rt
+        ctx, self._round_ctx = self._round_ctx, None
+        if tracer is not None and ctx is not None:
             tracer.export_span(
                 name="quorum_round",
-                trace_id=trace_id,
-                span_id=root_span_id,
-                start_ns=start_ns,
+                trace_id=ctx.trace_id,
+                span_id=ctx.span_id,
+                start_ns=self._round_start_ns,
                 end_ns=time.time_ns(),
                 attributes={
                     "replica_id": self._replica_id,
                     "rank": self._group_rank,
                     "quorum_id": self._quorum_id,
-                    "step": self._step,
+                    "step": self._round_step,
                     "commit_result": should_commit,
                 },
-                ok=self._errored is None,
+                ok=self._errored is None and retries_exhausted is None,
             )
+        tracing.set_current(None)
+        if retries_exhausted is not None:
+            raise retries_exhausted
         return should_commit
 
     # ------------------------------------------------------------------
@@ -980,13 +1007,15 @@ class Manager:
             self._phase_hist[name] = child
         child.observe(dt)
         tracer = tracing.get_tracer()
-        rt = self._round_trace
-        if tracer is not None and rt is not None:
+        ctx = self._round_ctx
+        if tracer is not None and ctx is not None:
             end_ns = time.time_ns()
+            # Phase names come from PROTOCOL_PHASES (pinned by tier-1;
+            # span-vocab lint checks the literal call sites).
             tracer.export_span(
                 name=name,
-                trace_id=rt[0],
-                parent_span_id=rt[1],
+                trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id,
                 start_ns=end_ns - int(dt * 1e9),
                 end_ns=end_ns,
                 attributes={
@@ -997,22 +1026,11 @@ class Manager:
             )
 
     def phase_times(self) -> "Dict[str, float]":
-        """Non-destructive snapshot of the per-phase accumulator (same keys
-        as :meth:`pop_phase_times`, which documents them).  Safe for any
-        number of concurrent consumers — scrapers should prefer the
-        ``torchft_quorum_duration_seconds`` histogram, which this same data
-        also feeds."""
-        with self._phase_lock:
-            return dict(self._phase_acc)
-
-    def pop_phase_times(self) -> "Dict[str, float]":
-        """Wall-clock seconds spent per protocol phase since the last call.
-
-        .. deprecated:: destructive single-consumer drain — two consumers
-           (e.g. bench + a scraper) corrupt each other's view.  New code
-           should read :meth:`phase_times` (non-destructive snapshot) or
-           the ``torchft_quorum_duration_seconds`` histogram; this method
-           remains for bench.py's per-step reset semantics.
+        """Non-destructive snapshot of the cumulative wall-clock seconds
+        spent per protocol phase.  Safe for any number of concurrent
+        consumers (bench takes deltas between snapshots); scrapers should
+        prefer the ``torchft_quorum_duration_seconds`` histogram, which
+        this same data also feeds.
 
         Caller-thread keys: ``quorum_wait`` (blocked waiting for the async
         quorum work — the part NOT hidden behind the forward pass; includes
@@ -1030,18 +1048,11 @@ class Manager:
         quorum change), ``heal_send`` / ``heal_recv`` (live checkpoint
         transfer to/from a recovering peer, incl. the metadata fetch).
 
-        Resets the accumulator.
+        (``pop_phase_times``, the destructive single-consumer drain this
+        replaced, was deprecated in PR 3 and removed in PR 9.)
         """
-        warnings.warn(
-            "Manager.pop_phase_times() is deprecated (destructive single-"
-            "consumer drain): read phase_times() or the "
-            "torchft_quorum_duration_seconds histogram instead",
-            DeprecationWarning,
-            stacklevel=2,
-        )
         with self._phase_lock:
-            out, self._phase_acc = self._phase_acc, {}
-        return out
+            return dict(self._phase_acc)
 
     def _report_progress(self, inflight_op: str) -> None:
         """Push (step, in-flight op) to the group's native ManagerServer so

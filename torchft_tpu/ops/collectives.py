@@ -92,6 +92,7 @@ from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import lockcheck as _lockcheck
 from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.bufpool import POOL as _POOL
 from torchft_tpu.utils.env import env_int
 
@@ -239,6 +240,12 @@ class _ChunkPipeline:
         # single-worker FIFO serializes every completion callback)
         self.hop_wire_s: "Dict[str, float]" = {}
         self.t_call = time.perf_counter()
+        # Distributed tracing: capture the submitting thread's context
+        # (the Manager's round) at construction — completion callbacks
+        # run on PG-worker/driver threads, where the thread-local is not
+        # bound.  Per-chunk/per-hop child spans mirror the quant.chunk
+        # flight records; None when tracing is off or the step unsampled.
+        self.trace_ctx = _tracing.get_current()
         # per-wait budget: each PG op enforces its own deadline
         # (pg._timeout), so a stage future unresolved past that plus grace
         # means a lost callback, not a slow wire
@@ -267,6 +274,26 @@ class _ChunkPipeline:
             chunks=len(self.chunks),
             error=repr(exc),
         )
+        # failed-collective span (ok=false): the trace ledger names the
+        # aborting replica from this alone
+        tracer = _tracing.get_tracer()
+        ctx = self.trace_ctx
+        if tracer is not None and ctx is not None:
+            end_ns = time.time_ns()
+            tracer.export_span(
+                name="quant.pipeline",
+                trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id,
+                start_ns=end_ns
+                - int((time.perf_counter() - self.t_call) * 1e9),
+                end_ns=end_ns,
+                attributes={
+                    "collective": self.collective,
+                    "wire": self.wire_dtype,
+                    "error": repr(exc),
+                },
+                ok=False,
+            )
         for futs in self._stage_future_lists():
             for f in futs:
                 try:
@@ -365,6 +392,27 @@ class _ChunkPipeline:
                 wire_s=round(wire_s, 6),
                 **({"error": repr(exc)} if exc is not None else {}),
             )
+            # one child span per (chunk, hop) wire op, mirroring the
+            # flight record — the trace-ledger's wire attribution
+            tracer = _tracing.get_tracer()
+            ctx = self.trace_ctx
+            if tracer is not None and ctx is not None:
+                end_ns = time.time_ns()
+                tracer.export_span(
+                    name="quant.chunk",
+                    trace_id=ctx.trace_id,
+                    parent_span_id=ctx.span_id,
+                    start_ns=end_ns - int(wire_s * 1e9),
+                    end_ns=end_ns,
+                    attributes={
+                        "collective": self.collective,
+                        "pg_op": op,
+                        "hop": hop,
+                        "chunk": k,
+                        "nbytes": nbytes,
+                    },
+                    ok=exc is None,
+                )
             if exc is not None:
                 self.abort(exc)
                 return
@@ -702,6 +750,27 @@ class _ChunkPipeline:
             wire_s=round(wire_s, 6),
             overlap_efficiency=round(efficiency, 4),
         )
+        # collective-level span: carries the codec/wire busy split the
+        # trace ledger uses to attribute this wall time to codec vs wire
+        tracer = _tracing.get_tracer()
+        ctx = self.trace_ctx
+        if tracer is not None and ctx is not None:
+            end_ns = time.time_ns()
+            tracer.export_span(
+                name="quant.pipeline",
+                trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id,
+                start_ns=end_ns - int(wall * 1e9),
+                end_ns=end_ns,
+                attributes={
+                    "collective": self.collective,
+                    "wire": self.wire_dtype,
+                    "chunks": len(self.chunks),
+                    "codec_s": round(codec_s, 6),
+                    "wire_s": round(wire_s, 6),
+                    "overlap_efficiency": round(efficiency, 4),
+                },
+            )
 
 
 class _HierPipeline(_ChunkPipeline):

@@ -2,8 +2,8 @@
 
 The structured-event pipeline (utils/logging.py) answers "what happened";
 this module answers "how often / how long / how many bytes" — the live,
-NON-destructive observability surface an elastic trainer needs (contrast
-``Manager.pop_phase_times``, a single-consumer drain).  Reliable-collective
+NON-destructive observability surface an elastic trainer needs (consumers
+take deltas of ``Manager.phase_times`` snapshots).  Reliable-collective
 systems (Prime PCCL, PAPERS.md) treat per-phase counters as first-class
 diagnostics; same stance here.
 
