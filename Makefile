@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke
 
 native:
 	$(MAKE) -C native
@@ -38,6 +38,14 @@ test: tier1
 # (docs/observability.md "Distributed tracing").
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tracing_integ.py -q -m "not slow"
+
+# Online-parallelism-switching round trip alone: the live shrink/grow
+# reshard integration incl. the tier-1 mid-reshard chaos tests (kill a
+# replica between stage and commit -> completed switch without the
+# victim or clean rollback, never a wedge; docs/architecture.md
+# "Online parallelism switching").
+reshard-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reshard_integ.py -q -m "not slow"
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

@@ -355,6 +355,171 @@ def bench_recovery(cycles: int = RECOVERY_CYCLES) -> "Dict[str, Any]":
 
 
 # ---------------------------------------------------------------------------
+# 1b. online-parallelism-switch latency (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+SWITCH_GROUPS = 4
+SWITCH_KILL_STEP = 3
+SWITCH_TOTAL_STEPS = 7
+SWITCH_PARAM_ELEMS = 1 << 18  # 1 MB fp32 of layout-sharded state
+
+
+def bench_switch() -> "Dict[str, Any]":
+    """Kill-to-switched latency of online parallelism switching
+    (parallel/layout.py): 4 single-rank groups under a memory ceiling
+    run layout (2,2,1); killing one shrinks the fleet to 3, which
+    re-plans to (1,3,1) and re-shards the 1 MB state live (slice-diff
+    fetches from current owners over the HTTP transport).  Measured:
+    wall seconds from the kill to the LAST survivor's fleet-synchronous
+    layout commit, with the per-phase split (reshard staging wall /
+    commit round wall, from ``Manager.phase_times``) and the bytes that
+    actually crossed the wire — the price of "the job continuously fits
+    the hardware it has", next to the recovery latency it complements."""
+    from torchft_tpu.parallel.layout import (
+        LayoutConstraints,
+        LayoutController,
+    )
+
+    lighthouse = LighthouseServer(
+        min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=1000
+    )
+    t_killed: "List[Optional[float]]" = [None]
+    commits: "Dict[int, Dict[str, Any]]" = {}
+    errs: "Dict[int, BaseException]" = {}
+
+    def worker(gid: int) -> None:
+        shard = {"w": np.zeros(SWITCH_PARAM_ELEMS, dtype=np.float32)}
+        ctrl = LayoutController(
+            LayoutConstraints(
+                param_bytes=SWITCH_PARAM_ELEMS * 4,
+                shard_memory_bytes=SWITCH_PARAM_ELEMS * 2,
+            )
+        )
+        ctrl.register_sharded_state(
+            "model",
+            {"w": SWITCH_PARAM_ELEMS},
+            lambda: dict(shard),
+            lambda new: shard.update(
+                {k: np.array(v) for k, v in new.items()}
+            ),
+        )
+        user = {"marker": float(gid)}
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=30.0),
+            min_replica_size=1,
+            load_state_dict=lambda sd: user.update(sd),
+            state_dict=lambda: dict(user),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"switch_{gid}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=True,
+            init_sync=False,
+            timeout=30.0,
+            quorum_timeout=30.0,
+            max_retries=4 * SWITCH_TOTAL_STEPS,
+        )
+        manager.attach_layout(ctrl)
+
+        base_phases: "Dict[str, float]" = {}
+
+        def on_commit(layout, info):
+            if layout.key() == (2, 2, 1):
+                # bootstrap shard-up: snapshot so the shrink switch's
+                # phase split below is a delta, not a cumulative sum
+                base_phases.update(manager.phase_times())
+            elif layout.key() == (1, 3, 1):  # the shrink switch
+                cur = manager.phase_times()
+                commits[gid] = {
+                    "ts": time.perf_counter(),
+                    "bytes": info.get("fetched_bytes", 0),
+                    "phases": {
+                        k: v - base_phases.get(k, 0.0) for k, v in cur.items()
+                    },
+                }
+
+        ctrl.add_listener(on_commit)
+        try:
+            while manager.current_step() < SWITCH_TOTAL_STEPS:
+                step = manager.current_step()
+                if gid == SWITCH_GROUPS - 1 and step == SWITCH_KILL_STEP:
+                    t_killed[0] = time.perf_counter()
+                    return
+                manager.start_quorum()
+                g = np.full(
+                    SWITCH_PARAM_ELEMS, float(step + 1), dtype=np.float32
+                )
+                avg = manager.allreduce({"g": g}).wait(timeout=30)
+                if manager.should_commit():
+                    ctrl.update_sharded(
+                        "model",
+                        lambda leaf, arr, start: arr.__isub__(
+                            np.float32(0.01)
+                            * avg["g"][start : start + arr.size]
+                        ),
+                    )
+        finally:
+            manager.shutdown()
+
+    try:
+        threads = []
+        for gid in range(SWITCH_GROUPS):
+
+            def runner(gid=gid):
+                try:
+                    worker(gid)
+                except BaseException as e:  # noqa: BLE001
+                    errs[gid] = e
+
+            threads.append(threading.Thread(target=runner, daemon=True))
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 180
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.001))
+        if any(t.is_alive() for t in threads):
+            raise TimeoutError("switch bench wedged (worker hung)")
+        if errs:
+            raise next(iter(errs.values()))
+    finally:
+        lighthouse.shutdown()
+
+    survivors = [g for g in range(SWITCH_GROUPS - 1)]
+    if t_killed[0] is None or any(g not in commits for g in survivors):
+        raise RuntimeError(
+            f"shrink switch did not commit on all survivors: {sorted(commits)}"
+        )
+    latency = max(commits[g]["ts"] for g in survivors) - t_killed[0]
+    reshard_s = statistics.median(
+        commits[g]["phases"].get("reshard", 0.0) for g in survivors
+    )
+    commit_s = statistics.median(
+        commits[g]["phases"].get("layout_commit", 0.0) for g in survivors
+    )
+    out = {
+        "latency_s": round(latency, 3),
+        "reshard_s": round(reshard_s, 4),
+        "layout_commit_s": round(commit_s, 4),
+        "reshard_bytes": max(commits[g]["bytes"] for g in survivors),
+        "layout": "(2,2,1)->(1,3,1)",
+        # kill-detection (quorum re-formation, heartbeat expiry) is the
+        # remainder — the same protocol cost recovery latency pays
+        "detect_s": round(max(latency - reshard_s - commit_s, 0.0), 3),
+    }
+    # critical-path ledger vocabulary (diagnose.PHASE_CATEGORY): which
+    # cost category dominated the switch (detection is quorum protocol)
+    out["dominant"] = dominant_contributor(
+        {
+            "reshard": reshard_s,
+            "layout_commit": commit_s,
+            "quorum_rpc": out["detect_s"],
+        }
+    )
+    log(f"switch latency: {out}")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # 2. FT overhead vs a bare (non-FT) DDP twin
 # ---------------------------------------------------------------------------
 
@@ -1671,12 +1836,22 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         if isinstance(wan.get("rtt_50ms"), dict)
         else None
     )
+    switch = result.get("switch") or {}
     out: "Dict[str, Any]" = {
         "compact": True,
         "metric": result.get("metric", "recovery_to_healthy_step_latency"),
         "unit": result.get("unit", "s"),
         "value": result.get("value"),
         "vs_baseline": result.get("vs_baseline"),
+        # online-parallelism-switch latency (kill -> fleet-synchronous
+        # layout commit) next to the recovery headline it complements
+        "switch_latency_s": switch.get("latency_s"),
+        "switch": {
+            k: switch.get(k)
+            for k in ("reshard_s", "layout_commit_s", "detect_s",
+                      "reshard_bytes", "layout")
+            if switch.get(k) is not None
+        } or None,
         "recovery_cycles_s": result.get("recovery_cycles_s"),
         "recovery_phases_ms_top": top_phases,
         "overhead_pct": result.get("overhead_pct"),
@@ -1699,6 +1874,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
             for k, v in {
                 "recovery": result.get("recovery_dominant"),
                 "overhead": result.get("overhead_dominant"),
+                "switch": switch.get("dominant"),
                 **{
                     f"diloco.{leg}": legd.get("dominant")
                     for leg, legd in sorted(diloco.items())
@@ -1714,7 +1890,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     # fields first rather than shipping an unparseable truncation.
     droppable = [
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
-        "diloco_winners", "dominant", "crosscheck",
+        "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
     ]
     while (
@@ -1762,6 +1938,14 @@ def main() -> None:
         print(json.dumps(compact_summary(result)), flush=True)
         return
     recovery = bench_recovery()
+    # switch latency (ISSUE 11): the membership-change twin of recovery
+    # latency — a shrink triggers a live re-shard instead of a restart.
+    # Degrades to an error field like every secondary bench.
+    try:
+        switch = bench_switch()
+    except Exception as e:  # noqa: BLE001
+        log(f"switch bench failed: {e!r}")
+        switch = {"error": repr(e)}
     # Insurance against an external wall-cap killing the process mid-run:
     # emit a parseable JSON line with the PRIMARY metric as soon as it
     # exists.  A completed run prints the full line at the end (later on
@@ -1832,6 +2016,7 @@ def main() -> None:
         "model": model,
         "diloco": diloco,
         "wan": wan,
+        "switch": switch,
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

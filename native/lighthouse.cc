@@ -42,6 +42,7 @@ Json QuorumMember::to_json() const {
   j["world_size"] = world_size;
   j["shrink_only"] = shrink_only;
   j["commit_failures"] = commit_failures;
+  j["layout_epoch"] = layout_epoch;
   j["data"] = data;
   return j;
 }
@@ -55,6 +56,7 @@ QuorumMember QuorumMember::from_json(const Json& j) {
   m.world_size = j.get("world_size").as_int(1);
   m.shrink_only = j.get("shrink_only").as_bool();
   m.commit_failures = j.get("commit_failures").as_int();
+  m.layout_epoch = j.get("layout_epoch").as_int(0);
   m.data = j.get("data").as_string();
   return m;
 }

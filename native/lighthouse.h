@@ -39,7 +39,11 @@ struct QuorumMember {
   int64_t world_size = 1;
   bool shrink_only = false;
   int64_t commit_failures = 0;
-  std::string data;  // opaque JSON passthrough
+  // Online parallelism switching: the member's current/staged layout
+  // epoch (monotone; min==max across a quorum is the fleet-wide layout
+  // commit signal — docs/protocol.md "Layout epochs").
+  int64_t layout_epoch = 0;
+  std::string data;  // opaque JSON passthrough (layout shard manifest)
 
   Json to_json() const;
   static QuorumMember from_json(const Json& j);

@@ -31,6 +31,11 @@ Json QuorumResult::to_json() const {
   j["replica_world_size"] = replica_world_size;
   j["heal"] = heal;
   j["commit_failures"] = commit_failures;
+  j["max_layout_epoch"] = max_layout_epoch;
+  j["min_layout_epoch"] = min_layout_epoch;
+  Json parts = Json::array();
+  for (const Json& p : participants) parts.push_back(p);
+  j["participants"] = parts;
   return j;
 }
 
@@ -115,6 +120,18 @@ QuorumResult compute_quorum_results(const std::string& replica_id,
   out.heal = recover_src_replica_rank.has_value();
   for (const auto& p : participants)
     out.commit_failures = std::max(out.commit_failures, p.commit_failures);
+  out.max_layout_epoch = participants.front().layout_epoch;
+  out.min_layout_epoch = participants.front().layout_epoch;
+  for (const auto& p : participants) {
+    out.max_layout_epoch = std::max(out.max_layout_epoch, p.layout_epoch);
+    out.min_layout_epoch = std::min(out.min_layout_epoch, p.layout_epoch);
+    Json entry = Json::object();
+    entry["replica_id"] = p.replica_id;
+    entry["address"] = p.address;
+    entry["layout_epoch"] = p.layout_epoch;
+    entry["data"] = p.data;
+    out.participants.push_back(entry);
+  }
   return out;
 }
 
@@ -258,6 +275,8 @@ Json ManagerServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
     member.world_size = opt_.world_size;
     member.shrink_only = params.get("shrink_only").as_bool();
     member.commit_failures = params.get("commit_failures").as_int();
+    member.layout_epoch = params.get("layout_epoch").as_int(0);
+    member.data = params.get("layout_data").as_string();
 
     quorum_participants_.insert(group_rank);
     round = quorum_round_seq_;

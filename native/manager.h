@@ -43,6 +43,14 @@ struct QuorumResult {
   int64_t replica_world_size = 0;
   bool heal = false;
   int64_t commit_failures = 0;
+  // Online parallelism switching: the layout-epoch spread across the
+  // quorum (min == max == E commits a staged layout at epoch E fleet-
+  // wide) and the participant roster in replica-rank order (replica_id,
+  // manager address, layout_epoch, opaque shard manifest) — what lets
+  // every group compute the same reshard slice-diff plan locally.
+  int64_t max_layout_epoch = 0;
+  int64_t min_layout_epoch = 0;
+  std::vector<Json> participants;
 
   Json to_json() const;
 };

@@ -1,0 +1,413 @@
+"""Unit + property tests for online parallelism switching (ISSUE 11).
+
+Covers the pure pieces of ``parallel/layout.py`` — planner determinism
+and feasibility, interval math and slice-diff exactness, the monotone
+layout-epoch state machine — plus the layout-aware
+``ManagedDeviceMesh.global_batch_slice`` partition property across
+shrink/grow (the satellite the elastic sampler never had), the reshard
+``part_<rank>`` serving of the HTTP transport, and row/column
+process-group re-formation on layout commits.  The live multi-manager
+switch protocol is exercised in tests/test_reshard_integ.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from torchft_tpu.parallel import layout as lay
+from torchft_tpu.parallel.layout import (
+    Layout,
+    LayoutConstraints,
+    LayoutError,
+    LayoutState,
+    ReshardError,
+    feasible_layouts,
+    interval_intersect,
+    interval_subtract,
+    partition,
+    plan_fetches,
+    plan_layout,
+    shard_interval,
+)
+
+
+class TestPlanner:
+    def test_pure_dp_world_is_default(self):
+        for world in (1, 2, 3, 5, 8):
+            plan = plan_layout(world, LayoutConstraints())
+            assert plan.key() == (world, 1, 1)
+
+    def test_memory_ceiling_forces_sharding(self):
+        c = LayoutConstraints(param_bytes=1000, shard_memory_bytes=500)
+        assert plan_layout(4, c).key() == (2, 2, 1)  # dp maximized first
+        assert plan_layout(3, c).key() == (1, 3, 1)  # 3 is prime: all-shard
+        assert plan_layout(2, c).key() == (1, 2, 1)
+
+    def test_min_dp_floor(self):
+        c = LayoutConstraints(
+            min_dp=2, param_bytes=1000, shard_memory_bytes=500
+        )
+        assert plan_layout(4, c).key() == (2, 2, 1)
+        # world 2 cannot satisfy both min_dp=2 and shard>=2
+        with pytest.raises(LayoutError):
+            plan_layout(2, c)
+
+    def test_pp_requires_layer_divisibility(self):
+        c = LayoutConstraints(
+            layers=6, max_pp=4, param_bytes=1000, shard_memory_bytes=300
+        )
+        for dp, shard, pp in feasible_layouts(12, c):
+            assert 6 % pp == 0 and pp <= 4
+            assert dp * shard * pp == 12
+
+    def test_batch_caps_dp(self):
+        c = LayoutConstraints(global_batch_size=2)
+        assert plan_layout(4, c).key() == (2, 2, 1)
+
+    def test_deterministic_and_epoch_stamped(self):
+        c = LayoutConstraints(param_bytes=1 << 20, shard_memory_bytes=1 << 19)
+        a = plan_layout(6, c, epoch=7)
+        b = plan_layout(6, c, epoch=7)
+        assert a == b and a.epoch == 7
+
+    def test_movement_tiebreak_prefers_previous_shard_count(self):
+        # world 4 with a loose ceiling: (1,4,1) and (1,2,2)... pick via
+        # prev: coming from nshards=4 prefers the 4-shard option among
+        # equal-dp, equal-pp candidates
+        c = LayoutConstraints(
+            min_dp=1, max_pp=1, param_bytes=100, shard_memory_bytes=30
+        )
+        prev = Layout(1, 4, 1, 3)
+        assert plan_layout(4, c, prev=prev).key() == (1, 4, 1)
+
+    def test_coords_round_trip(self):
+        layout = Layout(2, 3, 2, 0)
+        seen = set()
+        for r in range(layout.world):
+            dp, sh, pp = layout.coords(r)
+            assert 0 <= dp < 2 and 0 <= sh < 3 and 0 <= pp < 2
+            seen.add((dp, sh, pp))
+            assert layout.shard_index(r) == sh * layout.pp + pp
+        assert len(seen) == layout.world
+
+
+class TestIntervalMath:
+    @pytest.mark.parametrize("n", [0, 1, 5, 17, 4096])
+    @pytest.mark.parametrize("k", [1, 2, 3, 7])
+    def test_partition_tiles_exactly(self, n, k):
+        ivs = partition(n, k)
+        assert len(ivs) == k
+        cursor = 0
+        for (s, e) in ivs:
+            assert s == cursor and e >= s
+            cursor = e
+        assert cursor == n
+
+    def test_subtract_and_intersect(self):
+        assert interval_intersect((0, 10), (5, 20)) == (5, 10)
+        assert interval_intersect((0, 5), (5, 10)) is None
+        assert interval_subtract((0, 10), [(2, 4), (6, 8)]) == [
+            (0, 2), (4, 6), (8, 10)
+        ]
+        assert interval_subtract((0, 10), [(0, 10)]) == []
+
+    @pytest.mark.parametrize("old_k,new_k", [(1, 3), (3, 1), (2, 3), (4, 2)])
+    def test_plan_fetches_covers_exactly_the_diff(self, old_k, new_k):
+        n = 101
+        owners = list(enumerate(partition(n, old_k)))
+        for new_rank, need in enumerate(partition(n, new_k)):
+            for my_old in [None] + list(range(old_k)):
+                have = [partition(n, old_k)[my_old]] if my_old is not None else []
+                fetches = plan_fetches(need, have, owners)
+                got = sorted(iv for ivs in fetches.values() for iv in ivs)
+                # fetched + locally held tiles `need` exactly: no gap...
+                assert interval_subtract(need, have + got) == []
+                # ...no overlap between fetched pieces...
+                for a, b in zip(got, got[1:]):
+                    assert a[1] <= b[0]
+                # ...and nothing fetched that is already held locally
+                for iv in got:
+                    for h in have:
+                        assert interval_intersect(iv, h) is None
+
+    def test_plan_fetches_raises_on_uncovered(self):
+        # owners only cover [0, 5); needing [0, 10) must fail loudly
+        with pytest.raises(ReshardError):
+            plan_fetches((0, 10), [], [(0, (0, 5))])
+
+
+class TestLayoutState:
+    def test_epochs_are_monotone(self):
+        st = LayoutState()
+        st.active = Layout(2, 1, 1, 0)
+        st.stage(Layout(1, 2, 1, 1))
+        assert st.commit(1).epoch == 1
+        with pytest.raises(LayoutError):
+            st.stage(Layout(2, 1, 1, 1))  # not past the active epoch
+
+    def test_rollback_burns_the_epoch_forever(self):
+        st = LayoutState()
+        st.active = Layout(2, 1, 1, 0)
+        st.stage(Layout(1, 2, 1, 1))
+        st.rollback(1)
+        # the tft-verify resize model's layout-epoch-monotone invariant,
+        # enforced at runtime: a burned epoch can never be staged again
+        with pytest.raises(LayoutError):
+            st.stage(Layout(1, 2, 1, 1))
+        assert st.next_epoch() == 2
+
+    def test_next_epoch_exceeds_wire_observations(self):
+        st = LayoutState()
+        st.observe_epoch(9)
+        assert st.next_epoch() == 10
+
+
+class TestHealCarry:
+    """While unsharded (nshards == 1) the registered state rides ordinary
+    heal transfers, so a mid-run joiner in a never-switched fleet gets
+    real parameters; a sharded source ships only its epoch (the reshard
+    path repairs the joiner at the next switch)."""
+
+    @staticmethod
+    def _ctrl(values):
+        from torchft_tpu.parallel.layout import LayoutController
+
+        store = {"w": np.array(values, dtype=np.float32)}
+        ctrl = LayoutController(LayoutConstraints())
+        ctrl.register_sharded_state(
+            "model",
+            {"w": len(values)},
+            lambda: dict(store),
+            lambda new: store.update(
+                {k: np.array(v) for k, v in new.items()}
+            ),
+        )
+        return ctrl, store
+
+    def test_unsharded_state_rides_heal(self):
+        src, _ = self._ctrl([1.0, 2.0, 3.0, 4.0])
+        src.state.active = Layout(3, 1, 1, 0)
+        dst, dst_store = self._ctrl([0.0, 0.0, 0.0, 0.0])
+        dst._load_heal_state(src._heal_state())
+        np.testing.assert_array_equal(
+            dst_store["w"], np.array([1, 2, 3, 4], dtype=np.float32)
+        )
+        assert dst.state.active == Layout(3, 1, 1, 0)
+
+    def test_sharded_source_ships_only_the_epoch(self):
+        src, _ = self._ctrl([1.0, 2.0, 3.0, 4.0])
+        src.state.active = Layout(1, 2, 1, 5)
+        src._shard_index, src._nshards = 1, 2
+        dst, dst_store = self._ctrl([0.0, 0.0, 0.0, 0.0])
+        dst._load_heal_state(src._heal_state())
+        np.testing.assert_array_equal(
+            dst_store["w"], np.zeros(4, dtype=np.float32)
+        )
+        # the epoch is learned, so the joiner's next wire report is
+        # visibly stale and the fleet re-plans its shard in
+        assert dst.state.max_seen_epoch == 5
+        assert dst.state.active is None
+
+    def test_size_mismatch_is_skipped(self):
+        src, _ = self._ctrl([1.0, 2.0])
+        src.state.active = Layout(2, 1, 1, 0)
+        dst, dst_store = self._ctrl([0.0, 0.0, 0.0])
+        dst._load_heal_state(src._heal_state())
+        np.testing.assert_array_equal(
+            dst_store["w"], np.zeros(3, dtype=np.float32)
+        )
+
+
+class _StubManager:
+    """Duck-typed Manager for mesh-level tests."""
+
+    def __init__(self, n, rank):
+        self._n, self._rank = n, rank
+
+    def num_participants(self):
+        return self._n
+
+    def participating_rank(self):
+        return self._rank
+
+    def is_participating(self):
+        return self._rank is not None
+
+    def replica_id(self):
+        return f"stub_{self._rank}"
+
+
+def _mesh(manager):
+    from torchft_tpu.parallel.device_mesh import ManagedDeviceMesh
+
+    inner = jax.sharding.Mesh(
+        np.array(jax.devices()[:1]), ("fsdp",)
+    )
+    return ManagedDeviceMesh(manager, inner)
+
+
+class TestGlobalBatchSlicePartition:
+    """ISSUE 11 satellite: across ANY shrink/grow the per-replica slices
+    partition the global batch exactly — no overlap, no gap."""
+
+    @pytest.mark.parametrize("batch", [1, 7, 32, 33])
+    @pytest.mark.parametrize("world", [1, 2, 3, 5, 8, 40])
+    def test_flat_slices_tile_batch(self, batch, world):
+        slices = [
+            _mesh(_StubManager(world, r)).global_batch_slice(batch)
+            for r in range(world)
+        ]
+        assert sum(e - s for (s, e) in slices) == batch
+        # strict tiling: the nonempty slices, sorted, walk [0, batch)
+        # with no overlap and no gap (empty slices: world > batch ranks)
+        walk = 0
+        for (s, e) in sorted(sl for sl in slices if sl[0] != sl[1]):
+            assert s == walk and e > s
+            walk = e
+        assert walk == batch
+
+    def test_non_participant_gets_empty_slice(self):
+        assert _mesh(_StubManager(3, None)).global_batch_slice(12) == (0, 0)
+
+    @pytest.mark.parametrize("world,key", [(4, (2, 2, 1)), (6, (3, 2, 1))])
+    def test_layout_slices_partition_by_dp_dim(self, world, key):
+        from torchft_tpu.parallel.layout import LayoutController
+
+        dp, shard, pp = key
+        layout = Layout(dp, shard, pp, 1)
+        slices = []
+        for r in range(world):
+            mesh = _mesh(_StubManager(world, r))
+            ctrl = LayoutController(LayoutConstraints())
+            ctrl.state.active = layout
+            mesh.attach_layout(ctrl)
+            slices.append(mesh.global_batch_slice(24))
+        # shard/pp peers of one dp replica train the same slice; distinct
+        # dp rows tile the batch exactly
+        by_dp = {}
+        for r, sl in enumerate(slices):
+            dp_rank, _, _ = layout.coords(r)
+            by_dp.setdefault(dp_rank, set()).add(sl)
+        assert all(len(v) == 1 for v in by_dp.values())
+        walk = 0
+        for dp_rank in sorted(by_dp):
+            (s, e) = next(iter(by_dp[dp_rank]))
+            assert s == walk
+            walk = e
+        assert walk == 24
+
+    def test_layout_grid_mismatch_falls_back_to_flat(self):
+        """Mid-switch (membership changed, commit pending) the flat
+        partition keeps the tiling exact."""
+        from torchft_tpu.parallel.layout import LayoutController
+
+        layout = Layout(2, 2, 1, 1)  # world 4, but only 3 live
+        slices = []
+        for r in range(3):
+            mesh = _mesh(_StubManager(3, r))
+            ctrl = LayoutController(LayoutConstraints())
+            ctrl.state.active = layout
+            mesh.attach_layout(ctrl)
+            slices.append(mesh.global_batch_slice(9))
+        walk = 0
+        for (s, e) in sorted(slices):
+            assert s == walk
+            walk = e
+        assert walk == 9
+
+
+class TestReshardTransport:
+    """The HTTP transport's reshard surface: multi-slot staging under
+    negative step keys surviving per-step heal retirement, and the
+    ``part_<rank>`` slice-diff resource."""
+
+    def test_part_resource_serves_only_the_destination_slices(self):
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        src = HTTPTransport(timeout=10.0)
+        dst = HTTPTransport(timeout=10.0)
+        try:
+            doc = {
+                "for:1": {"model/w/0:4": np.arange(4, dtype=np.float32)},
+                "for:2": {"model/w/4:8": np.arange(4, 8, dtype=np.float32)},
+            }
+            src.send_checkpoint(
+                dst_ranks=[], step=-3, state_dict=doc, timeout=5.0
+            )
+            got = dst.recv_checkpoint(
+                src_rank=0, metadata=src.metadata(), step=-3, timeout=5.0,
+                resource="part_1",
+            )
+            assert list(got) == ["model/w/0:4"]
+            np.testing.assert_array_equal(
+                got["model/w/0:4"], np.arange(4, dtype=np.float32)
+            )
+            # a rank with nothing routed through this source gets an
+            # empty doc (not a 404/503)
+            got = dst.recv_checkpoint(
+                src_rank=0, metadata=src.metadata(), step=-3, timeout=5.0,
+                resource="part_9",
+            )
+            assert got == {}
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_reshard_slots_survive_heal_retirement(self):
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        t = HTTPTransport(timeout=10.0)
+        try:
+            t.send_checkpoint([], step=5, state_dict={"a": 1}, timeout=5.0)
+            t.send_checkpoint([], step=-2, state_dict={"b": 2}, timeout=5.0)
+            t.disallow_checkpoint()  # the per-step heal retirement
+            assert 5 not in t._staged and -2 in t._staged
+            t.retire_checkpoint(-2)
+            assert t._staged == {}
+        finally:
+            t.shutdown()
+
+    def test_staged_slots_are_bounded(self):
+        from torchft_tpu.checkpointing import http_transport as ht
+
+        t = ht.HTTPTransport(timeout=10.0)
+        try:
+            for step in range(ht._MAX_STAGED + 3):
+                t.send_checkpoint([], step=step, state_dict={}, timeout=5.0)
+            assert len(t._staged) == ht._MAX_STAGED
+            assert 0 not in t._staged  # oldest evicted first
+        finally:
+            t.shutdown()
+
+
+class TestMeshLayoutPGs:
+    def test_row_and_col_pgs_reconfigure_on_commit(self):
+        """A committed layout re-forms the dp-row and shard-column
+        process groups under a per-epoch store prefix — the fleet-
+        synchronous reconfigure an HSDP-across-groups algorithm needs."""
+        from torchft_tpu.parallel.layout import LayoutController
+
+        class _PG:
+            def __init__(self):
+                self.calls = []
+
+            def configure(self, addr, replica_id, rank, world):
+                self.calls.append((addr, rank, world))
+
+        layout = Layout(2, 2, 1, 5)
+        for rank in range(4):
+            mesh = _mesh(_StubManager(4, rank))
+            ctrl = LayoutController(LayoutConstraints())
+            row, col = _PG(), _PG()
+            mesh.attach_layout(ctrl, row_pg=row, col_pg=col)
+            mesh._on_layout_commit(
+                layout, {"rank": rank, "store_address": "host:1"}
+            )
+            dp_rank, shard_rank, pp_rank = layout.coords(rank)
+            (addr, r, w) = row.calls[0]
+            assert r == dp_rank and w == layout.dp
+            assert f"/layout/{layout.epoch}/row/" in addr
+            (addr, r, w) = col.calls[0]
+            assert r == shard_rank and w == layout.shard
+            assert f"/layout/{layout.epoch}/col/" in addr

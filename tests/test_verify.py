@@ -97,7 +97,11 @@ class TestMutationGate:
     def test_every_mutation_has_a_scenario(self):
         assert set(mc.MUTATION_SCENARIOS) == {m.name for m in pm.MUTATIONS}
         for scenario in mc.MUTATION_SCENARIOS.values():
-            assert scenario == "votes" or scenario in mc.SCENARIOS
+            assert (
+                scenario == "votes"
+                or scenario in mc.SCENARIOS
+                or scenario in mc.RESIZE_SCENARIOS
+            )
 
     def test_every_invariant_is_exercised_by_a_mutation(self):
         """No dead invariants: each safety predicate must be the catcher
@@ -130,6 +134,54 @@ class TestVoteSubModel:
         r = mc.explore_votes(mutations=frozenset({"resend_vote"}))
         assert not r.ok
         assert r.violation.invariant == "vote-integrity"
+
+
+class TestResizeSubModel:
+    """ISSUE 11: the online-parallelism-switching (resize) scenario —
+    layout-epoch-monotone + all-commit-same-epoch proven over churn
+    (crash mid-reshard, rejoin, failed transfers) and the two seeded
+    switch-protocol bugs provably caught."""
+
+    def test_clean_resize_space_reaches_switches(self):
+        r = mc.explore_resize(mc.RESIZE_SCENARIOS["resize"])
+        assert r.ok, f"resize scenario violated: {r.violation}"
+        # non-vacuous: the bounded space contains completed switches
+        assert r.goal_states > 0
+
+    def test_exploration_is_deterministic(self):
+        a = mc.explore_resize(mc.RESIZE_SCENARIOS["resize"])
+        b = mc.explore_resize(mc.RESIZE_SCENARIOS["resize"])
+        assert (a.states, a.transitions, a.goal_states) == (
+            b.states, b.transitions, b.goal_states
+        )
+
+    def test_mixed_commit_splits_the_fleet(self):
+        r = mc.explore_resize(
+            mc.RESIZE_SCENARIOS["resize"],
+            mutations=frozenset({"commit_mixed_epochs"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "all-commit-same-epoch"
+
+    def test_epoch_reuse_after_rollback_is_caught(self):
+        r = mc.explore_resize(
+            mc.RESIZE_SCENARIOS["resize"],
+            mutations=frozenset({"reuse_epoch_after_rollback"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "layout-epoch-monotone"
+
+    def test_counterexample_renders_as_flight_dump(self, tmp_path):
+        r = mc.check_mutation("commit_mixed_epochs")
+        assert not r.ok and r.trace
+        path = str(tmp_path / "resize_cex.jsonl")
+        mc.write_flight_dump(r, path)
+        lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert lines[0]["flight"] == "meta"
+        errs = [rec for rec in lines[1:] if rec["status"] == "error"]
+        assert len(errs) == 1
+        # the violating phase renders in the Manager's vocabulary
+        assert errs[0]["op"] == "layout_commit"
 
 
 class TestDiagnoseRoundTrip:

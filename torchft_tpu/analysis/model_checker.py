@@ -36,6 +36,7 @@ from torchft_tpu.analysis.protocol_model import (
     INVISIBLE_OPS,
     MODEL_PHASE_OPS,
     ModelConfig,
+    ResizeConfig,
     State,
     Transition,
     Violation,
@@ -44,6 +45,11 @@ from torchft_tpu.analysis.protocol_model import (
     enabled_transitions,
     initial_state,
     is_goal,
+    resize_apply,
+    resize_check,
+    resize_enabled,
+    resize_initial,
+    resize_is_goal,
     vote_apply,
     vote_check,
     vote_enabled,
@@ -54,8 +60,10 @@ __all__ = [
     "CheckResult",
     "explore",
     "explore_votes",
+    "explore_resize",
     "run_schedule",
     "SCENARIOS",
+    "RESIZE_SCENARIOS",
     "LIVENESS_SCHEDULES",
     "trace_to_flight_dump",
     "write_flight_dump",
@@ -201,6 +209,54 @@ def explore_votes(
     return CheckResult(True, len(seen), transitions, goal, None, ())
 
 
+def explore_resize(
+    cfg: "ResizeConfig" = ResizeConfig(),
+    mutations: "FrozenSet[str]" = frozenset(),
+    max_states: int = 200_000,
+) -> CheckResult:
+    """Exhaustive exploration of the online-parallelism-switching
+    (resize) sub-model: plan at quorum under a monotone layout epoch,
+    stage (can fail, groups can crash mid-reshard), commit on unanimous
+    epoch reports or roll back and burn the epoch."""
+    init = resize_initial(cfg)
+    seen = {init}
+    transitions = 0
+    goal = 0
+    stack = [(init, resize_enabled(cfg, init, mutations), 0)]
+    path: "List[Tuple[str, int, str, int, int]]" = []
+    while stack:
+        st, ts, idx = stack[-1]
+        if idx >= len(ts):
+            stack.pop()
+            if path:
+                path.pop()
+            continue
+        stack[-1] = (st, ts, idx + 1)
+        t = ts[idx]
+        nxt = resize_apply(cfg, st, t, mutations)
+        transitions += 1
+        if nxt in seen:
+            continue
+        seen.add(nxt)
+        op, i = t
+        rid = "lighthouse" if i < 0 else f"r{i}:0"
+        epoch = max((r.epoch for r in st.reps), default=0)
+        path.append((op, i, rid, st.switches, epoch))
+        violations = resize_check(cfg, nxt)
+        if violations:
+            return CheckResult(
+                False, len(seen), transitions, goal, violations[0], tuple(path)
+            )
+        if resize_is_goal(cfg, nxt):
+            goal += 1
+            path.pop()
+            continue
+        if len(seen) >= max_states:
+            raise RuntimeError("resize state-space bound exceeded")
+        stack.append((nxt, resize_enabled(cfg, nxt, mutations), 0))
+    return CheckResult(True, len(seen), transitions, goal, None, ())
+
+
 # ---------------------------------------------------------------------------
 # scenarios (the bounded state spaces tier-1 proves clean)
 # ---------------------------------------------------------------------------
@@ -265,6 +321,22 @@ SCENARIOS: "Dict[str, ModelConfig]" = {
     ),
 }
 
+#: online-parallelism-switching sub-model scenarios (explore_resize):
+#: membership churn + reshard-transfer failures around the two-phase
+#: layout-epoch commit.
+RESIZE_SCENARIOS: "Dict[str, ResizeConfig]" = {
+    # a shrink (crash), a grow (rejoin) and one failed reshard transfer
+    # around two committed switches — the full plan/stage/commit/rollback
+    # space of ISSUE 11's switch protocol
+    "resize": ResizeConfig(
+        n_replicas=3,
+        target_switches=2,
+        crash_budget=1,
+        join_budget=1,
+        stage_fail_budget=1,
+    ),
+}
+
 #: scenario used to catch each mutation (the smallest space where the
 #: mutated behavior is reachable)
 MUTATION_SCENARIOS: "Dict[str, str]" = {
@@ -276,6 +348,8 @@ MUTATION_SCENARIOS: "Dict[str, str]" = {
     "zombie_rejoin": "zombie",
     "ignore_shrink_only": "shrink",
     "resend_vote": "votes",  # vote-barrier sub-model
+    "commit_mixed_epochs": "resize",  # parallelism-switching sub-model
+    "reuse_epoch_after_rollback": "resize",
 }
 
 
@@ -285,6 +359,10 @@ def check_mutation(name: str) -> CheckResult:
     scenario = MUTATION_SCENARIOS[name]
     if scenario == "votes":
         return explore_votes(mutations=frozenset({name}))
+    if scenario in RESIZE_SCENARIOS:
+        return explore_resize(
+            RESIZE_SCENARIOS[scenario], mutations=frozenset({name})
+        )
     return explore(SCENARIOS[scenario], mutations=frozenset({name}))
 
 

@@ -49,6 +49,13 @@ __all__ = [
     "vote_enabled",
     "vote_apply",
     "vote_check",
+    "ResizeConfig",
+    "ResizeState",
+    "resize_initial",
+    "resize_enabled",
+    "resize_apply",
+    "resize_check",
+    "resize_is_goal",
     "MODEL_PHASE_OPS",
 ]
 
@@ -77,6 +84,12 @@ MODEL_PHASE_OPS: "Dict[str, str]" = {
     "zombie_join": "quorum_rpc",
     "expire": "quorum_rpc",
     "timeout": "quorum_rpc",
+    # resize (online-parallelism-switching) sub-model ops
+    "stage": "reshard",
+    "stage_fail": "reshard",
+    "quorum": "quorum_rpc",
+    "plan": "quorum_rpc",
+    "commit_layout": "layout_commit",
 }
 
 
@@ -215,6 +228,20 @@ MUTATIONS: "Tuple[Mutation, ...]" = (
         "should_commit votes are blindly re-sent after a broken "
         "connection (the idempotent=True path PR 2 forbids for votes)",
         "vote-integrity",
+    ),
+    Mutation(
+        "commit_mixed_epochs",
+        "a replica activates its staged layout even when the quorum's "
+        "layout-epoch reports disagree (min < max) — a subset of the "
+        "fleet switches parallelism while the rest keeps the old layout",
+        "all-commit-same-epoch",
+    ),
+    Mutation(
+        "reuse_epoch_after_rollback",
+        "layout planning reuses a rolled-back (burned) epoch value "
+        "instead of advancing past it — a straggler still holding the "
+        "burned stage could later commit stale data under the fresh plan",
+        "layout-epoch-monotone",
     ),
 )
 
@@ -1099,6 +1126,284 @@ def _vote_count(st: VoteState, msg: VoteMsg) -> VoteState:
         at=at,
         decisions=st.decisions + ((st.step, decision),),
     )
+
+
+# ---------------------------------------------------------------------------
+# resize sub-model (online parallelism switching, parallel/layout.py)
+# ---------------------------------------------------------------------------
+#
+# Models the two-phase layout-switch protocol over whole replica groups:
+# a quorum whose live world no longer fits the active layout PLANS the
+# next layout under a fresh monotone epoch; each group then STAGES the
+# reshard transfers (which can fail, or the group can crash mid-stage);
+# the next quorum COMMITS the switch iff every participant reports the
+# staged epoch (min == max == E at the planned world) — otherwise the
+# whole fleet rolls back and the epoch is BURNED, never reused.
+#
+# Layout identity is abstracted to the (world, generation) pair the plan
+# was made for — equal inputs produce equal layouts in the runtime
+# planner, so generation inequality stands in for "different (dp, shard,
+# pp) / different resharded bytes".
+
+
+class ResizeConfig(NamedTuple):
+    """One bounded resize scenario."""
+
+    n_replicas: int = 3
+    target_switches: int = 2  # goal: this many committed layout switches
+    crash_budget: int = 1  # group deaths (staged buffers die with them)
+    join_budget: int = 1  # dead groups re-admitted fresh (epoch 0)
+    stage_fail_budget: int = 1  # reshard transfer failures
+
+
+class RRep(NamedTuple):
+    alive: bool
+    epoch: int  # active layout epoch
+    gen: int  # active layout identity (0 = the implicit seed layout)
+    world: int  # the world the ACTIVE layout was planned for
+    # staged switch awaiting its commit round: (epoch, world, gen)
+    staged: "Optional[Tuple[int, int, int]]"
+    # planned this round, transfer not yet attempted: (epoch, world, gen)
+    pending: "Optional[Tuple[int, int, int]]"
+
+
+class RGhost(NamedTuple):
+    """Spec-side bookkeeping; never read by the (mutable) behavior."""
+
+    # epoch value -> generation it was first planned under (epoch reuse
+    # across generations is the layout-epoch-monotone violation)
+    epoch_gens: "Tuple[Tuple[int, int], ...]"
+    # last quorum's (participant_count, activator_count, distinct (epoch,
+    # gen) pairs activated) — the switch-atomicity record
+    last_round: "Optional[Tuple[int, int, int]]"
+    # last activation per replica: (replica, prev_epoch, new_epoch)
+    last_activation: "Optional[Tuple[int, int, int]]"
+
+
+class ResizeState(NamedTuple):
+    reps: "Tuple[RRep, ...]"
+    highest: int  # highest epoch ever planned (behavior-side)
+    burned: "FrozenSet[int]"  # rolled-back epochs (behavior-side)
+    gen_seq: int  # plan counter
+    switches: int  # committed switch rounds so far
+    ghost: RGhost
+    crashes: int
+    joins: int
+    stage_fails: int
+
+
+def resize_initial(cfg: ResizeConfig) -> ResizeState:
+    # seed: every group runs the implicit pure-DP layout at epoch 0,
+    # planned (by construction) for the full initial fleet
+    reps = tuple(
+        RRep(
+            alive=True, epoch=0, gen=0, world=cfg.n_replicas,
+            staged=None, pending=None,
+        )
+        for _ in range(cfg.n_replicas)
+    )
+    return ResizeState(
+        reps=reps,
+        highest=0,
+        burned=frozenset(),
+        gen_seq=0,
+        switches=0,
+        ghost=RGhost(epoch_gens=(), last_round=None, last_activation=None),
+        crashes=cfg.crash_budget,
+        joins=cfg.join_budget,
+        stage_fails=cfg.stage_fail_budget,
+    )
+
+
+def _resize_live(st: ResizeState) -> "List[int]":
+    return [i for i, r in enumerate(st.reps) if r.alive]
+
+
+def resize_enabled(
+    cfg: ResizeConfig,
+    st: ResizeState,
+    mutations: "FrozenSet[str]" = frozenset(),
+) -> "List[Transition]":
+    del mutations  # the mutated behaviors live in resize_apply
+    out: "List[Transition]" = []
+    for i, r in enumerate(st.reps):
+        if r.alive and r.pending is not None:
+            out.append(("stage", i))
+            if st.stage_fails > 0:
+                out.append(("stage_fail", i))
+        if r.alive and st.crashes > 0:
+            out.append(("crash", i))
+        if not r.alive and st.joins > 0:
+            out.append(("join", i))
+    live = _resize_live(st)
+    # the quorum barrier: everyone alive finished (or skipped) staging
+    if live and all(st.reps[i].pending is None for i in live):
+        if st.switches < cfg.target_switches:
+            out.append(("quorum", -1))
+    return sorted(out)
+
+
+def resize_apply(
+    cfg: ResizeConfig,
+    st: ResizeState,
+    t: Transition,
+    mutations: "FrozenSet[str]" = frozenset(),
+) -> ResizeState:
+    op, i = t
+    reps = list(st.reps)
+    ghost = st.ghost
+
+    if op == "stage":
+        r = reps[i]
+        assert r.pending is not None
+        reps[i] = r._replace(staged=r.pending, pending=None)
+        return st._replace(reps=tuple(reps))
+
+    if op == "stage_fail":
+        r = reps[i]
+        # transfer failed: nothing staged; the commit round sees this
+        # group still reporting its old epoch and rolls the fleet back
+        reps[i] = r._replace(pending=None)
+        return st._replace(reps=tuple(reps), stage_fails=st.stage_fails - 1)
+
+    if op == "crash":
+        reps[i] = reps[i]._replace(alive=False, staged=None, pending=None)
+        return st._replace(reps=tuple(reps), crashes=st.crashes - 1)
+
+    if op == "join":
+        # a fresh incarnation: no layout history, no sharded data (its
+        # world=0 can never equal a live world, forcing a fleet re-plan
+        # that fetches its shard — exactly the runtime's joiner path)
+        reps[i] = RRep(
+            alive=True, epoch=0, gen=0, world=0, staged=None, pending=None
+        )
+        return st._replace(reps=tuple(reps), joins=st.joins - 1)
+
+    if op == "quorum":
+        live = _resize_live(st)
+        world = len(live)
+        reported = {
+            j: (reps[j].staged[0] if reps[j].staged is not None else reps[j].epoch)
+            for j in live
+        }
+        min_e, max_e = min(reported.values()), max(reported.values())
+        staged_pairs = {
+            reps[j].staged for j in live if reps[j].staged is not None
+        }
+        switches = st.switches
+        burned = st.burned
+        activators: "List[int]" = []
+        activated_pairs: "set" = set()
+        # --- commit / rollback of the previous round's stage ------------
+        unanimous = (
+            len(staged_pairs) == 1
+            and all(reps[j].staged is not None for j in live)
+            and min_e == max_e
+            and next(iter(staged_pairs))[1] == world
+        )
+        for j in live:
+            r = reps[j]
+            if r.staged is None:
+                continue
+            if unanimous or "commit_mixed_epochs" in mutations:
+                e, w, g = r.staged
+                ghost = ghost._replace(last_activation=(j, r.epoch, e))
+                activators.append(j)
+                activated_pairs.add((e, g))
+                reps[j] = r._replace(epoch=e, gen=g, world=w, staged=None)
+            else:
+                burned = burned | {r.staged[0]}
+                reps[j] = r._replace(staged=None)
+        ghost = ghost._replace(
+            last_round=(len(live), len(activators), len(activated_pairs))
+        )
+        if activators and len(activators) == len(live):
+            switches += 1
+        # --- plan the next switch if the world no longer fits -----------
+        live_reps = [reps[j] for j in live]
+        uniform = len({(r.epoch, r.gen, r.world) for r in live_reps}) == 1
+        needs_plan = (not uniform) or live_reps[0].world != world
+        new_highest = st.highest
+        gen_seq = st.gen_seq
+        if needs_plan:
+            if "reuse_epoch_after_rollback" in mutations and burned:
+                epoch = max(burned)
+            else:
+                epoch = max(new_highest, max_e) + 1
+            new_highest = max(new_highest, epoch)
+            gen_seq += 1
+            ghost = ghost._replace(
+                epoch_gens=ghost.epoch_gens + ((epoch, gen_seq),)
+            )
+            for j in live:
+                reps[j] = reps[j]._replace(
+                    pending=(epoch, world, gen_seq)
+                )
+        return st._replace(
+            reps=tuple(reps),
+            highest=new_highest,
+            burned=burned,
+            gen_seq=gen_seq,
+            switches=switches,
+            ghost=ghost,
+        )
+
+    raise AssertionError(f"unknown resize transition {t}")
+
+
+def resize_check(cfg: ResizeConfig, st: ResizeState) -> "List[Violation]":
+    out: "List[Violation]" = []
+    # layout-epoch-monotone: (a) an epoch value is bound to exactly one
+    # generation — burned epochs are never reused; (b) activations
+    # strictly advance the replica's epoch.
+    seen: "Dict[int, int]" = {}
+    for epoch, gen in st.ghost.epoch_gens:
+        if epoch in seen and seen[epoch] != gen:
+            out.append(
+                Violation(
+                    "layout-epoch-monotone",
+                    f"layout epoch {epoch} planned twice (generations "
+                    f"{seen[epoch]} and {gen}) — a rolled-back epoch was "
+                    f"reused, so a straggler's stale stage could commit "
+                    f"under the fresh plan",
+                    "lighthouse",
+                    "plan",
+                )
+            )
+        seen.setdefault(epoch, gen)
+    la = st.ghost.last_activation
+    if la is not None and la[2] <= la[1]:
+        out.append(
+            Violation(
+                "layout-epoch-monotone",
+                f"replica r{la[0]} activated epoch {la[2]} over active "
+                f"epoch {la[1]} — layout epochs must strictly advance",
+                f"r{la[0]}:0",
+                "commit_layout",
+            )
+        )
+    # all-commit-same-epoch: a switch is fleet-atomic — either every
+    # quorum participant activates (one identical layout) or none does.
+    lr = st.ghost.last_round
+    if lr is not None:
+        participants, activators, distinct = lr
+        if 0 < activators < participants or distinct > 1:
+            out.append(
+                Violation(
+                    "all-commit-same-epoch",
+                    f"layout commit split the fleet: {activators} of "
+                    f"{participants} participants activated "
+                    f"({distinct} distinct layouts) — every replica must "
+                    f"switch at the same round or not at all",
+                    "lighthouse",
+                    "commit_layout",
+                )
+            )
+    return out
+
+
+def resize_is_goal(cfg: ResizeConfig, st: ResizeState) -> bool:
+    return st.switches >= cfg.target_switches
 
 
 def vote_check(st: VoteState) -> "List[Violation]":

@@ -80,6 +80,10 @@ def run_explore_all(verbose: bool = False) -> int:
     r = mc.explore_votes()
     _print_result("votes", r, verbose)
     bad += 0 if r.ok else 1
+    for name, rcfg in mc.RESIZE_SCENARIOS.items():
+        r = mc.explore_resize(rcfg)
+        _print_result(name, r, verbose)
+        bad += 0 if r.ok else 1
     print(f"explored clean in {time.monotonic() - t0:.1f}s"
           if not bad else f"{bad} scenario(s) violated")
     return 1 if bad else 0
@@ -222,6 +226,8 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     if args.list:
         for name, cfg in mc.SCENARIOS.items():
             print(f"scenario {name:12s} {cfg}")
+        for name, rcfg in mc.RESIZE_SCENARIOS.items():
+            print(f"scenario {name:12s} {rcfg}")
         for m in MUTATIONS:
             print(f"mutation {m.name:26s} -> {m.catches}: {m.doc}")
         for name, scenario, rotation in mc.LIVENESS_SCHEDULES:
@@ -247,6 +253,10 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
                   f"(render: torchft-diagnose {args.dump})")
         return 1 if not r.ok else 0
     if args.scenario:
+        if args.scenario in mc.RESIZE_SCENARIOS:
+            r = mc.explore_resize(mc.RESIZE_SCENARIOS[args.scenario])
+            _print_result(args.scenario, r, args.verbose)
+            return 0 if r.ok else 1
         if args.scenario not in mc.SCENARIOS:
             print(f"tft-verify: unknown scenario {args.scenario!r} "
                   f"(see --list)", file=sys.stderr)

@@ -38,6 +38,11 @@ logger = logging.getLogger(__name__)
 # snapshot — poll through retryable 503s (and connection errors during a
 # sender restart) with jittered backoff until the receiver's deadline.
 # Permanent failures (404 bad path / chunk range) fail immediately.
+#: Staged-snapshot slots kept live at once (heal steps + reshard epochs);
+#: oldest-inserted evicts first.  4 covers a heal and a reshard in flight
+#: plus one superseded generation of each.
+_MAX_STAGED = 4
+
 _FETCH_POLICY = RetryPolicy(
     name="transport.http.fetch",
     base_delay=0.05,
@@ -88,8 +93,8 @@ class _Handler(BaseHTTPRequestHandler):
             # Hold the read lock for the whole serve so the snapshot can't be
             # retired mid-stream (reference http_transport.py:77-131).
             with transport._staged_lock.r_lock(timeout=transport._lock_timeout):
-                staged = transport._staged
-                if staged is None or staged[0] != step:
+                staged = transport._staged.get(step)
+                if staged is None:
                     # Healer raced the sender's staging: retryable 503 (the
                     # receiver polls until its deadline). Permanent problems
                     # (bad path, chunk out of range) stay 404 and fail fast.
@@ -98,7 +103,7 @@ class _Handler(BaseHTTPRequestHandler):
                         f"no checkpoint staged for step {step}",
                     )
                     return
-                _, state_dict, num_chunks = staged
+                state_dict, num_chunks = staged
                 if what == "full":
                     indices = None
                 elif what == "metadata":
@@ -110,6 +115,22 @@ class _Handler(BaseHTTPRequestHandler):
                         self.send_error(404, "chunk out of range")
                         return
                     indices = chunks[idx]
+                elif what.startswith("part_"):
+                    # Reshard slice-diff serving (parallel/layout.py): the
+                    # staged doc maps "for:<rank>" to the slices planned
+                    # for that destination; serve exactly that sub-dict so
+                    # the wire carries only the destination's missing
+                    # intervals.  An empty sub-dict (nothing routed through
+                    # this source) is a valid, tiny payload — NOT a 404 —
+                    # so a racing fetcher can distinguish "staged, nothing
+                    # for you" from "not staged yet" (503 above).
+                    try:
+                        part = int(what[len("part_"):])
+                    except ValueError:
+                        self.send_error(400, "bad part rank")
+                        return
+                    state_dict = state_dict.get(f"for:{part}", {})
+                    indices = None
                 else:
                     self.send_error(404, "unknown resource")
                     return
@@ -176,6 +197,11 @@ class HTTPTransport(CheckpointTransport[Any]):
             page-fault during recv and halve effective bandwidth).
     """
 
+    #: This transport can serve the live-reshard slice-diff protocol
+    #: (multi-slot staging + ``part_<rank>`` resources + ``resource=``
+    #: fetches); parallel/layout.py gates data-moving switches on it.
+    supports_reshard = True
+
     def __init__(
         self,
         timeout: float = 60.0,
@@ -185,7 +211,13 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._lock_timeout = timeout
         self._num_chunks = num_chunks
         self._state_dict_fn = state_dict_fn
-        self._staged: "Optional[tuple[int, Any, int]]" = None
+        # Staged snapshots keyed by step.  Heal staging uses the real
+        # (>= 0) step and is retired per step by disallow_checkpoint();
+        # live-reshard staging (parallel/layout.py) uses NEGATIVE keys
+        # derived from the layout epoch so it survives the per-step heal
+        # retirement until the switch commits or rolls back.  Bounded:
+        # oldest slots are evicted past _MAX_STAGED.
+        self._staged: "dict[int, tuple[Any, int]]" = {}
         self._staged_lock = RWLock(timeout=timeout)
         self._server = _make_server()
         self._server.transport = self  # type: ignore[attr-defined]
@@ -218,25 +250,43 @@ class HTTPTransport(CheckpointTransport[Any]):
             lambda x: np.asarray(x) if hasattr(x, "__array__") else x, state_dict
         )
         with self._staged_lock.w_lock(timeout=timeout):
-            self._staged = (step, host_sd, max(self._num_chunks, 1))
+            self._staged[step] = (host_sd, max(self._num_chunks, 1))
+            while len(self._staged) > _MAX_STAGED:
+                self._staged.pop(next(iter(self._staged)))
         _flightrec.record(
             "checkpoint.http.stage", start_ns=t0_ns, step=step,
             dst_ranks=list(dst_ranks),
         )
 
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        resource: "Optional[str]" = None,
     ) -> Any:
+        """Fetch a staged snapshot from ``metadata``'s server.  With
+        ``resource`` (e.g. ``part_<rank>``, the reshard slice-diff
+        payload) that single resource is fetched instead of the
+        full/chunked stream."""
         _faults.check("transport.recv", step=step)
         # in-flight op for the whole heal fetch: a healer wedged mid-fetch
         # shows up in the flight dump with src/step context
         with _flightrec.track(
             "checkpoint.http.recv", step=step, src_rank=src_rank,
         ):
-            return self._recv_checkpoint(src_rank, metadata, step, timeout)
+            return self._recv_checkpoint(
+                src_rank, metadata, step, timeout, resource
+            )
 
     def _recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        resource: "Optional[str]" = None,
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
         deadline = time.monotonic() + timeout
@@ -293,6 +343,11 @@ class HTTPTransport(CheckpointTransport[Any]):
                 transport="http", direction="recv"
             ).observe(time.perf_counter() - t_recv)
 
+        if resource is not None:
+            skeleton, leaves, n = fetch(resource)
+            _done()
+            return ser.reassemble(skeleton, leaves, n)
+
         if self._num_chunks <= 0:
             skeleton, leaves, n = fetch("full")
             _done()
@@ -309,8 +364,18 @@ class HTTPTransport(CheckpointTransport[Any]):
         return ser.reassemble(skeleton, merged, n)
 
     def disallow_checkpoint(self) -> None:
+        """Retire heal snapshots (real, >= 0 step keys) before the
+        optimizer mutates parameters.  Reshard staging (negative keys)
+        stays until its switch commits/rolls back — peers may still be
+        mid-fetch when this group's step commits."""
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
-            self._staged = None
+            self._staged = {k: v for k, v in self._staged.items() if k < 0}
+
+    def retire_checkpoint(self, step: int) -> None:
+        """Drop one staged snapshot (the reshard slots' explicit
+        retirement path); no-op when absent."""
+        with self._staged_lock.w_lock(timeout=self._lock_timeout):
+            self._staged.pop(step, None)
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()

@@ -66,6 +66,10 @@ class QuorumMember:
     world_size: int = 1
     shrink_only: bool = False
     commit_failures: int = 0
+    # Online parallelism switching (parallel/layout.py): the member's
+    # current/staged layout epoch — the monotone counter the two-phase
+    # layout commit is keyed on (docs/protocol.md "Layout epochs").
+    layout_epoch: int = 0
     data: str = ""
 
     @staticmethod
@@ -79,6 +83,7 @@ class QuorumMember:
             world_size=d.get("world_size", 1),
             shrink_only=d.get("shrink_only", False),
             commit_failures=d.get("commit_failures", 0),
+            layout_epoch=d.get("layout_epoch", 0),
             data=d.get("data", ""),
         )
 
@@ -92,6 +97,7 @@ class QuorumMember:
             "world_size": self.world_size,
             "shrink_only": self.shrink_only,
             "commit_failures": self.commit_failures,
+            "layout_epoch": self.layout_epoch,
             "data": self.data,
         }
 
@@ -131,6 +137,17 @@ class QuorumResult:
     max_world_size: int = 1
     heal: bool = False
     commit_failures: int = 0
+    # Online parallelism switching (parallel/layout.py): the min/max
+    # layout epoch reported across the quorum (min == max == E is the
+    # fleet-wide commit signal for a staged layout at epoch E) and the
+    # participant roster in replica-rank order — each entry carries
+    # replica_id, manager address, layout_epoch and the opaque shard
+    # manifest, which is what lets every group compute the same reshard
+    # slice-diff plan with zero extra RPCs.
+    max_layout_epoch: int = 0
+    min_layout_epoch: int = 0
+    # roster entries are {replica_id, address, layout_epoch, data} dicts
+    participants: List[Any] = field(default_factory=list)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "QuorumResult":
@@ -148,6 +165,9 @@ class QuorumResult:
             max_world_size=d.get("max_world_size", 1),
             heal=d.get("heal", False),
             commit_failures=d.get("commit_failures", 0),
+            max_layout_epoch=d.get("max_layout_epoch", 0),
+            min_layout_epoch=d.get("min_layout_epoch", 0),
+            participants=list(d.get("participants", [])),
         )
 
 
@@ -701,7 +721,14 @@ class ManagerClient:
         timeout: "float | timedelta",
         init_sync: bool = True,
         commit_failures: int = 0,
+        layout_epoch: int = 0,
+        layout_data: str = "",
     ) -> QuorumResult:
+        """Per-rank quorum entry.  ``layout_epoch`` / ``layout_data`` are
+        the online-parallelism-switching fields (parallel/layout.py): the
+        group's current/staged layout epoch and its opaque shard manifest,
+        forwarded into the lighthouse QuorumMember so every participant's
+        result carries the fleet's epoch spread + manifests."""
         result = self._client.call(
             "quorum",
             {
@@ -711,6 +738,8 @@ class ManagerClient:
                 "shrink_only": shrink_only,
                 "init_sync": init_sync,
                 "commit_failures": commit_failures,
+                "layout_epoch": layout_epoch,
+                "layout_data": layout_data,
             },
             timeout,
         )

@@ -94,6 +94,10 @@ PHASE_CATEGORY = {
     "ring": "wire",
     "heal_send": "wire",
     "heal_recv": "wire",
+    # online parallelism switching (parallel/layout.py): the reshard
+    # slice-diff transfers are wire cost; the commit round is protocol
+    "reshard": "wire",
+    "layout_commit": "protocol",
 }
 
 #: the ledger's full category vocabulary, in render order

@@ -74,6 +74,8 @@ PROTOCOL_PHASES = (
     "pg_configure",
     "heal_send",
     "heal_recv",
+    "reshard",
+    "layout_commit",
     "host_sync",
     "ring",
     "commit",
@@ -351,6 +353,12 @@ class Manager:
         self._summary_phase_snapshot: Dict[str, float] = {}
         self._summary_codec_s = 0.0
         self._summary_wire_s = 0.0
+        # Online parallelism switching (parallel/layout.py): optional
+        # LayoutController attached via attach_layout().  When present,
+        # every quorum entry carries this group's layout epoch + shard
+        # manifest, and the async-quorum thread runs the two-phase
+        # switch protocol (commit round first, then plan+stage).
+        self._layout: "Optional[Any]" = None
 
     @staticmethod
     def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
@@ -377,6 +385,22 @@ class Manager:
         (reference manager.py:355-366)."""
         self._load_state_dict_fns[key] = load_state_dict_fn
         self._user_state_dicts[key] = state_dict_fn
+
+    def attach_layout(self, controller: Any) -> Any:
+        """Attach a :class:`~torchft_tpu.parallel.layout.LayoutController`
+        enabling online parallelism switching: on membership change the
+        fleet re-plans its (dp, shard, pp) layout under a monotone layout
+        epoch, re-shards registered state live over the checkpoint
+        transport, and commits the switch at the same quorum round on
+        every group or rolls back (docs/architecture.md "Online
+        parallelism switching").  Returns the controller for chaining."""
+        self._layout = controller
+        if hasattr(controller, "bind"):
+            controller.bind(self)
+        return controller
+
+    def layout_controller(self) -> "Optional[Any]":
+        return self._layout
 
     def _manager_state_dict(self) -> "Dict[str, Any]":
         with self._state_dict_lock.r_lock():
@@ -493,6 +517,12 @@ class Manager:
                         timeout=budget if budget is not None else quorum_timeout,
                         init_sync=self._init_sync,
                         commit_failures=self._commit_failures,
+                        layout_epoch=(
+                            0 if self._layout is None else self._layout.wire_epoch()
+                        ),
+                        layout_data=(
+                            "" if self._layout is None else self._layout.wire_data()
+                        ),
                     )
 
                 quorum = self._quorum_policy.run(
@@ -527,6 +557,64 @@ class Manager:
                 and self._participating_replica_rank >= self._min_replica_size
             ):
                 self._participating_replica_rank = None
+
+        # Online parallelism switching, two-phase (parallel/layout.py):
+        # FIRST resolve the previous round's staged switch (commit when
+        # the whole quorum reports the staged epoch, else roll back and
+        # burn it), THEN — if the live world no longer fits the active
+        # layout — plan the next layout and run the reshard transfers on
+        # this thread, where heal runs.  Neither phase may fail the
+        # training step: a broken switch degrades to the old layout.
+        # Runs BEFORE pg configure and the allow_heal gate: this round's
+        # quorum entry already advertised our epoch report, so skipping
+        # the commit round here (configure error, heal-less round) would
+        # let the rest of the fleet activate without us — the exact
+        # mixed-generation split the all-commit-same-epoch invariant
+        # forbids.  The transfers ride the checkpoint transport, not the
+        # PG, so ordering before configure is safe.
+        if self._layout is not None:
+            t_lc = time.perf_counter()
+            outcome = ""
+            try:
+                faults.check(
+                    "manager.layout_commit",
+                    replica=self._replica_id,
+                    step=quorum.max_step,
+                )
+                outcome = self._layout.maybe_commit(quorum)
+            except Exception as e:  # noqa: BLE001 - degrade, never wedge
+                self._logger.exception(f"layout commit failed: {e}")
+                self._layout.abort_staged(f"layout commit failed: {e}")
+                outcome = "rolled_back"
+            if outcome:
+                self._record_phase("layout_commit", time.perf_counter() - t_lc)
+                metrics.LAYOUT_SWITCHES.labels(
+                    replica_id=self._metric_replica_id, result=outcome
+                ).inc()
+                active = self._layout.active_layout()
+                metrics.LAYOUT_EPOCH.labels(
+                    replica_id=self._metric_replica_id
+                ).set(active.epoch if active is not None else 0)
+                log_event(
+                    "layout",
+                    f"layout switch {outcome}",
+                    job_id=env_str("JOB_ID", "unknown"),
+                    replica_id=self._replica_id,
+                    rank=self._group_rank,
+                    quorum_id=quorum.quorum_id,
+                    step=quorum.max_step,
+                    outcome=outcome,
+                    layout=str(active.key() if active is not None else None),
+                )
+            t_rs = time.perf_counter()
+            try:
+                staged = self._layout.maybe_stage(self, quorum)
+            except Exception as e:  # noqa: BLE001 - degrade, never wedge
+                self._logger.exception(f"layout staging failed: {e}")
+                self._layout.abort_staged(f"layout staging failed: {e}")
+                staged = True
+            if staged:
+                self._record_phase("reshard", time.perf_counter() - t_rs)
 
         if quorum.quorum_id != self._quorum_id:
             metrics.QUORUM_CHANGES.labels(replica_id=self._metric_replica_id).inc()
@@ -914,6 +1002,13 @@ class Manager:
             commit_result=should_commit,
         )
 
+        # Layout two-phase hook: the barrier outcome decides whether a
+        # staged reshard survives into the next quorum's commit round —
+        # every local rank observes the same vote, so the whole group
+        # either carries the staged epoch or burns it together.
+        if self._layout is not None:
+            self._layout.on_step_commit(should_commit)
+
         self._checkpoint_transport.disallow_checkpoint()
 
         # Raised AFTER the round's root span closes below: the terminally
@@ -1046,7 +1141,11 @@ class Manager:
         caller was waiting FOR): ``quorum_rpc`` (the lighthouse-mediated
         quorum round trip), ``pg_configure`` (collective reconfigure on
         quorum change), ``heal_send`` / ``heal_recv`` (live checkpoint
-        transfer to/from a recovering peer, incl. the metadata fetch).
+        transfer to/from a recovering peer, incl. the metadata fetch),
+        ``reshard`` (online-parallelism-switch staging: plan + slice-diff
+        transfers into the staged buffer) and ``layout_commit`` (the
+        fleet-wide activate/rollback of a staged layout at the commit
+        round) — both only with a LayoutController attached.
 
         (``pop_phase_times``, the destructive single-consumer drain this
         replaced, was deprecated in PR 3 and removed in PR 9.)

@@ -33,6 +33,17 @@ from torchft_tpu.manager import Manager
 class ManagedDeviceMesh:
     """An inner JAX mesh plus the elastic FT replicate dimension.
 
+    With a :class:`~torchft_tpu.parallel.layout.LayoutController`
+    attached (:meth:`attach_layout`), the replicate dimension itself
+    becomes a live ``dp x shard x pp`` grid: on every committed layout
+    switch the mesh re-forms its cross-group process groups (the dp row
+    this group averages gradients with, and the shard column it
+    re-partitions parameters across) against the quorum store under a
+    per-epoch prefix — so collectives after the switch can never mix
+    layout generations — and ``global_batch_slice`` partitions the batch
+    over the ``dp`` dimension only (shard/pp peers of one replica train
+    the same examples).
+
     Args:
         manager: FT manager owning the replica dimension.
         mesh: inner ``jax.sharding.Mesh`` (ICI dims: fsdp/tp/sp/...).
@@ -48,6 +59,71 @@ class ManagedDeviceMesh:
         self._manager = manager
         self.mesh = mesh
         self.replicate_dim_name = replicate_dim_name
+        self._layout_ctrl: "Optional[Any]" = None
+        self._row_pg: "Optional[Any]" = None
+        self._col_pg: "Optional[Any]" = None
+        self._grid_rank: "Optional[int]" = None
+
+    # -- online parallelism switching (parallel/layout.py) -----------------
+
+    def attach_layout(
+        self,
+        controller: Any,
+        row_pg: "Optional[Any]" = None,
+        col_pg: "Optional[Any]" = None,
+    ) -> Any:
+        """Subscribe this mesh to layout commits.  ``row_pg`` (optional)
+        is re-configured over the dp row (same shard+pp coordinates) on
+        every committed switch; ``col_pg`` over the shard column (same
+        dp+pp coordinates) — the process groups an HSDP-across-groups
+        algorithm reduces over.  Returns the controller."""
+        self._layout_ctrl = controller
+        self._row_pg = row_pg
+        self._col_pg = col_pg
+        controller.add_listener(self._on_layout_commit)
+        return controller
+
+    def _on_layout_commit(self, layout: Any, info: "Dict[str, Any]") -> None:
+        """Re-form the cross-group process groups for the new grid.  The
+        store prefix embeds the layout epoch, so two generations can
+        never rendezvous with each other — every replica switches at the
+        same quorum round, making this a fleet-synchronous reconfigure."""
+        rank = info.get("rank")
+        self._grid_rank = rank
+        if rank is None:
+            return
+        dp_rank, shard_rank, pp_rank = layout.coords(rank)
+        store = info.get("store_address", "")
+        replica_id = self._manager.replica_id()
+        if self._row_pg is not None and store:
+            self._row_pg.configure(
+                f"{store}/torchft/layout/{layout.epoch}/row/"
+                f"{shard_rank}_{pp_rank}/{dp_rank}",
+                replica_id,
+                dp_rank,
+                layout.dp,
+            )
+        if self._col_pg is not None and store:
+            self._col_pg.configure(
+                f"{store}/torchft/layout/{layout.epoch}/col/"
+                f"{dp_rank}_{pp_rank}/{shard_rank}",
+                replica_id,
+                shard_rank,
+                layout.shard,
+            )
+
+    def layout(self) -> "Optional[Any]":
+        """The active (dp, shard, pp) layout, or None when no controller
+        is attached / nothing committed yet."""
+        if self._layout_ctrl is None:
+            return None
+        return self._layout_ctrl.active_layout()
+
+    def row_pg(self) -> "Optional[Any]":
+        return self._row_pg
+
+    def col_pg(self) -> "Optional[Any]":
+        return self._col_pg
 
     # -- virtual replicate dim (live quorum values) ------------------------
 
@@ -79,11 +155,27 @@ class ManagedDeviceMesh:
 
         Returns the empty slice (0, 0) while not participating (healing /
         no quorum yet) — defaulting to rank 0's slice would silently train
-        on another replica's data."""
+        on another replica's data.
+
+        Partition contract (property-tested across shrink/grow in
+        tests/test_layout.py): over the participating ranks the slices
+        tile [0, global_batch_size) exactly — no overlap, no gap — under
+        ANY participant count, including counts larger than the batch.
+        With a committed layout whose grid matches the live participant
+        count, the batch partitions over the ``dp`` dimension only and
+        shard/pp peers of one dp replica receive the same slice."""
         rank = self.replica_rank()
         if rank is None or not self.is_participating():
             return 0, 0
         n = max(self.num_participants(), 1)
+        layout = self.layout()
+        if layout is not None and layout.world == n and layout.dp != n:
+            # dp-dim slicing: shard/pp peers train the same examples.
+            # Guarded on the grid matching the live count — mid-switch
+            # (membership changed, commit pending) the flat partition
+            # below keeps the tiling exact.
+            dp_rank, _, _ = layout.coords(rank)
+            rank, n = dp_rank, layout.dp
         per, rem = divmod(global_batch_size, n)
         # first `rem` ranks take one extra example so every example in the
         # global batch is assigned under any elastic membership

@@ -30,6 +30,12 @@ site                  fires in
                       (``step`` = chunk index)
 ``pg.allreduce.hop``  hierarchical plan driver before each chunk's
                       inter-host hops (``step`` = chunk index)
+``mesh.reshard``      ``parallel/layout.py`` reshard staging, before each
+                      per-source slice-diff fetch (``step`` = layout
+                      epoch)
+``manager.layout_commit``  ``Manager._async_quorum`` before the layout
+                      commit round is resolved (``step`` = quorum
+                      max_step)
 ``transport.send``    ``send_checkpoint`` of both checkpoint transports
 ``transport.recv``    ``recv_checkpoint`` of both checkpoint transports
 ``store.barrier``     blocking ``StoreClient.get(wait=True)`` (the
@@ -109,6 +115,8 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "pg.allreduce",
     "pg.allreduce.chunk",
     "pg.allreduce.hop",
+    "mesh.reshard",
+    "manager.layout_commit",
     "transport.send",
     "transport.recv",
     "store.barrier",

@@ -56,6 +56,9 @@ _LOGGERS = {
     "reconfigure": logging.getLogger("torchft_reconfigures"),
     # chaos layer: every injected fault (utils/faults.py)
     "fault": logging.getLogger("torchft_faults"),
+    # online parallelism switching: layout plans, reshard staging,
+    # fleet-wide commit/rollback (parallel/layout.py)
+    "layout": logging.getLogger("torchft_layouts"),
 }
 
 _lock = threading.Lock()

@@ -968,6 +968,25 @@ QUANT_OVERLAP_EFFICIENCY = gauge(
     "pipelined, 0.0 = fully serialized",
     ("wire",),
 )
+LAYOUT_EPOCH = gauge(
+    "torchft_layout_epoch",
+    "Active layout epoch of the online-parallelism-switching protocol "
+    "(parallel/layout.py; monotone, bumped per committed switch)",
+    ("replica_id",),
+)
+LAYOUT_SWITCHES = counter(
+    "torchft_layout_switches_total",
+    "Layout-switch commit rounds by outcome (committed = the whole "
+    "fleet activated the staged layout; rolled_back = the epoch was "
+    "burned and the old layout kept)",
+    ("replica_id", "result"),
+)
+RESHARD_BYTES = counter(
+    "torchft_reshard_bytes_total",
+    "Bytes fetched from peers by the live-reshard slice-diff transfers "
+    "(parallel/layout.py; only missing intervals cross the wire)",
+    ("replica_id",),
+)
 FAULTS_INJECTED = counter(
     "torchft_faults_injected_total",
     "Chaos faults injected by site and action (utils/faults.py registry)",

@@ -61,6 +61,9 @@ _SEVERITY = {
     # injected chaos faults are deliberate, but a collector should still
     # be able to alert on them leaking into a production deployment
     "fault": (13, "WARN"),
+    # layout switches are planned membership responses, not errors; the
+    # rolled_back outcome is surfaced via the event body + metrics
+    "layout": (9, "INFO"),
 }
 
 
