@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving serve-soak
 
 native:
 	$(MAKE) -C native
@@ -46,6 +46,25 @@ trace-smoke:
 # "Online parallelism switching").
 reshard-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reshard_integ.py -q -m "not slow"
+
+# Weight-serving tier round trip alone: tree synthesis, payload codec,
+# fan-out round trips, and the tier-1 chaos smoke — kill a tree node
+# mid-fetch, clients complete from a failover source with
+# bitwise-identical weights (docs/architecture.md "Weight-serving tier").
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serving.py -q -m "not slow"
+
+# The slow serving soak: 32 stub clients against a churning tree with
+# staggered server kills; asserts the p99 fetch bound and zero failed
+# fetches after failover settles.
+serve-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serving.py -q -m "slow"
+
+# Serving bench alone: sustained checkpoints/sec + client fetch p50/p99
+# at stub-client load with a chaos kill of a tree node mid-fetch; ends
+# with the same < 1.5 KB compact-summary JSON line as the full bench.
+bench-serving:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

@@ -1796,6 +1796,190 @@ def bench_model() -> "Dict[str, Any]":
 # line alone is several KB, so its head (with the primary metric) was
 # truncated out of r5's capture.  The compact summary printed after it
 # must always fit the tail window with room for the trailing newline.
+# ---------------------------------------------------------------------------
+# serving: fan-out weight distribution under churn (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+SERVING_SERVERS = 4
+SERVING_CLIENTS = 8
+SERVING_RUN_S = 12.0
+SERVING_LEAVES = 8
+SERVING_LEAF_ELEMS = 64 * 1024  # 8 x 64k fp32 = 2 MB payload
+
+
+def bench_serving() -> "Dict[str, Any]":
+    """Weight-serving tier under churn: a publisher streams versioned
+    int8 payloads through a lighthouse-synthesized fan-out tree of
+    ``SERVING_SERVERS`` relays while ``SERVING_CLIENTS`` stub clients
+    fetch the latest version in a loop; mid-run the chaos kill takes a
+    TREE NODE down while fetches are in flight.  Headlines: sustained
+    published+delivered checkpoints/sec, client fetch p50/p99, failover
+    count, and the bitwise-identity check after failover (a client's
+    post-kill fetch must decode byte-identical to the published
+    payload).  docs/architecture.md "Weight-serving tier"."""
+    from torchft_tpu.ops import quantization as q
+    from torchft_tpu.serving import (
+        ServingClient,
+        ServingReplica,
+        WeightPublisher,
+    )
+
+    rng = np.random.RandomState(7)
+    base = {
+        f"layer{i}": rng.randn(SERVING_LEAF_ELEMS).astype(np.float32)
+        for i in range(SERVING_LEAVES)
+    }
+    payload_bytes = sum(a.nbytes for a in base.values())
+
+    lh = LighthouseServer(
+        min_replicas=1, heartbeat_timeout_ms=1000, quorum_tick_ms=50,
+        serving_fanout=2,
+    )
+    pub = WeightPublisher(
+        lh.address(), wire="int8", fragments=2, heartbeat_interval=0.1
+    )
+    reps = [
+        ServingReplica(
+            lh.address(), replica_id=f"bench{i}", poll_interval=0.05,
+            fetch_timeout=10.0,
+        )
+        for i in range(SERVING_SERVERS)
+    ]
+    stop = threading.Event()
+    lat: "List[float]" = []
+    errors: "List[str]" = []
+    lock = threading.Lock()
+    published_states: "Dict[int, Dict[str, np.ndarray]]" = {}
+
+    def _publish(vi: int) -> int:
+        state = {k: a + np.float32(vi) for k, a in base.items()}
+        v = pub.publish(state)
+        with lock:
+            published_states[v] = state
+            while len(published_states) > 8:
+                published_states.pop(min(published_states))
+        return v
+
+    def _client_loop(i: int) -> None:
+        c = ServingClient(lh.address(), plan_ttl=0.2, client_id=str(i))
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                c.fetch(timeout=15)
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 - tallied
+                with lock:
+                    errors.append(repr(e))
+            time.sleep(0.01)
+        c.close()
+
+    from torchft_tpu.utils import metrics as _m
+
+    def _failover_count() -> float:
+        return (
+            _m.SERVING_FAILOVERS.labels(role="client").get()
+            + _m.SERVING_FAILOVERS.labels(role="relay").get()
+        )
+
+    failovers0 = _failover_count()
+    kill_info: "Dict[str, Any]" = {}
+    bitwise_ok = False
+    try:
+        t_pub0 = time.perf_counter()
+        vi = _publish(0)
+        threads = [
+            threading.Thread(target=_client_loop, args=(i,), daemon=True)
+            for i in range(SERVING_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        t_end = time.monotonic() + SERVING_RUN_S
+        killed = False
+        while time.monotonic() < t_end:
+            vi = _publish(vi)
+            if not killed and time.monotonic() > t_end - SERVING_RUN_S / 2:
+                # chaos: kill a live TREE NODE mid-run, fetches in flight
+                cl = ServingClient(lh.address(), plan_ttl=0.0)
+                plan = cl.plan(refresh=True)
+                cl.close()
+                interior = [
+                    n for n in plan["nodes"] if n["children"] > 0
+                ] or plan["nodes"]
+                victim_id = interior[0]["replica_id"]
+                victim = next(
+                    r for r in reps if r.replica_id() == victim_id
+                )
+                t_kill = time.perf_counter()
+                victim.shutdown()
+                killed = True
+                kill_info = {
+                    "victim": victim_id,
+                    "victim_children": interior[0]["children"],
+                    "at_version": vi,
+                }
+            time.sleep(0.1)
+        publish_wall = time.perf_counter() - t_pub0
+        published = pub.latest_version()
+
+        # post-kill bitwise check: fetch the latest version through the
+        # surviving tree and compare against the int8 round trip of the
+        # exact published state
+        vc = ServingClient(lh.address(), plan_ttl=0.0, client_id="verify")
+        state, got = vc.fetch(timeout=30)
+        vc.close()
+        with lock:
+            src = published_states.get(got)
+        if src is not None:
+            bitwise_ok = all(
+                np.array_equal(
+                    state[k],
+                    q.dequantize(
+                        *q.quantize(a.reshape(1, -1), q.WIRE_INT8),
+                        a.shape,
+                        np.dtype(np.float32),
+                    ),
+                )
+                for k, a in src.items()
+            )
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        stop.set()
+        for r in reps:
+            try:
+                r.shutdown()
+            except Exception:  # noqa: BLE001 - victim already down
+                pass
+        pub.shutdown()
+        lh.shutdown()
+
+    failovers = _failover_count() - failovers0
+    lat.sort()
+
+    def _pct(p: float) -> "Optional[float]":
+        if not lat:
+            return None
+        return round(lat[min(int(len(lat) * p), len(lat) - 1)] * 1000, 1)
+
+    return {
+        "servers": SERVING_SERVERS,
+        "clients": SERVING_CLIENTS,
+        "payload_mb": round(payload_bytes / 2**20, 2),
+        "wire": "int8",
+        "published_cps": round(published / publish_wall, 2),
+        "delivered_total": len(lat),
+        "delivered_cps": round(len(lat) / publish_wall, 2),
+        "fetch_p50_ms": _pct(0.50),
+        "fetch_p99_ms": _pct(0.99),
+        "failed_fetches": len(errors),
+        "failovers": int(failovers),
+        "kill": kill_info,
+        "bitwise_identical_after_failover": bitwise_ok,
+    }
+
+
 COMPACT_SUMMARY_MAX_BYTES = 1500
 
 
@@ -1837,6 +2021,20 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         else None
     )
     switch = result.get("switch") or {}
+    serving = result.get("serving") or {}
+    serving_compact = {
+        k: serving.get(k)
+        for k in (
+            "published_cps",
+            "delivered_cps",
+            "fetch_p50_ms",
+            "fetch_p99_ms",
+            "failovers",
+            "failed_fetches",
+            "bitwise_identical_after_failover",
+        )
+        if serving.get(k) is not None
+    } or None
     out: "Dict[str, Any]" = {
         "compact": True,
         "metric": result.get("metric", "recovery_to_healthy_step_latency"),
@@ -1865,6 +2063,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "step_ms": model.get("step_ms"),
         "diloco_winners": winners,
         "diloco_wire_reduction_x": diloco.get("wire_reduction_x"),
+        # serving-tier headline (ISSUE 12): sustained checkpoints/sec +
+        # p99 fetch under churn + the post-failover bitwise verdict
+        "serving": serving_compact,
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
         # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
@@ -1892,6 +2093,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
+        "serving",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -1929,6 +2131,14 @@ def main() -> None:
     from torchft_tpu.utils import metrics as _metrics
 
     _metrics.maybe_serve_from_env()
+    if "--serving" in sys.argv:
+        # `make bench-serving`: the weight-serving churn leg alone, with
+        # the compact tail (same last-line contract as the full run)
+        serving = bench_serving()
+        result = {"metric": "serving_fanout_under_churn", "serving": serving}
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
     if "--wan" in sys.argv:
         # `make bench-wan`: the RTT sweep alone, with the compact tail
         # (same last-line contract as the full run)
@@ -2006,6 +2216,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"wan bench failed: {e!r}")
         wan = {"error": repr(e)}
+    try:
+        # the "millions of users" axis: fan-out weight serving under
+        # churn (chaos kills a tree node mid-fetch)
+        serving = bench_serving()
+    except Exception as e:  # noqa: BLE001
+        log(f"serving bench failed: {e!r}")
+        serving = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",
@@ -2017,6 +2234,7 @@ def main() -> None:
         "diloco": diloco,
         "wan": wan,
         "switch": switch,
+        "serving": serving,
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

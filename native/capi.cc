@@ -61,7 +61,8 @@ int64_t tft_lighthouse_create(const char* bind_host, int port,
                               int64_t quorum_tick_ms,
                               int64_t heartbeat_timeout_ms,
                               int64_t status_page_size,
-                              int64_t straggler_topk, int64_t timeline_ring) {
+                              int64_t straggler_topk, int64_t timeline_ring,
+                              int64_t serving_fanout) {
   try {
     tft::LighthouseOpt opt;
     opt.bind_host = bind_host ? bind_host : "";
@@ -73,6 +74,7 @@ int64_t tft_lighthouse_create(const char* bind_host, int port,
     if (status_page_size > 0) opt.status_page_size = status_page_size;
     if (straggler_topk > 0) opt.straggler_topk = straggler_topk;
     if (timeline_ring > 0) opt.timeline_ring = timeline_ring;
+    if (serving_fanout > 0) opt.serving_fanout = serving_fanout;
     auto server = std::make_unique<tft::LighthouseServer>(opt);
     server->start_serving();
     return register_server(

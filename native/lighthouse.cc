@@ -257,6 +257,11 @@ void LighthouseServer::tick_locked(int64_t now) {
     hb_expiry_.erase(hb_expiry_.begin());
     dirty_.insert(rid);
   }
+  // Weight-serving membership expiry: a dead serving replica must bump
+  // the plan epoch promptly (the tree re-forms around it) even with no
+  // serving RPC traffic.  O(serving fleet), microseconds at any
+  // plausible size — the quorum dirty-set gate below is unaffected.
+  serving_gc_locked(now);
   // Dirty-set gate: with no state change and no timed deadline due, the
   // last decision is still the decision — skip the O(fleet) recompute.
   if (dirty_.empty() && now < wake_deadline_ms_) {
@@ -331,6 +336,8 @@ Json LighthouseServer::handle(const std::string& method, const Json& params,
                               int64_t timeout_ms) {
   if (method == "quorum") return rpc_quorum(params, timeout_ms);
   if (method == "heartbeat") return rpc_heartbeat(params);
+  if (method == "serving_heartbeat") return rpc_serving_heartbeat(params);
+  if (method == "serving_plan") return rpc_serving_plan(params);
   // One status document for the RPC and GET /status.json: the dashboard
   // schema IS the programmatic schema (tests assert they round-trip),
   // including the pagination/shard controls.
@@ -528,6 +535,151 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
   // timeline served at /timeline.json.
   const Json& summary = params.get("summary");
   if (summary.is_object()) note_summary_locked(rid, summary, now);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Weight-serving tier: serving replicas register with serving_heartbeat;
+// the lighthouse synthesizes the fan-out distribution tree served by
+// serving_plan.  Membership changes bump the monotone serving epoch
+// (the PR 10 layout-epoch idiom): a replica that adopted "epoch E" and
+// one that adopted "epoch F" can never believe they share a tree, so a
+// mid-churn tree switch is fleet-atomic without any extra round.
+// ---------------------------------------------------------------------------
+
+void LighthouseServer::serving_gc_locked(int64_t now) {
+  // Expire members whose serving heartbeat went stale; any expiry is a
+  // membership change => epoch bump (the tree re-forms around it).
+  bool changed = false;
+  for (auto it = serving_.begin(); it != serving_.end();) {
+    if (now - it->second.last_hb_ms >= opt_.heartbeat_timeout_ms) {
+      it = serving_.erase(it);
+      changed = true;
+    } else {
+      ++it;
+    }
+  }
+  if (changed) serving_epoch_ += 1;
+}
+
+int64_t LighthouseServer::serving_latest_version_locked() const {
+  // The pull target: newest version any PUBLISHER holds.  Server-held
+  // versions don't count — a relay can never be ahead of its source.
+  int64_t v = 0;
+  for (const auto& [rid, m] : serving_) {
+    (void)rid;
+    if (m.role == "publisher") v = std::max(v, m.version);
+  }
+  return v;
+}
+
+Json LighthouseServer::rpc_serving_heartbeat(const Json& params) {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  serving_heartbeats_total_ += 1;
+  serving_gc_locked(now);
+  ServingMember m;
+  m.replica_id = params.get("replica_id").as_string();
+  if (m.replica_id.empty())
+    throw std::runtime_error("serving_heartbeat: missing replica_id");
+  m.address = params.get("address").as_string();
+  m.role = params.get("role").as_string();
+  if (m.role != "publisher" && m.role != "server")
+    throw std::runtime_error(
+        "serving_heartbeat: role must be publisher|server, got " + m.role);
+  m.version = params.get("version").as_int(0);
+  m.capacity = params.get("capacity").as_int(0);
+  m.last_hb_ms = now;
+  auto it = serving_.find(m.replica_id);
+  // Epoch bumps only on TREE-SHAPE changes (join, address/role/capacity
+  // change) — a version advance is the steady-state publish cadence and
+  // must not re-plan the fleet every step.
+  bool shape_changed =
+      it == serving_.end() || it->second.address != m.address ||
+      it->second.role != m.role || it->second.capacity != m.capacity;
+  serving_[m.replica_id] = m;
+  if (shape_changed) serving_epoch_ += 1;
+  Json out = Json::object();
+  out["plan_epoch"] = serving_epoch_;
+  out["latest_version"] = serving_latest_version_locked();
+  return out;
+}
+
+Json LighthouseServer::rpc_serving_plan(const Json& params) {
+  (void)params;
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  serving_gc_locked(now);
+  // Deterministic synthesis from the replica_id-ordered membership:
+  // publishers are the tree's sources (root pulls from the
+  // max-version publisher); servers are laid out BFS — node i's parent
+  // is the earliest node with a free child slot (per-node capacity, or
+  // the configured fanout) — so the same membership always yields the
+  // same tree on every read, and a membership delta moves the minimum
+  // number of edges (sorted order is stable under churn).
+  std::vector<const ServingMember*> servers;
+  std::string root_source;
+  int64_t root_version = -1;
+  Json publishers = Json::array();
+  for (const auto& [rid, m] : serving_) {
+    (void)rid;
+    if (m.role == "publisher") {
+      Json p = Json::object();
+      p["replica_id"] = m.replica_id;
+      p["address"] = m.address;
+      p["version"] = m.version;
+      publishers.push_back(p);
+      if (m.version > root_version) {
+        root_version = m.version;
+        root_source = m.address;
+      }
+    } else {
+      servers.push_back(&m);
+    }
+  }
+  std::vector<int64_t> depth(servers.size(), 0);
+  std::vector<int64_t> children(servers.size(), 0);
+  std::vector<std::string> parent(servers.size(), "");
+  // BFS slot queue: (server index, remaining child slots).
+  std::vector<std::pair<size_t, int64_t>> slots;
+  size_t head = 0;
+  for (size_t i = 0; i < servers.size(); ++i) {
+    int64_t cap = servers[i]->capacity > 0 ? servers[i]->capacity
+                                           : opt_.serving_fanout;
+    if (i > 0) {
+      while (head < slots.size() && slots[head].second <= 0) ++head;
+      if (head < slots.size()) {
+        size_t pi = slots[head].first;
+        slots[head].second -= 1;
+        parent[i] = servers[pi]->address;
+        depth[i] = depth[pi] + 1;
+        children[pi] += 1;
+      }
+    }
+    slots.emplace_back(i, cap);
+  }
+  Json nodes = Json::array();
+  int64_t max_depth = 0;
+  for (size_t i = 0; i < servers.size(); ++i) {
+    Json n = Json::object();
+    n["replica_id"] = servers[i]->replica_id;
+    n["address"] = servers[i]->address;
+    n["parent"] = parent[i];  // "" = root (pulls from root_source)
+    n["depth"] = depth[i];
+    n["children"] = children[i];
+    n["version"] = servers[i]->version;
+    nodes.push_back(n);
+    max_depth = std::max(max_depth, depth[i]);
+  }
+  Json out = Json::object();
+  out["epoch"] = serving_epoch_;
+  out["generated_ms"] = wall_ms();
+  out["fanout"] = opt_.serving_fanout;
+  out["latest_version"] = serving_latest_version_locked();
+  out["root_source"] = root_source;
+  out["publishers"] = publishers;
+  out["nodes"] = nodes;
+  out["depth"] = max_depth;
   return out;
 }
 
@@ -805,6 +957,13 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
     http_reply(fd, 200, "application/json", timeline_json().dump());
     return;
   }
+  if (method == "GET" && path == "/serving.json") {
+    // Same document as the serving_plan RPC (the dashboard idiom: the
+    // HTTP surface IS the programmatic surface).
+    http_reply(fd, 200, "application/json",
+               rpc_serving_plan(Json::object()).dump());
+    return;
+  }
   if (method == "GET" && path == "/metrics") {
     http_reply(fd, 200, "text/plain; version=0.0.4", render_metrics());
     return;
@@ -916,6 +1075,35 @@ std::string LighthouseServer::render_metrics() {
        << "# TYPE torchft_stragglers_tracked gauge\n"
        << "torchft_stragglers_tracked "
        << static_cast<int64_t>(all_rows.size()) << "\n";
+    // Weight-serving tier: registered members, plan epoch and the
+    // newest published version (bounded: three series at any fleet
+    // size — the full tree lives in /serving.json).
+    int64_t serving_pubs = 0, serving_srvs = 0;
+    for (const auto& [rid, m] : serving_) {
+      (void)rid;
+      (m.role == "publisher" ? serving_pubs : serving_srvs) += 1;
+    }
+    os << "# HELP torchft_lighthouse_serving_replicas Registered "
+          "weight-serving members by role\n"
+       << "# TYPE torchft_lighthouse_serving_replicas gauge\n"
+       << "torchft_lighthouse_serving_replicas{role=\"publisher\"} "
+       << serving_pubs << "\n"
+       << "torchft_lighthouse_serving_replicas{role=\"server\"} "
+       << serving_srvs << "\n"
+       << "# HELP torchft_lighthouse_serving_epoch Weight-serving plan "
+          "epoch (monotone; bumps on serving membership change)\n"
+       << "# TYPE torchft_lighthouse_serving_epoch gauge\n"
+       << "torchft_lighthouse_serving_epoch " << serving_epoch_ << "\n"
+       << "# HELP torchft_lighthouse_serving_latest_version Newest weight "
+          "version any registered publisher holds\n"
+       << "# TYPE torchft_lighthouse_serving_latest_version gauge\n"
+       << "torchft_lighthouse_serving_latest_version "
+       << serving_latest_version_locked() << "\n"
+       << "# HELP torchft_lighthouse_serving_heartbeats_total "
+          "serving_heartbeat RPCs received\n"
+       << "# TYPE torchft_lighthouse_serving_heartbeats_total counter\n"
+       << "torchft_lighthouse_serving_heartbeats_total "
+       << serving_heartbeats_total_ << "\n";
   }
   {
     std::lock_guard<std::mutex> g(provider_mu_);
@@ -1073,6 +1261,23 @@ Json LighthouseServer::status_json(int64_t page, int64_t per_page,
       (rows_max + static_cast<size_t>(per_page) - 1) /
       static_cast<size_t>(per_page));
   if (sharded) out["replica"] = replica_filter;
+  // Weight-serving tier summary: always-small (counts + epoch + latest
+  // version), never the member list — /serving.json and the
+  // serving_plan RPC carry the full tree.
+  {
+    int64_t publishers = 0, servers = 0;
+    for (const auto& [rid, m] : serving_) {
+      (void)rid;
+      (m.role == "publisher" ? publishers : servers) += 1;
+    }
+    Json serving = Json::object();
+    serving["epoch"] = serving_epoch_;
+    serving["publishers"] = publishers;
+    serving["servers"] = servers;
+    serving["latest_version"] = serving_latest_version_locked();
+    out["serving"] = serving;
+  }
+
   Json summary = Json::object();
   summary["replicas_tracked"] = static_cast<int64_t>(hb_total);
   summary["participants_waiting"] =
@@ -1190,6 +1395,18 @@ std::string LighthouseServer::render_status_html(int64_t page) {
       }
       os << "</table>";
     }
+  }
+  if (!serving_.empty()) {
+    int64_t pubs = 0, srvs = 0;
+    for (const auto& [rid, m] : serving_) {
+      (void)rid;
+      (m.role == "publisher" ? pubs : srvs) += 1;
+    }
+    os << "<h2>weight-serving tier</h2><p>epoch " << serving_epoch_
+       << " &middot; " << pubs << " publisher(s) &middot; " << srvs
+       << " server(s) &middot; latest version "
+       << serving_latest_version_locked()
+       << " &middot; <a href=\"/serving.json\">plan</a></p>";
   }
   {
     os << "<h2>pending participants (" << participants_.size()

@@ -65,6 +65,9 @@ struct LighthouseOpt {
   int64_t join_timeout_ms = 100;
   int64_t quorum_tick_ms = 100;
   int64_t heartbeat_timeout_ms = 5000;
+  // Weight-serving tier: children per interior node of the synthesized
+  // fan-out distribution tree (serving_plan RPC).
+  int64_t serving_fanout = 2;
   // Fleet-scale status plane (see docs/observability.md):
   // default page size for /status.json row arrays (and the dashboard
   // tables) — the default document stays small at any fleet size.
@@ -140,6 +143,8 @@ class LighthouseServer : public RpcServer {
 
   Json rpc_quorum(const Json& params, int64_t timeout_ms);
   Json rpc_heartbeat(const Json& params);
+  Json rpc_serving_heartbeat(const Json& params);
+  Json rpc_serving_plan(const Json& params);
   void note_summary_locked(const std::string& rid, const Json& summary,
                            int64_t now);
   std::string render_status_html(int64_t page);
@@ -191,7 +196,32 @@ class LighthouseServer : public RpcServer {
     double wire_busy_s = 0.0;
   };
 
+  // One registered weight-serving participant (serving_heartbeat RPC).
+  // Roles: "publisher" (a training-side WeightPublisher, the tree's
+  // source of truth) or "server" (a relay/leaf replica).  version is the
+  // newest weight version the member holds; the plan's latest_version is
+  // the max over publishers — the pull target every server converges to.
+  struct ServingMember {
+    std::string replica_id;
+    std::string address;   // HTTP checkpoint-transport base address
+    std::string role;      // "publisher" | "server"
+    int64_t version = 0;
+    int64_t capacity = 0;  // max children (0 = opt_.serving_fanout)
+    int64_t last_hb_ms = 0;
+  };
+
  private:
+  // Weight-serving tier bookkeeping (caller holds mu_).  Membership
+  // changes (join, role change, heartbeat expiry) bump serving_epoch_
+  // — the PR 10 layout-epoch idiom: the epoch is monotone and never
+  // reused, so replicas adopting "the plan at epoch E" can never
+  // disagree about which tree E names.  The plan itself is synthesized
+  // deterministically from the replica_id-ordered membership at read
+  // time (same members => same tree), so there is no cached document to
+  // go stale: any read under mu_ sees a consistent (epoch, tree) pair.
+  void serving_gc_locked(int64_t now);
+  int64_t serving_latest_version_locked() const;
+
   // Record progress for rid (caller holds mu_).
   void note_progress_locked(const std::string& rid, int64_t step,
                             int64_t last_step_wall_ms,
@@ -236,6 +266,12 @@ class LighthouseServer : public RpcServer {
   int64_t wake_deadline_ms_ = INT64_MAX;
   // replica_id -> progress (pruned with heartbeats_ on supersession).
   std::map<std::string, ReplicaProgress> progress_;
+  // Weight-serving membership (replica_id-ordered: the plan synthesis
+  // is deterministic across rebuilds with unchanged membership) plus
+  // the monotone plan epoch and the cached synthesized plan document.
+  std::map<std::string, ServingMember> serving_;
+  int64_t serving_epoch_ = 0;
+  int64_t serving_heartbeats_total_ = 0;
   // Rolling cluster step-timeline, keyed by step, capped to
   // opt_.timeline_ring buckets (oldest step evicted).
   std::map<int64_t, StepBucket> timeline_;

@@ -16,6 +16,7 @@
 // TSAN_OPTIONS=exitcode / halt_on_error set by the test harness
 // (tests/test_native_sanitize.py).
 
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -107,6 +108,82 @@ int codec_round() {
   return 0;
 }
 
+// Serving-tier round: N threads heartbeat the serving role (publisher +
+// servers) against the live lighthouse while others read the plan — the
+// serving bookkeeping shares mu_ with the quorum tick thread, so under
+// TSan this proves the new paths race neither each other nor the tick.
+int serving_round(const std::string& lighthouse_addr) {
+  constexpr int kServers = 4;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  threads.reserve(kServers + 2);
+  for (int s = 0; s < kServers; ++s) {
+    threads.emplace_back([&, s] {
+      for (int i = 0; i < 5; ++i) {
+        tft::Json params = tft::Json::object();
+        params["replica_id"] = std::string("smoke_srv") + std::to_string(s);
+        params["address"] =
+            std::string("http://s") + std::to_string(s) + ":1";
+        params["role"] = std::string("server");
+        params["version"] = static_cast<int64_t>(i);
+        params["capacity"] = static_cast<int64_t>(0);
+        tft::Json result;
+        std::string err;
+        if (!tft::call_rpc(lighthouse_addr, "serving_heartbeat", params,
+                           kRpcTimeoutMs, &result, &err)) {
+          fprintf(stderr, "smoke: serving_heartbeat failed: %s\n",
+                  err.c_str());
+          failures = 1;
+          return;
+        }
+      }
+    });
+  }
+  threads.emplace_back([&] {
+    tft::Json params = tft::Json::object();
+    params["replica_id"] = std::string("smoke_pub");
+    params["address"] = std::string("http://p:1");
+    params["role"] = std::string("publisher");
+    params["version"] = static_cast<int64_t>(7);
+    tft::Json result;
+    std::string err;
+    if (!tft::call_rpc(lighthouse_addr, "serving_heartbeat", params,
+                       kRpcTimeoutMs, &result, &err)) {
+      fprintf(stderr, "smoke: publisher heartbeat failed: %s\n", err.c_str());
+      failures = 1;
+    }
+  });
+  threads.emplace_back([&] {
+    for (int i = 0; i < 5; ++i) {
+      tft::Json result;
+      std::string err;
+      if (!tft::call_rpc(lighthouse_addr, "serving_plan", tft::Json::object(),
+                         kRpcTimeoutMs, &result, &err)) {
+        fprintf(stderr, "smoke: serving_plan failed: %s\n", err.c_str());
+        failures = 1;
+        return;
+      }
+    }
+  });
+  for (auto& th : threads) th.join();
+  if (failures.load()) return failures.load();
+  // final plan sanity: 4 servers placed, publisher is the root source
+  tft::Json result;
+  std::string err;
+  if (!tft::call_rpc(lighthouse_addr, "serving_plan", tft::Json::object(),
+                     kRpcTimeoutMs, &result, &err)) {
+    fprintf(stderr, "smoke: final serving_plan failed: %s\n", err.c_str());
+    return 1;
+  }
+  if (result.get("nodes").as_array().size() != kServers ||
+      result.get("root_source").as_string() != "http://p:1" ||
+      result.get("latest_version").as_int() != 7) {
+    fprintf(stderr, "smoke: serving plan shape wrong\n");
+    return 1;
+  }
+  return 0;
+}
+
 int drive_round(const std::string& manager_addr, int round) {
   tft::Json params = tft::Json::object();
   params["group_rank"] = static_cast<int64_t>(0);
@@ -189,14 +266,18 @@ int main() {
     // progress reports race the heartbeat thread's reads — on purpose
     m0.report_progress(round, "quorum");
     m1.report_progress(round, "quorum");
-    int f0 = 0, f1 = 0;
+    int f0 = 0, f1 = 0, fs = 0;
     std::thread t0([&] { f0 = drive_round(m0.address(), round); });
     std::thread t1([&] { f1 = drive_round(m1.address(), round); });
+    // serving traffic races the quorum rounds + tick thread on mu_
+    std::thread ts([&] { fs = serving_round(lighthouse.address()); });
     t0.join();
     t1.join();
-    failures += f0 + f1;
+    ts.join();
+    failures += f0 + f1 + fs;
     if (failures) break;
   }
+  if (!failures) printf("SERVING OK\n");
 
   m0.stop();
   m1.stop();

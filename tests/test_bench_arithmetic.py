@@ -95,6 +95,17 @@ class TestCompactTailSummary:
                 "wire_reduction_x": 3.99,
                 "padding": ["x" * 100] * 40,
             },
+            "serving": {
+                "servers": 4, "clients": 8, "payload_mb": 2.0,
+                "wire": "int8",
+                "published_cps": 9.1, "delivered_total": 4000,
+                "delivered_cps": 334.0, "fetch_p50_ms": 2.2,
+                "fetch_p99_ms": 58.0, "failed_fetches": 0,
+                "failovers": 27,
+                "kill": {"victim": "bench0", "victim_children": 2,
+                         "at_version": 55},
+                "bitwise_identical_after_failover": True,
+            },
         }
 
     def test_summary_under_budget_with_primary_metric(self):
@@ -113,6 +124,12 @@ class TestCompactTailSummary:
         assert parsed["crosscheck"]["converged_2pts"] is True
         assert parsed["diloco_winners"]["0.5"]["winner"] == "int8"
         assert len(parsed["recovery_phases_ms_top"]) == 4
+        # the serving headline survives the budget (ISSUE 12): sustained
+        # checkpoints/sec, p99 fetch, and the post-failover verdict
+        assert parsed["serving"]["published_cps"] == 9.1
+        assert parsed["serving"]["fetch_p99_ms"] == 58.0
+        assert parsed["serving"]["bitwise_identical_after_failover"] is True
+        assert parsed["serving"]["failed_fetches"] == 0
 
     def test_tail_of_captured_emission_parses_to_summary(self):
         """Simulate the driver: capture full-result line + compact line,

@@ -85,3 +85,117 @@ class TestParameterServer:
         bad = ps.address().replace("/new_session", "/nope")
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(bad)
+
+    def test_session_mint_document(self, ps):
+        """GET /new_session mints a fresh uuid session whose store prefix
+        is namespaced under the server's rendezvous store."""
+        import urllib.request
+
+        with urllib.request.urlopen(ps.address()) as f:
+            import json as _json
+
+            data = _json.load(f)
+        assert set(data) == {"session_id", "store_addr"}
+        assert f"/session/{data['session_id']}" in data["store_addr"]
+        store_base = ps._store.address()
+        assert data["store_addr"].startswith(store_base)
+        # distinct mints -> distinct sessions (each gets its own PG pair)
+        with urllib.request.urlopen(ps.address()) as f:
+            data2 = _json.load(f)
+        assert data2["session_id"] != data["session_id"]
+
+    def test_rank_assignment(self, ps):
+        """Server serves rank 0, the minted client configures rank 1 of a
+        2-rank session PG (the reference's fixed convention)."""
+        pg = _EchoPS.new_session(ps.address())
+        try:
+            assert pg.rank() == 1
+            assert pg.size() == 2
+            pg.allreduce([np.full(4, 2.0, np.float32)]).wait(timeout=20)
+            pg.broadcast(np.zeros(8, np.float32), root=0).wait(timeout=20)
+        finally:
+            pg.shutdown()
+
+    def test_failed_collective_tears_down_session(self):
+        """A client that dies mid-session fails the server's collective;
+        the session thread raises, frees its PG, and the server keeps
+        minting fresh sessions."""
+        server = _EchoPS(port=0)
+        _EchoPS.sessions_served = 0
+        _EchoPS.session_error = None
+        try:
+            pg = _EchoPS.new_session(server.address())
+            # abandon the session mid-protocol: the server's allreduce is
+            # waiting on rank 1's contribution that never comes
+            pg.shutdown()
+            deadline = threading.Event()
+            assert not deadline.wait(0.2)
+            # the server must still serve a FRESH session end-to-end
+            pg2 = _EchoPS.new_session(server.address())
+            try:
+                got = pg2.allreduce([np.full(4, 2.0, np.float32)]).wait(
+                    timeout=20
+                )
+                np.testing.assert_array_equal(
+                    got[0], np.full(4, 3.0, np.float32)
+                )
+                pg2.broadcast(np.zeros(8, np.float32), root=0).wait(timeout=20)
+            finally:
+                pg2.shutdown()
+        finally:
+            server.shutdown()
+
+    def test_new_session_retries_until_server_up(self):
+        """new_session goes through the unified retry layer: a server
+        that binds after the first attempts is polled, not failed."""
+        import socket
+
+        # reserve a port, delay-bind the real server onto it
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        results = {}
+
+        def _mint():
+            try:
+                results["pg"] = _EchoPS.new_session(
+                    f"http://127.0.0.1:{port}/new_session", timeout=20.0
+                )
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                results["error"] = e
+
+        t = threading.Thread(target=_mint, daemon=True)
+        t.start()
+        # let a few connection-refused attempts happen first
+        t.join(timeout=0.5)
+        server = _EchoPS(port=port)
+        try:
+            t.join(timeout=20)
+            assert not t.is_alive(), "new_session never completed"
+            assert "error" not in results, results.get("error")
+            pg = results["pg"]
+            try:
+                pg.allreduce([np.full(4, 2.0, np.float32)]).wait(timeout=20)
+                pg.broadcast(np.zeros(8, np.float32), root=0).wait(timeout=20)
+            finally:
+                pg.shutdown()
+        finally:
+            server.shutdown()
+
+    def test_new_session_deadline_bounded(self):
+        """With nothing listening, new_session fails within its deadline
+        with TimeoutError (the retry budget), not an unbounded hang."""
+        import socket
+        import time
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        t0 = time.monotonic()
+        with pytest.raises((TimeoutError, ConnectionError)):
+            _EchoPS.new_session(
+                f"http://127.0.0.1:{port}/new_session", timeout=1.5
+            )
+        assert time.monotonic() - t0 < 10

@@ -324,6 +324,130 @@ class TestBf16AndZeroDim:
             sender.shutdown()
             receiver.shutdown()
 
+    def test_version_keyed_staging_retention(self):
+        """Serving-tier contract (ISSUE 12): concurrently publishing
+        version V+1 while clients still fetch V must not retire V early
+        — V survives until it ages out of the staging window."""
+        import threading
+
+        tr = HTTPTransport(timeout=10.0, max_staged=3)
+        try:
+            docs = {
+                v: {"w": np.full(2048, float(v), np.float32)}
+                for v in range(1, 6)
+            }
+            tr.send_checkpoint([], step=1, state_dict=docs[1], timeout=5.0)
+            tr.send_checkpoint([], step=2, state_dict=docs[2], timeout=5.0)
+            # fetch V=1 from many threads WHILE V=3 (and then V=4) stage
+            results = {}
+
+            def _fetch(i):
+                try:
+                    results[i] = tr.recv_checkpoint(
+                        src_rank=0, metadata=tr.metadata(), step=1,
+                        timeout=10.0,
+                    )
+                except Exception as e:  # noqa: BLE001 - asserted below
+                    results[i] = e
+
+            threads = [
+                threading.Thread(target=_fetch, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            tr.send_checkpoint([], step=3, state_dict=docs[3], timeout=5.0)
+            for t in threads:
+                t.join(timeout=20)
+                assert not t.is_alive()
+            # every concurrent fetch of V=1 completed with V=1's bytes
+            for i, out in results.items():
+                assert not isinstance(out, Exception), f"fetch {i}: {out}"
+                np.testing.assert_array_equal(out["w"], docs[1]["w"])
+            # window is 3: V=1 still staged after the concurrent publish
+            assert tr.staged_steps() == [1, 2, 3]
+            # a FOURTH version finally ages V=1 out (oldest first)
+            tr.send_checkpoint([], step=4, state_dict=docs[4], timeout=5.0)
+            assert tr.staged_steps() == [2, 3, 4]
+        finally:
+            tr.shutdown()
+
+    def test_staging_writer_never_starved_by_fetch_storm(self):
+        """The writer-priority lock: a continuous 503-poll storm on the
+        read side must not starve send_checkpoint (the serving soak's
+        failure mode before the turnstile)."""
+        import threading
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        tr = HTTPTransport(timeout=10.0, max_staged=4)
+        stop = threading.Event()
+
+        def _poll():
+            # hammer an unstaged step: each request takes the read lock
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(
+                        f"{tr.metadata()}/checkpoint/999/full", timeout=1.0
+                    )
+                except (urllib.error.HTTPError, OSError):
+                    pass
+
+        threads = [
+            threading.Thread(target=_poll, daemon=True) for _ in range(8)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            _time.sleep(0.2)  # let the storm densify
+            t0 = _time.monotonic()
+            tr.send_checkpoint(
+                [], step=1, state_dict={"w": np.ones(4)}, timeout=5.0
+            )
+            staged_in = _time.monotonic() - t0
+            assert staged_in < 5.0, f"staging starved for {staged_in:.1f}s"
+            assert 1 in tr.staged_steps()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            tr.shutdown()
+
+    def test_fragment_resource(self):
+        """frag_<name> serves exactly one staged fragment; an unknown
+        fragment is a permanent 404, distinct from the unstaged 503."""
+        import urllib.error
+        import urllib.request
+
+        from torchft_tpu.checkpointing import serialization as ser
+
+        tr = HTTPTransport(timeout=10.0)
+        try:
+            doc = {
+                "frag:manifest": {"version": 3, "fragments": ["0"]},
+                "frag:0": {"w": np.arange(4, dtype=np.float32)},
+            }
+            tr.send_checkpoint([], step=3, state_dict=doc, timeout=5.0)
+            with urllib.request.urlopen(
+                f"{tr.metadata()}/checkpoint/3/frag_0", timeout=5.0
+            ) as resp:
+                skeleton, leaves, n = ser.deserialize_from(resp)
+            frag = ser.reassemble(skeleton, leaves, n)
+            np.testing.assert_array_equal(frag["w"], doc["frag:0"]["w"])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{tr.metadata()}/checkpoint/3/frag_nope", timeout=5.0
+                )
+            assert ei.value.code == 404
+            # unstaged version stays the retryable 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{tr.metadata()}/checkpoint/99/frag_0", timeout=5.0
+                )
+            assert ei.value.code == 503
+        finally:
+            tr.shutdown()
+
     def test_recv_retries_until_staged(self):
         # healer fetches BEFORE the sender stages: must poll, not fail
         import threading

@@ -89,6 +89,7 @@ class TestDriftGateClean:
         assert set(servers) == {"lighthouse", "manager", "store"}
         assert set(servers["lighthouse"]) == {
             "quorum", "heartbeat", "status", "timeline",
+            "serving_heartbeat", "serving_plan",
         }
         assert set(servers["manager"]) == {
             "quorum", "should_commit", "checkpoint_metadata", "kill",
@@ -158,6 +159,41 @@ class TestSeededDrift:
         assert drifted["manager.cc"] != mg
         codes = self._codes(native=drifted)
         assert {"param-dead", "param-missing"} <= codes
+
+    def test_python_serving_param_rename_is_caught(self):
+        """Serving-tier surface (ISSUE 12): renaming a serving_heartbeat
+        param on the Python side means the native handler reads its wire
+        default forever — the gate must bite."""
+        py, *_ = _tree_inputs()
+        drifted = py.replace('"capacity": int(capacity)', '"cap": int(capacity)')
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_serving_param_rename_is_caught(self):
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'params.get("version").as_int(0)', 'params.get("ver").as_int(0)'
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_serving_result_rename_is_caught(self):
+        """Renaming the plan-epoch reply field natively orphans the
+        Python client's result read."""
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'out["plan_epoch"] = serving_epoch_;',
+            'out["planepoch"] = serving_epoch_;',
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert "result-missing" in codes or "lock-drift" in codes
 
     def test_doc_omission_is_caught(self):
         _py, _native, _nf, docs, *_ = _tree_inputs()
@@ -307,6 +343,14 @@ class TestLiveConformance:
             self._check_result("lighthouse", "status", st)
             tl = c.timeline()
             self._check_result("lighthouse", "timeline", tl)
+            sh = c.serving_heartbeat(
+                "live_srv", "http://x:1", role="server", version=2,
+                capacity=1,
+            )
+            self._check_result("lighthouse", "serving_heartbeat", sh)
+            sp = c.serving_plan()
+            self._check_result("lighthouse", "serving_plan", sp)
+            assert [n["replica_id"] for n in sp["nodes"]] == ["live_srv"]
         finally:
             c.close()
             lh.shutdown()

@@ -128,6 +128,8 @@ def get_lib() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             # status plane: status_page_size, straggler_topk, timeline_ring
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            # weight-serving tier: serving_fanout (distribution-tree arity)
+            ctypes.c_int64,
         ]
         lib.tft_manager_create.restype = ctypes.c_int64
         lib.tft_manager_create.argtypes = [

@@ -10,7 +10,8 @@ free-form name silently falls out of every report.  Two rules:
 tuple the flight recorder and the quorum-duration histogram label from),
 the ``quorum_round`` root, or the documented prefix families ``quant.*``
 (quantized-collective pipeline), ``heal.*`` (checkpoint heal endpoints),
-and ``rpc.*`` (native server spans) — docs/observability.md "Distributed
+``rpc.*`` (native server spans), and ``serving.*`` (weight-serving tier
+publish/fetch/tree-commit) — docs/observability.md "Distributed
 tracing".  One level of indirection is resolved: when the name argument
 is a parameter of the enclosing function (e.g. ``Manager._record_phase``),
 the SAME-MODULE callers' literal arguments are checked instead.
@@ -46,7 +47,7 @@ PASS_ID = "span-vocab"
 _MANAGER_FILE = "manager.py"
 
 #: documented span-name prefix families (docs/observability.md)
-SPAN_FAMILIES = ("quant.", "heal.", "rpc.")
+SPAN_FAMILIES = ("quant.", "heal.", "rpc.", "serving.")
 
 #: allowed exact names beyond PROTOCOL_PHASES
 EXTRA_SPAN_NAMES = ("quorum_round",)
@@ -361,7 +362,8 @@ def selftest() -> None:
 PASS = LintPass(
     id=PASS_ID,
     doc="trace-span names come from PROTOCOL_PHASES / quant.* / heal.* / "
-    "rpc.*; every span-emitting function also feeds the flight recorder",
+    "rpc.* / serving.*; every span-emitting function also feeds the "
+    "flight recorder",
     run=run,
     selftest=selftest,
 )

@@ -115,6 +115,20 @@ class _Handler(BaseHTTPRequestHandler):
                         self.send_error(404, "chunk out of range")
                         return
                     indices = chunks[idx]
+                elif what.startswith("frag_"):
+                    # Version-keyed fragment serving (serving/ tier): the
+                    # staged doc maps "frag:<name>" to one fragment's
+                    # sub-dict; serve exactly that fragment so delta
+                    # updates move one fragment, not the checkpoint.  A
+                    # missing fragment name is a permanent 404 (the
+                    # staged manifest names every fragment), distinct
+                    # from the retryable not-yet-staged 503 above.
+                    frag = state_dict.get(f"frag:{what[len('frag_'):]}")
+                    if frag is None:
+                        self.send_error(404, "unknown fragment")
+                        return
+                    state_dict = frag
+                    indices = None
                 elif what.startswith("part_"):
                     # Reshard slice-diff serving (parallel/layout.py): the
                     # staged doc maps "for:<rank>" to the slices planned
@@ -207,10 +221,15 @@ class HTTPTransport(CheckpointTransport[Any]):
         timeout: float = 60.0,
         num_chunks: int = 0,
         state_dict_fn: "Optional[Callable[[], Any]]" = None,
+        max_staged: int = _MAX_STAGED,
     ) -> None:
         self._lock_timeout = timeout
         self._num_chunks = num_chunks
         self._state_dict_fn = state_dict_fn
+        # Staged-slot budget: heal/reshard transports keep the default;
+        # the weight-serving tier sizes it to its version window so a
+        # burst of publishes cannot retire a version clients still fetch.
+        self._max_staged = max(int(max_staged), 1)
         # Staged snapshots keyed by step.  Heal staging uses the real
         # (>= 0) step and is retired per step by disallow_checkpoint();
         # live-reshard staging (parallel/layout.py) uses NEGATIVE keys
@@ -218,7 +237,11 @@ class HTTPTransport(CheckpointTransport[Any]):
         # retirement until the switch commits or rolls back.  Bounded:
         # oldest slots are evicted past _MAX_STAGED.
         self._staged: "dict[int, tuple[Any, int]]" = {}
-        self._staged_lock = RWLock(timeout=timeout)
+        # writer_priority: staging/retirement must acquire in bounded
+        # time even under a dense fetch storm (the serving tier's
+        # 503-polling clients keep the read side continuously occupied —
+        # a reader-preferring lock starves the stager forever).
+        self._staged_lock = RWLock(timeout=timeout, writer_priority=True)
         self._server = _make_server()
         self._server.transport = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
@@ -251,7 +274,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         )
         with self._staged_lock.w_lock(timeout=timeout):
             self._staged[step] = (host_sd, max(self._num_chunks, 1))
-            while len(self._staged) > _MAX_STAGED:
+            while len(self._staged) > self._max_staged:
                 self._staged.pop(next(iter(self._staged)))
         _flightrec.record(
             "checkpoint.http.stage", start_ns=t0_ns, step=step,
@@ -376,6 +399,13 @@ class HTTPTransport(CheckpointTransport[Any]):
         retirement path); no-op when absent."""
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
             self._staged.pop(step, None)
+
+    def staged_steps(self) -> "List[int]":
+        """Step/version keys currently staged (insertion order — the
+        eviction order).  The serving tier uses this as "which versions
+        do I still hold"; tests assert retention windows with it."""
+        with self._staged_lock.r_lock(timeout=self._lock_timeout):
+            return list(self._staged)
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()

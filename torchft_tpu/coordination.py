@@ -439,6 +439,7 @@ class LighthouseServer(_NativeServer):
         status_page_size: "Optional[int]" = None,
         straggler_topk: "Optional[int]" = None,
         timeline_ring: "Optional[int]" = None,
+        serving_fanout: "Optional[int]" = None,
     ) -> None:
         from torchft_tpu.utils.env import env_int
 
@@ -463,6 +464,10 @@ class LighthouseServer(_NativeServer):
             timeline_ring
             if timeline_ring is not None
             else env_int("TORCHFT_TIMELINE_RING", 256, minimum=1),
+            # weight-serving distribution-tree arity (serving_plan RPC)
+            serving_fanout
+            if serving_fanout is not None
+            else env_int("TORCHFT_SERVING_FANOUT", 2, minimum=1),
         )
         super().__init__(handle)
         self._metrics_cb: Any = None
@@ -685,6 +690,58 @@ class LighthouseClient:
         if replica is not None:
             params["replica"] = replica
         return self._client.call("status", params, timeout)
+
+    def serving_heartbeat(
+        self,
+        replica_id: str,
+        address: str,
+        role: str = "server",
+        version: int = 0,
+        capacity: int = 0,
+        timeout: "float | timedelta" = 5.0,
+    ) -> Dict[str, Any]:
+        """Register/refresh a weight-serving member (docs/architecture.md
+        "Weight-serving tier").  ``role`` is ``publisher`` (training-side
+        WeightPublisher, the tree's source) or ``server`` (relay/leaf
+        serving replica); ``address`` is the member's HTTP
+        checkpoint-transport base address; ``version`` the newest weight
+        version it holds; ``capacity`` overrides the tree fanout for this
+        node (0 = server default).  Expiry follows the lighthouse
+        heartbeat timeout.  Returns ``{"plan_epoch", "latest_version"}``
+        — a ``plan_epoch`` differing from the adopted one means the tree
+        re-formed and :meth:`serving_plan` should be re-fetched."""
+        params: "Dict[str, Any]" = {
+            "replica_id": replica_id,
+            "address": address,
+            "role": role,
+            "version": int(version),
+            "capacity": int(capacity),
+        }
+        result = self._client.call("serving_heartbeat", params, timeout)
+        return {
+            "plan_epoch": result["plan_epoch"],
+            "latest_version": result["latest_version"],
+        }
+
+    def serving_plan(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
+        """The synthesized weight-distribution fan-out plan (same document
+        as ``GET /serving.json``): monotone ``epoch``, ``root_source``
+        (max-version publisher address), ``publishers``, and ``nodes`` —
+        one entry per serving replica with ``parent`` ("" = root, pulls
+        from ``root_source``), ``depth`` and ``children``.  Synthesis is
+        deterministic over the replica_id-ordered membership, so every
+        reader of epoch E sees the identical tree."""
+        result = self._client.call("serving_plan", {}, timeout)
+        return {
+            "epoch": result["epoch"],
+            "generated_ms": result["generated_ms"],
+            "fanout": result["fanout"],
+            "latest_version": result["latest_version"],
+            "root_source": result["root_source"],
+            "publishers": result["publishers"],
+            "nodes": result["nodes"],
+            "depth": result["depth"],
+        }
 
     def timeline(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:
         """The rolling cluster step-timeline (same document as

@@ -359,6 +359,11 @@ class Manager:
         # manifest, and the async-quorum thread runs the two-phase
         # switch protocol (commit round first, then plan+stage).
         self._layout: "Optional[Any]" = None
+        self._weight_publisher: "Optional[Any]" = None
+        self._publish_pending: "Optional[int]" = None
+        self._publish_executor: (
+            "Optional[concurrent.futures.ThreadPoolExecutor]"
+        ) = None
 
     @staticmethod
     def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
@@ -402,6 +407,66 @@ class Manager:
     def layout_controller(self) -> "Optional[Any]":
         return self._layout
 
+    def attach_weight_publisher(self, publisher: Any) -> Any:
+        """Attach a :class:`~torchft_tpu.serving.WeightPublisher`: every
+        COMMITTED step's user state is published as weight version
+        ``step`` into the serving tier (docs/architecture.md
+        "Weight-serving tier").  Timing: the user applies the optimizer
+        update AFTER ``should_commit`` returns, so the snapshot is taken
+        at the start of the NEXT round (the same point layout updates
+        settle) — and flushed at :meth:`shutdown` for the final step.
+        Attach to ONE rank per job — typically group 0's rank 0; the
+        publisher's versions fan out through the lighthouse-synthesized
+        distribution tree.  Publish failures are logged, never allowed
+        to fail training.  Returns the publisher for chaining."""
+        self._weight_publisher = publisher
+        return publisher
+
+    def _flush_pending_publish(self, wait: bool = False) -> None:
+        """Publish the last committed step's user state, if one is
+        pending (called from the next round's start and from shutdown —
+        both points where the user's post-commit optimizer update has
+        fully materialized).
+
+        Only the SNAPSHOT runs on the caller (under the state-dict read
+        lock, the heal consistency point); the encode + staging + HTTP
+        advertise run on a single-worker executor so a multi-GB publish
+        never turns the publishing rank into the fleet's straggler at
+        every ``start_quorum``.  One worker keeps versions ordered;
+        ``wait`` (shutdown) drains the queue so the final version is
+        staged before the transports die."""
+        version, self._publish_pending = self._publish_pending, None
+        pub = self._weight_publisher
+        if pub is None:
+            return
+        if version is not None:
+            try:
+                with self._state_dict_lock.r_lock():
+                    state = {
+                        k: fn() for k, fn in self._user_state_dicts.items()
+                    }
+            except Exception:  # noqa: BLE001 - serving never fails training
+                self._logger.exception("weight-publish snapshot failed")
+                return
+            if self._publish_executor is None:
+                self._publish_executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tft_weight_publish"
+                )
+
+            def _do_publish() -> None:
+                try:
+                    pub.publish(state, version=version)
+                except Exception:  # noqa: BLE001 - never fails training
+                    self._logger.exception(
+                        "weight publish failed (serving tier degraded "
+                        "this step)"
+                    )
+
+            self._publish_executor.submit(_do_publish)
+        if wait and self._publish_executor is not None:
+            self._publish_executor.shutdown(wait=True)
+            self._publish_executor = None
+
     def _manager_state_dict(self) -> "Dict[str, Any]":
         with self._state_dict_lock.r_lock():
             assert self._user_state_dicts, "user state_dict is not initialized"
@@ -443,6 +508,12 @@ class Manager:
         """
         if self._quorum_future is not None:
             self._quorum_future.result()
+
+        # Serving tier: the previous round's committed weights are fully
+        # materialized by now (the user's optimizer update ran between
+        # should_commit and this call) — publish them as that step's
+        # weight version before the new round begins.
+        self._flush_pending_publish()
 
         self._errored = None
         self._healing = False
@@ -1019,6 +1090,11 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            # Serving tier: committed weights become weight version
+            # `step` — published at the NEXT round's start / shutdown,
+            # after the user's post-commit optimizer update lands
+            # (attach_weight_publisher; no-op when unattached).
+            self._publish_pending = self._step
         else:
             self._commit_failures += 1
             if (
@@ -1240,6 +1316,10 @@ class Manager:
         second-largest addressable recovery phase.  Reference semantics
         preserved (manager.rs shutdown aborts in one Drop).
         """
+        # Final committed step's weight version, if a publisher is
+        # attached and the loop ended right after its commit; wait=True
+        # drains the publish queue before the transports die.
+        self._flush_pending_publish(wait=True)
         legs = [
             lambda: self._checkpoint_transport.shutdown(wait=wait),
             self._client.close,

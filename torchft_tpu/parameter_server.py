@@ -20,15 +20,34 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.error
 import urllib.request
 import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 from torchft_tpu.coordination import StoreServer
 from torchft_tpu.parallel.process_group import ProcessGroup, _routable_local_ip
+from torchft_tpu.utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+# Session-mint retry: the server may still be binding (rolling restart)
+# or briefly saturated — poll connection-level failures and retryable
+# 503s with jittered backoff inside the caller's deadline.  A 400 (bad
+# path) or any other HTTP error is permanent and fails immediately.
+_SESSION_POLICY = RetryPolicy(
+    name="parameter_server.new_session",
+    base_delay=0.05,
+    multiplier=2.0,
+    max_delay=1.0,
+    retry_if=lambda e: (
+        e.code == 503
+        if isinstance(e, urllib.error.HTTPError)
+        else isinstance(e, (urllib.error.URLError, ConnectionError, OSError))
+    ),
+)
 
 
 class ParameterServer(ABC):
@@ -113,10 +132,22 @@ class ParameterServer(ABC):
             pg.shutdown()
 
     @classmethod
-    def new_session(cls, address: str) -> ProcessGroup:
-        """Client side: mint a session and return a configured PG (rank 1)."""
-        with urllib.request.urlopen(address) as f:
-            data = json.load(f)
+    def new_session(cls, address: str, timeout: float = 30.0) -> ProcessGroup:
+        """Client side: mint a session and return a configured PG (rank 1).
+
+        The mint request runs under the unified retry layer
+        (``_SESSION_POLICY``): connection failures and retryable 503s
+        are polled with jittered backoff until ``timeout``; permanent
+        HTTP errors fail immediately."""
+
+        def attempt(budget: "Optional[float]") -> dict:
+            t = max(budget if budget is not None else 0.001, 0.001)
+            with urllib.request.urlopen(address, timeout=t) as f:
+                return json.load(f)
+
+        data = _SESSION_POLICY.run(
+            attempt, timeout=timeout, op="parameter_server.new_session"
+        )
 
         logger.info(
             "connecting to session %s at %s", data["session_id"], data["store_addr"]

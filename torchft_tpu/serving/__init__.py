@@ -1,0 +1,39 @@
+"""Weight-serving tier: fault-tolerant fan-out checkpoint distribution.
+
+The heavy-traffic serving plane (ROADMAP item 5, docs/architecture.md
+"Weight-serving tier"): a :class:`WeightPublisher` next to training
+publishes committed weights as versioned, optionally int8-quantized
+payloads; :class:`ServingReplica` nodes form a lighthouse-synthesized
+fan-out tree (root pulls the publisher, interior nodes relay, leaves
+serve); :class:`ServingClient` inference clients fetch full or delta
+(changed-fragment) payloads with automatic failover when a server dies
+mid-fetch.  Discovery, health and tree synthesis ride the existing
+lighthouse (``serving_heartbeat`` / ``serving_plan`` RPCs,
+``/serving.json``); the wire path is the existing HTTP checkpoint
+transport's version-keyed multi-slot staging.
+"""
+
+from torchft_tpu.serving.client import ServingClient, fetch_resource
+from torchft_tpu.serving.payload import (
+    MANIFEST_FRAG,
+    WIRE_F32,
+    WIRE_INT8,
+    changed_fragments,
+    decode_payload,
+    encode_payload,
+)
+from torchft_tpu.serving.publisher import WeightPublisher
+from torchft_tpu.serving.replica import ServingReplica
+
+__all__ = [
+    "WeightPublisher",
+    "ServingReplica",
+    "ServingClient",
+    "fetch_resource",
+    "encode_payload",
+    "decode_payload",
+    "changed_fragments",
+    "MANIFEST_FRAG",
+    "WIRE_F32",
+    "WIRE_INT8",
+]

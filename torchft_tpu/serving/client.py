@@ -1,0 +1,307 @@
+"""ServingClient: the inference-side weight fetch path.
+
+Discovers the distribution tree through the lighthouse (cached plan,
+refreshed on epoch change or failure), fetches versioned payloads from
+serving replicas — leaves first, so client load lands on the tree's
+widest tier — and fails over to siblings/the root source when a server
+dies mid-fetch.  Holding the previous version enables delta fetches:
+manifest + changed fragments only (publisher-computed digests decide).
+
+The fetch itself is plain HTTP against the checkpoint transport's
+``/checkpoint/<version>/<resource>`` surface with the unified retry
+layer polling retryable 503s (version staged but not yet on this node)
+inside each source's budget slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchft_tpu.checkpointing import serialization as ser
+from torchft_tpu.serving import payload as _payload
+from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils import tracing as _tracing
+from torchft_tpu.utils.env import env_float
+from torchft_tpu.utils.retry import RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServingClient", "fetch_resource"]
+
+# Serving fetch retry: 503 = the version exists fleet-wide but this node
+# has not finished staging it (publisher still encoding, relay still
+# pulling) — poll within the source's budget slice.  Connection errors
+# (server killed mid-fetch) retry here too; budget expiry surfaces so
+# the caller fails over to the next source.
+_FETCH_POLICY = RetryPolicy(
+    name="serving.fetch",
+    base_delay=0.02,
+    multiplier=2.0,
+    max_delay=0.5,
+    retry_if=lambda e: (
+        e.code == 503
+        if isinstance(e, urllib.error.HTTPError)
+        else isinstance(e, (urllib.error.URLError, ConnectionError, OSError))
+    ),
+)
+
+
+def fetch_resource(
+    base: str, version: int, resource: str, timeout: float
+) -> Any:
+    """Fetch + deserialize one resource of a staged version from a
+    serving node's transport (``full``, ``frag_<name>``, ...)."""
+    traceparent = _tracing.current_traceparent()
+
+    def attempt(budget: "Optional[float]") -> Any:
+        t = max(budget if budget is not None else 0.001, 0.001)
+        req = urllib.request.Request(
+            f"{base}/checkpoint/{version}/{resource}",
+            headers={"traceparent": traceparent} if traceparent else {},
+        )
+        with urllib.request.urlopen(req, timeout=t) as resp:
+            _metrics.SERVING_FETCH_BYTES.labels(role="client").inc(
+                int(resp.headers.get("Content-Length") or 0)
+            )
+            skeleton, leaves, n = ser.deserialize_from(resp)
+            return ser.reassemble(skeleton, leaves, n)
+
+    return _FETCH_POLICY.run(attempt, timeout=timeout, op="serving.fetch")
+
+
+class ServingClient:
+    """Pull live weight versions from the serving tier.
+
+    Args:
+        lighthouse_addr: serving-tier discovery endpoint.
+        plan_ttl: seconds a fetched plan is trusted before re-asking the
+            lighthouse (default ``TORCHFT_SERVING_PLAN_TTL_S``); any
+            fetch failure refreshes immediately.
+        client_id: spreads initial source choice across clients (leaves
+            are rotated by its hash) so a client fleet does not dogpile
+            one leaf.
+    """
+
+    def __init__(
+        self,
+        lighthouse_addr: str,
+        plan_ttl: "Optional[float]" = None,
+        client_id: "Optional[str]" = None,
+    ) -> None:
+        from torchft_tpu.coordination import LighthouseClient
+
+        self._client = LighthouseClient(lighthouse_addr)
+        self._plan_ttl = (
+            plan_ttl
+            if plan_ttl is not None
+            else env_float("TORCHFT_SERVING_PLAN_TTL_S", 2.0, minimum=0.0)
+        )
+        self._rot = hash(client_id) if client_id is not None else id(self)
+        # non-final sources are capped at the failover bound (a killed
+        # server costs seconds, not the fetch deadline)
+        self._failover_s = env_float(
+            "TORCHFT_SERVING_FAILOVER_S", 2.0, minimum=0.05
+        )
+        self._plan: "Optional[Dict[str, Any]]" = None
+        self._plan_at = 0.0
+        # previous decoded version for delta fetches
+        self._held: "Optional[Tuple[Dict[str, Any], Dict[int, Any]]]" = None
+        self._held_version = 0
+
+    # -- discovery ---------------------------------------------------------
+
+    def plan(self, refresh: bool = False) -> "Dict[str, Any]":
+        now = time.monotonic()
+        if (
+            refresh
+            or self._plan is None
+            or now - self._plan_at > self._plan_ttl
+        ):
+            self._plan = self._client.serving_plan()
+            self._plan_at = now
+            _metrics.SERVING_PLAN_EPOCH.labels(role="client").set(
+                self._plan["epoch"]
+            )
+        return self._plan
+
+    def latest_version(self, refresh: bool = True) -> int:
+        return int(self.plan(refresh=refresh)["latest_version"])
+
+    def _sources(self, plan: "Dict[str, Any]") -> "List[str]":
+        """Fetch order: leaves (deepest first, rotated per client for
+        load spread), then interior nodes, then the root source — a
+        client can always complete as long as ANY copy is alive."""
+        nodes = list(plan["nodes"])
+        leaves = [n for n in nodes if n["children"] == 0]
+        inner = [n for n in nodes if n["children"] > 0]
+        leaves.sort(key=lambda n: (-n["depth"], n["replica_id"]))
+        inner.sort(key=lambda n: (-n["depth"], n["replica_id"]))
+        if leaves:
+            r = self._rot % len(leaves)
+            leaves = leaves[r:] + leaves[:r]
+        ordered = [n["address"] for n in leaves + inner if n["address"]]
+        if plan["root_source"]:
+            ordered.append(plan["root_source"])
+        return ordered
+
+    # -- fetch -------------------------------------------------------------
+
+    def fetch(
+        self,
+        version: "Optional[int]" = None,
+        timeout: float = 30.0,
+        delta: bool = True,
+    ) -> "Tuple[Any, int]":
+        """Fetch weight ``version`` (default: the fleet's latest);
+        returns ``(state_dict, version)``.
+
+        With ``delta`` and a previously fetched version held, only the
+        manifest plus changed fragments cross the wire.  Sources are
+        tried leaves-first within the deadline; a source failure (killed
+        server, staging lag past its budget slice) fails over to the
+        next and counts in ``torchft_serving_failovers_total``."""
+        deadline = time.monotonic() + timeout
+        plan = self.plan()
+        pinned = version is not None
+        v = int(version) if pinned else int(plan["latest_version"])
+        if v <= 0:
+            raise RuntimeError("serving tier has no published version yet")
+        _faults.check("serving.fetch", step=v)
+        t0 = time.perf_counter()
+        t0_ns = time.time_ns()
+        with _flightrec.track("serving.fetch", step=v, role="client") as op:
+            state, v, failovers = self._fetch_any(
+                v, plan, deadline, delta, pinned
+            )
+            op.update(failovers=failovers, version=v)
+        dt = time.perf_counter() - t0
+        _metrics.SERVING_FETCH_SECONDS.labels(role="client").observe(dt)
+        tracer = _tracing.get_tracer()
+        ctx = _tracing.get_current()
+        if tracer is not None and ctx is not None and ctx.sampled:
+            tracer.export_span(
+                name="serving.fetch",
+                trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id,
+                start_ns=t0_ns,
+                end_ns=time.time_ns(),
+                attributes={"version": v, "failovers": failovers},
+            )
+        return state, v
+
+    def _fetch_any(
+        self,
+        v: int,
+        plan: "Dict[str, Any]",
+        deadline: float,
+        delta: bool,
+        pinned: bool,
+    ) -> "Tuple[Any, int, int]":
+        """Try sources in failover order; returns ``(state, version,
+        failovers)``.  An UNPINNED fetch (caller asked for "latest")
+        re-resolves the target version on every failover: under a fast
+        publish cadence the originally-latest version can be evicted
+        from every staging window before a slow start completes, and a
+        newer version satisfies the caller strictly better."""
+        sources = self._sources(plan)
+        if not sources:
+            raise RuntimeError("serving plan has no servable nodes")
+        failovers = 0
+        last: "Optional[Exception]" = None
+        i = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            budget = max(remaining / max(len(sources) - i, 1), 0.2)
+            # Every non-final slice is capped so a dead source costs
+            # seconds.  An UNPINNED fetch caps the final slice too: if
+            # the target was evicted fleet-wide (publish cadence outran
+            # this fetch), burning the whole deadline polling 503s on
+            # one source would be pure loss — re-resolve and go again.
+            if i < len(sources) - 1 or not pinned:
+                budget = min(budget, self._failover_s, remaining)
+            try:
+                state = self._fetch_from(sources[i], v, budget, delta)
+                if failovers:
+                    _metrics.SERVING_FAILOVERS.labels(role="client").inc(
+                        failovers
+                    )
+                return state, v, failovers
+            except Exception as e:  # noqa: BLE001 - failover path
+                last = e
+                failovers += 1
+                logger.warning(
+                    "serving fetch v%d from %s failed (%s); failing over",
+                    v, sources[i], e,
+                )
+                # mid-fetch plan refresh: the tree may have re-formed
+                # around a dead node, and an unpinned target re-resolves
+                # to the CURRENT latest version
+                restart = False
+                try:
+                    plan = self.plan(refresh=True)
+                    if not pinned and int(plan["latest_version"]) > v:
+                        v = int(plan["latest_version"])
+                        restart = True  # newer version: walk from the top
+                    sources = self._sources(plan) or sources
+                except Exception:  # noqa: BLE001 - keep old list
+                    pass
+                i = 0 if restart else i + 1
+                if i >= len(sources):
+                    if pinned:
+                        break
+                    i = 0  # unpinned: keep cycling until the deadline
+        # The LAST failed attempt never moved to another source — it is
+        # the terminal failure, not a failover (on the success path every
+        # earlier failure did move, so the count there is already right).
+        failovers = max(failovers - 1, 0)
+        if failovers:
+            _metrics.SERVING_FAILOVERS.labels(role="client").inc(failovers)
+        raise TimeoutError(
+            f"serving fetch v{v}: no source completed within deadline "
+            f"({failovers} failover(s))"
+        ) from last
+
+    def _fetch_from(
+        self, base: str, v: int, budget: float, delta: bool
+    ) -> Any:
+        t_end = time.monotonic() + budget
+        if delta and self._held is not None and self._held_version > 0:
+            frag_doc = fetch_resource(
+                base, v, f"frag_{_payload.MANIFEST_FRAG}",
+                timeout=t_end - time.monotonic(),
+            )
+            manifest = frag_doc
+            names = _payload.changed_fragments(manifest, self._held[0])
+            doc: "Dict[str, Any]" = {
+                f"frag:{_payload.MANIFEST_FRAG}": manifest
+            }
+            for name in names:
+                doc[f"frag:{name}"] = fetch_resource(
+                    base, v, f"frag_{name}",
+                    timeout=max(t_end - time.monotonic(), 0.001),
+                )
+            state, manifest, leaves = _payload.decode_payload(
+                doc, prev=self._held
+            )
+        else:
+            doc = fetch_resource(base, v, "full", timeout=budget)
+            state, manifest, leaves = _payload.decode_payload(doc)
+        if int(manifest["version"]) != v:
+            raise RuntimeError(
+                f"serving fetch: wanted v{v}, source {base} served "
+                f"v{manifest['version']}"
+            )
+        self._held = (manifest, leaves)
+        self._held_version = v
+        return state
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,204 @@
+"""Versioned weight-payload codec for the serving tier.
+
+A published weight version is one staged checkpoint-transport document
+(``HTTPTransport`` multi-slot staging keyed by VERSION instead of step):
+
+.. code-block:: text
+
+    {
+      "frag:manifest": {version, wire, fragments, digests, skeleton,
+                        num_leaves, created_ns},
+      "frag:0": {"<slot>": <encoded leaf>, ...},
+      ...
+      "frag:<F-1>": {...},
+    }
+
+Every fragment is independently fetchable via the transport's
+``frag_<name>`` resource, so a client that already holds version ``V``
+can pull version ``V+1`` as *manifest + changed fragments only* — the
+per-fragment ``digests`` (publisher-computed over the encoded leaf
+bytes) say which fragments moved.  A DiLoCo fragment maps naturally onto
+one payload fragment (the delta unit the training side already syncs).
+
+Leaves are optionally int8-quantized through the same per-row absmax
+codec the quantized collectives use (``ops/quantization.py``, reusing
+its GIL-free native kernels): a float32 leaf becomes
+``{"q8": int8 payload, "scale": f32 row scales, "shape": [...]}``.
+Encoding is deterministic, so two serving replicas relaying the same
+published version hold — and serve — bitwise-identical bytes: the
+property the chaos tests pin (failover mid-fetch completes with
+identical weights).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "WIRE_F32",
+    "WIRE_INT8",
+    "MANIFEST_FRAG",
+    "encode_payload",
+    "decode_fragment",
+    "decode_payload",
+    "changed_fragments",
+]
+
+WIRE_F32 = "f32"
+WIRE_INT8 = "int8"
+
+#: the manifest travels as a fragment itself so the delta path is
+#: uniform: fetch ``frag_manifest``, diff digests, fetch what moved.
+MANIFEST_FRAG = "manifest"
+
+_Q8_KEY = "q8"
+
+
+def _encode_leaf(leaf: Any, wire: str) -> Any:
+    if wire != WIRE_INT8:
+        return leaf
+    if not isinstance(leaf, np.ndarray) and hasattr(leaf, "__array__"):
+        leaf = np.asarray(leaf)
+    if (
+        not isinstance(leaf, np.ndarray)
+        or leaf.dtype != np.float32
+        or leaf.size == 0
+    ):
+        return leaf
+    from torchft_tpu.ops import quantization as q
+
+    # The codec's own row view (``_as_rows``: leading dim = rows, rest
+    # flattened) — passing the leaf straight through keeps serving
+    # payload bytes in lockstep with the collective wire bytes by
+    # construction, not by a mirrored re-implementation.
+    scales, payload = q.quantize(np.ascontiguousarray(leaf), q.WIRE_INT8)
+    return {
+        _Q8_KEY: payload,
+        "scale": scales,
+        "shape": np.asarray(leaf.shape, dtype=np.int64),
+    }
+
+
+def _decode_leaf(leaf: Any) -> Any:
+    if isinstance(leaf, dict) and _Q8_KEY in leaf:
+        from torchft_tpu.ops import quantization as q
+
+        shape = tuple(int(d) for d in np.asarray(leaf["shape"]).tolist())
+        return q.dequantize(
+            np.asarray(leaf["scale"]),
+            np.asarray(leaf[_Q8_KEY]),
+            shape,
+            np.dtype(np.float32),
+        )
+    return leaf
+
+
+def _leaf_bytes(leaf: Any) -> bytes:
+    """Stable byte view of an encoded leaf for digesting."""
+    if isinstance(leaf, dict) and _Q8_KEY in leaf:
+        return (
+            np.ascontiguousarray(leaf[_Q8_KEY]).tobytes()
+            + np.ascontiguousarray(leaf["scale"]).tobytes()
+        )
+    if isinstance(leaf, np.ndarray) or hasattr(leaf, "__array__"):
+        return np.ascontiguousarray(np.asarray(leaf)).tobytes()
+    return repr(leaf).encode()
+
+
+def encode_payload(
+    state_dict: Any,
+    version: int,
+    wire: str = WIRE_F32,
+    fragments: int = 1,
+) -> "Dict[str, Any]":
+    """Build the staged document for one published weight version.
+
+    ``fragments``: leaf slots are split round-robin into this many
+    independently fetchable fragments (the delta unit); pass the DiLoCo
+    fragment count to align delta fetches with training's sync unit.
+    """
+    import jax
+
+    if wire not in (WIRE_F32, WIRE_INT8):
+        raise ValueError(f"serving wire must be f32|int8, got {wire!r}")
+    fragments = max(int(fragments), 1)
+    leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+    skeleton = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
+    frag_names = [str(i) for i in range(min(fragments, max(len(leaves), 1)))]
+    doc: "Dict[str, Any]" = {}
+    digests: "Dict[str, str]" = {}
+    for fi, name in enumerate(frag_names):
+        frag: "Dict[str, Any]" = {}
+        h = hashlib.sha256()
+        for slot in range(fi, len(leaves), len(frag_names)):
+            enc = _encode_leaf(leaves[slot], wire)
+            frag[str(slot)] = enc
+            h.update(str(slot).encode())
+            h.update(_leaf_bytes(enc))
+        doc[f"frag:{name}"] = frag
+        digests[name] = h.hexdigest()
+    doc[f"frag:{MANIFEST_FRAG}"] = {
+        "version": int(version),
+        "wire": wire,
+        "fragments": frag_names,
+        "digests": digests,
+        "skeleton": skeleton,
+        "num_leaves": len(leaves),
+        "created_ns": time.time_ns(),
+    }
+    return doc
+
+
+def decode_fragment(frag: "Dict[str, Any]") -> "Dict[int, Any]":
+    """Decode one fetched fragment into ``{leaf slot: decoded leaf}``."""
+    return {int(slot): _decode_leaf(leaf) for slot, leaf in frag.items()}
+
+
+def changed_fragments(
+    manifest: "Dict[str, Any]", prev_manifest: "Optional[Dict[str, Any]]"
+) -> "List[str]":
+    """Fragment names whose digest differs from ``prev_manifest`` (all of
+    them when there is no previous version or the shape changed)."""
+    names = list(manifest["fragments"])
+    if prev_manifest is None or prev_manifest.get("num_leaves") != manifest.get(
+        "num_leaves"
+    ):
+        return names
+    prev = prev_manifest.get("digests") or {}
+    return [n for n in names if manifest["digests"].get(n) != prev.get(n)]
+
+
+def decode_payload(
+    doc: "Dict[str, Any]",
+    prev: "Optional[Tuple[Dict[str, Any], Dict[int, Any]]]" = None,
+) -> "Tuple[Any, Dict[str, Any], Dict[int, Any]]":
+    """Decode a full fetched document (or a manifest + changed-fragment
+    subset merged over ``prev = (prev_manifest, prev_leaves)``).
+
+    Returns ``(state_dict, manifest, leaves)`` — keep ``(manifest,
+    leaves)`` around to decode the next delta fetch.
+    """
+    import jax
+
+    manifest = doc[f"frag:{MANIFEST_FRAG}"]
+    leaves: "Dict[int, Any]" = dict(prev[1]) if prev is not None else {}
+    for name in manifest["fragments"]:
+        frag = doc.get(f"frag:{name}")
+        if frag is not None:
+            leaves.update(decode_fragment(frag))
+    n = int(manifest["num_leaves"])
+    missing = [i for i in range(n) if i not in leaves]
+    if missing:
+        raise ValueError(
+            f"serving payload v{manifest.get('version')}: missing leaf "
+            f"slots {missing[:5]}{'...' if len(missing) > 5 else ''} "
+            f"(delta fetch without a complete previous version?)"
+        )
+    state = jax.tree_util.tree_map(
+        lambda slot: leaves[slot], manifest["skeleton"]
+    )
+    return state, manifest, leaves

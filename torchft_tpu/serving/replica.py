@@ -1,0 +1,275 @@
+"""ServingReplica: one node of the weight-distribution fan-out tree.
+
+Registers the ``server`` serving role with the lighthouse, adopts the
+synthesized plan whenever the plan epoch moves (the PR 10 epoch-commit
+idiom: epochs are monotone and name exactly one tree, so adoption is a
+local, wedge-free act — a replica mid-switch simply serves the versions
+it already holds while it re-parents), pulls new weight versions from
+its tree parent (the root pulls from the publisher), and re-stages them
+in its own HTTP checkpoint transport for its children and for inference
+clients.  A dead parent is routed around: the pull fails over to the
+publisher/root source, so a killed interior node degrades depth, never
+availability.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils import tracing as _tracing
+from torchft_tpu.utils.env import env_float, env_int
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServingReplica"]
+
+
+class ServingReplica:
+    """A relay/leaf serving replica.
+
+    Args:
+        lighthouse_addr: the lighthouse coordinating the serving tier.
+        replica_id: stable id (default ``serve_<uuid8>``); ordering over
+            ids determines the synthesized tree position.
+        capacity: max children this node accepts (0 = the lighthouse's
+            configured fanout).
+        max_versions: staged versions retained (default
+            ``TORCHFT_SERVING_VERSIONS``).
+        poll_interval: heartbeat + version-poll cadence in seconds
+            (default ``TORCHFT_SERVING_POLL_S``).
+        fetch_timeout: per-pull deadline (default
+            ``TORCHFT_SERVING_FETCH_TIMEOUT_S``).
+    """
+
+    def __init__(
+        self,
+        lighthouse_addr: str,
+        replica_id: "Optional[str]" = None,
+        capacity: int = 0,
+        max_versions: "Optional[int]" = None,
+        poll_interval: "Optional[float]" = None,
+        fetch_timeout: "Optional[float]" = None,
+    ) -> None:
+        from torchft_tpu.coordination import LighthouseClient
+
+        self._replica_id = replica_id or f"serve_{uuid.uuid4().hex[:8]}"
+        self._capacity = int(capacity)
+        self._client = LighthouseClient(lighthouse_addr)
+        self._transport = HTTPTransport(
+            max_staged=(
+                max_versions
+                if max_versions is not None
+                else env_int("TORCHFT_SERVING_VERSIONS", 4, minimum=1)
+            ),
+        )
+        self._poll = (
+            poll_interval
+            if poll_interval is not None
+            else env_float("TORCHFT_SERVING_POLL_S", 0.2, minimum=0.01)
+        )
+        self._fetch_timeout = (
+            fetch_timeout
+            if fetch_timeout is not None
+            else env_float("TORCHFT_SERVING_FETCH_TIMEOUT_S", 30.0, minimum=0.1)
+        )
+        # Per-source failover bound: a dead source costs at most this
+        # before the pull moves on (the LAST candidate gets the full
+        # remaining deadline, so a slow-but-alive fleet still completes).
+        self._failover_s = env_float("TORCHFT_SERVING_FAILOVER_S", 2.0,
+                                     minimum=0.05)
+        self._lock = threading.Lock()
+        self._version = 0
+        self._plan_epoch = -1
+        self._parent = ""       # adopted parent address ("" = unplaced)
+        self._root_source = ""  # publisher address (failover of last resort)
+        self._peers: "List[str]" = []  # other serving-node addresses
+        self._depth = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"tft_serving_{self._replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- introspection -----------------------------------------------------
+
+    def address(self) -> str:
+        """HTTP base address children/clients fetch from."""
+        return self._transport.metadata()
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    def version(self) -> int:
+        """Newest weight version staged and servable on this node."""
+        with self._lock:
+            return self._version
+
+    def plan_epoch(self) -> int:
+        with self._lock:
+            return self._plan_epoch
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    # -- the serving loop --------------------------------------------------
+
+    def _run(self) -> None:
+        # Pacing loop (not a retry loop): one heartbeat + pull check per
+        # poll interval; every failure inside is logged and re-attempted
+        # on the next beat — a serving replica must outlive any
+        # lighthouse restart or parent death.
+        while not self._stop.is_set():
+            try:
+                self._beat_once()
+            except Exception as e:  # noqa: BLE001 - keep serving
+                logger.warning(
+                    "serving replica %s beat failed: %s", self._replica_id, e
+                )
+            self._stop.wait(self._poll)
+
+    def _beat_once(self) -> None:
+        reply = self._client.serving_heartbeat(
+            self._replica_id,
+            self.address(),
+            role="server",
+            version=self.version(),
+            capacity=self._capacity,
+        )
+        if reply["plan_epoch"] != self.plan_epoch():
+            self._adopt_plan()
+        target = int(reply["latest_version"])
+        if target > self.version():
+            self._pull(target)
+
+    def _adopt_plan(self) -> None:
+        plan = self._client.serving_plan()
+        epoch = int(plan["epoch"])
+        # Chaos site: a raise here leaves the OLD tree adopted — the
+        # replica keeps serving what it holds (degrade, never wedge) and
+        # re-tries adoption on the next heartbeat.
+        _faults.check(
+            "serving.tree_commit", replica=self._replica_id, step=epoch
+        )
+        t0_ns = time.time_ns()
+        me = None
+        peers: "List[str]" = []
+        for node in plan["nodes"]:
+            if node["replica_id"] == self._replica_id:
+                me = node
+            elif node["address"]:
+                peers.append(node["address"])
+        with self._lock:
+            self._plan_epoch = epoch
+            self._root_source = plan["root_source"]
+            self._peers = peers
+            if me is not None:
+                self._parent = me["parent"] or plan["root_source"]
+                self._depth = int(me["depth"])
+        _metrics.SERVING_PLAN_EPOCH.labels(role="server").set(epoch)
+        _metrics.SERVING_TREE_DEPTH.set(int(plan["depth"]))
+        _flightrec.record(
+            "serving.tree_commit", start_ns=t0_ns, step=epoch,
+            parent=self._parent, depth=self._depth,
+        )
+        tracer = _tracing.get_tracer()
+        ctx = _tracing.get_current()
+        if tracer is not None and ctx is not None and ctx.sampled:
+            tracer.export_span(
+                name="serving.tree_commit",
+                trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id,
+                start_ns=t0_ns,
+                end_ns=time.time_ns(),
+                attributes={"epoch": epoch, "depth": self._depth},
+            )
+
+    def _pull(self, target: int) -> None:
+        """Pull version ``target`` from the parent; fail over to the
+        root source, then any peer, when the parent is dead or lags."""
+        _faults.check("serving.fetch", replica=self._replica_id, step=target)
+        with self._lock:
+            sources = [s for s in (self._parent, self._root_source) if s]
+            peers = list(self._peers)
+        own = self.address()
+        # dedupe, drop self, keep order: parent -> root source -> two
+        # peers (bounded walk: a stale target is cheaper to re-resolve
+        # on the next beat than to chase across the whole fleet)
+        seen = {own}
+        ordered: "List[str]" = []
+        for s in sources + peers:
+            if s not in seen:
+                seen.add(s)
+                ordered.append(s)
+        ordered = ordered[:4]
+        if not ordered:
+            return
+        t0 = time.perf_counter()
+        with _flightrec.track(
+            "serving.fetch", step=target, role="relay",
+        ) as op:
+            last: "Optional[Exception]" = None
+            deadline = time.monotonic() + self._fetch_timeout
+            for i, src in enumerate(ordered):
+                # Per-source budget: split the remaining deadline, but
+                # cap every non-final source at the failover bound so a
+                # dead parent costs seconds, not the whole deadline.
+                remaining = max(deadline - time.monotonic(), 0.1)
+                budget = max(remaining / max(len(ordered) - i, 1), 0.5)
+                if i < len(ordered) - 1:
+                    budget = min(budget, self._failover_s)
+                try:
+                    doc = self._transport.recv_checkpoint(
+                        0, src, step=target, timeout=budget
+                    )
+                    break
+                except Exception as e:  # noqa: BLE001 - failover path
+                    last = e
+                    if i < len(ordered) - 1:
+                        # count only pulls that actually MOVE to another
+                        # source; a terminal failure is not a failover
+                        _metrics.SERVING_FAILOVERS.labels(role="relay").inc()
+                    logger.warning(
+                        "serving relay %s: pull v%d from %s failed (%s); "
+                        "failing over",
+                        self._replica_id, target, src, e,
+                    )
+            else:
+                op.update(status="error")
+                raise ConnectionError(
+                    f"serving relay {self._replica_id}: no source served "
+                    f"v{target} within {self._fetch_timeout}s"
+                ) from last
+            self._transport.send_checkpoint(
+                [], target, doc, timeout=self._fetch_timeout
+            )
+        with self._lock:
+            if target > self._version:
+                self._version = target
+        dt = time.perf_counter() - t0
+        _metrics.SERVING_FETCH_SECONDS.labels(role="relay").observe(dt)
+        _metrics.SERVING_VERSION.labels(role="server").set(self.version())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def retire_below(self, version: int) -> None:
+        """Drop staged versions older than ``version`` (the bounded
+        staging window does this on its own; explicit for tests)."""
+        for v in self._transport.staged_steps():
+            if v < version:
+                self._transport.retire_checkpoint(v)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._client.close()
+        self._transport.shutdown()

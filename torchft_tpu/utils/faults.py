@@ -38,6 +38,13 @@ site                  fires in
                       max_step)
 ``transport.send``    ``send_checkpoint`` of both checkpoint transports
 ``transport.recv``    ``recv_checkpoint`` of both checkpoint transports
+``serving.publish``   ``WeightPublisher.publish`` before a weight
+                      version is encoded/staged (``step`` = version)
+``serving.fetch``     serving-tier fetch attempts — relay pull from the
+                      tree parent and client fetches (``step`` =
+                      version)
+``serving.tree_commit``  ``ServingReplica`` adopting a new
+                      distribution-tree plan epoch (``step`` = epoch)
 ``store.barrier``     blocking ``StoreClient.get(wait=True)`` (the
                       rendezvous-barrier wait PG configure relies on)
 ``local_sgd.sync``    ``LocalSGD.sync`` / DiLoCo fragment sync entry
@@ -119,6 +126,9 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "manager.layout_commit",
     "transport.send",
     "transport.recv",
+    "serving.publish",
+    "serving.fetch",
+    "serving.tree_commit",
     "store.barrier",
     "local_sgd.sync",
     "train.step",

@@ -1020,3 +1020,51 @@ LOCK_HOLD_OUTLIERS = counter(
     "(utils/lockcheck.py; straggler-origin telemetry)",
     ("name",),
 )
+SERVING_PUBLISHES = counter(
+    "torchft_serving_versions_published_total",
+    "Weight versions published into the serving tier by wire format "
+    "(serving/publisher.py; f32 = raw, int8 = quantized payload)",
+    ("wire",),
+)
+SERVING_PUBLISH_SECONDS = histogram(
+    "torchft_serving_publish_seconds",
+    "Wall seconds to encode + stage one published weight version "
+    "(serving/publisher.py) by wire format",
+    ("wire",),
+)
+SERVING_FETCH_SECONDS = histogram(
+    "torchft_serving_fetch_seconds",
+    "Weight-version fetch wall seconds by role (relay = tree node "
+    "pulling from its parent, client = inference client fetch incl. "
+    "failover)",
+    ("role",),
+)
+SERVING_FETCH_BYTES = counter(
+    "torchft_serving_fetch_bytes_total",
+    "Bytes received by serving-tier fetches, by role (relay/client)",
+    ("role",),
+)
+SERVING_FAILOVERS = counter(
+    "torchft_serving_failovers_total",
+    "Serving fetches that moved to another source after a failure "
+    "(dead parent / killed server mid-fetch), by role",
+    ("role",),
+)
+SERVING_PLAN_EPOCH = gauge(
+    "torchft_serving_plan_epoch",
+    "Distribution-tree plan epoch this process last adopted, by role "
+    "(publisher/server/client; monotone — lags the lighthouse's "
+    "torchft_lighthouse_serving_epoch only during a tree switch)",
+    ("role",),
+)
+SERVING_TREE_DEPTH = gauge(
+    "torchft_serving_tree_depth",
+    "Depth of the adopted distribution tree (serving_plan max node "
+    "depth; 0 = every server pulls the publisher directly)",
+    (),
+)
+SERVING_VERSION = gauge(
+    "torchft_serving_version",
+    "Newest weight version this process holds/has published, by role",
+    ("role",),
+)

@@ -34,7 +34,7 @@ class RWLock:
     a false reader<->writer cycle for it; see lockcheck.gate()).
     """
 
-    def __init__(self, timeout: float = -1) -> None:
+    def __init__(self, timeout: float = -1, writer_priority: bool = False) -> None:
         # Default timeout applied when an acquire doesn't pass its own.
         self._default_timeout = timeout
         self._reader_lock = lockcheck.lock("rwlock.reader_gate")
@@ -44,6 +44,22 @@ class RWLock:
         # it gets hold-time-only instrumentation
         self._writer_lock = lockcheck.gate("rwlock.writer_gate")
         self._readers = 0
+        # Writer-priority turnstile (opt-in).  The plain lock is
+        # reader-preferring: with continuously overlapping readers,
+        # ``_readers`` never reaches 0 and a writer starves FOREVER —
+        # measured in the serving soak, where 32 polling clients held the
+        # staged-snapshot read side so densely that a relay's staging
+        # write lock never acquired and the whole tier 503'd.  With
+        # ``writer_priority``, a waiting writer holds the turnstile while
+        # it waits, new readers must pass through it first, the existing
+        # readers drain, and the writer gets in; readers resume after.
+        # Sharpens the documented non-reentrancy rule: under
+        # writer_priority a reader RE-entering acquire_read while a
+        # writer waits deadlocks by construction — don't nest readers.
+        self._writer_priority = writer_priority
+        self._turnstile = (
+            lockcheck.lock("rwlock.turnstile") if writer_priority else None
+        )
 
     def _resolve(self, timeout: float | None) -> float:
         return self._default_timeout if timeout is None else timeout
@@ -53,6 +69,16 @@ class RWLock:
         # Single deadline across both mutex acquisitions so the configured
         # timeout bounds the total wait, not each stage.
         deadline = time.monotonic() + t if t >= 0 else None
+        if self._turnstile is not None:
+            # Writer-priority: pass through the turnstile a waiting
+            # writer holds, so new readers queue BEHIND the writer
+            # instead of starving it (acquire-and-release: readers never
+            # hold it while waiting on the gates below).
+            if not self._turnstile.acquire(timeout=t):
+                raise TimeoutError(f"acquire_read timed out after {t}s")
+            self._turnstile.release()
+            if deadline is not None:
+                t = max(0.0, deadline - time.monotonic())
         if not self._reader_lock.acquire(timeout=t):
             raise TimeoutError(f"acquire_read timed out after {t}s")
         try:
@@ -75,8 +101,25 @@ class RWLock:
 
     def acquire_write(self, timeout: float | None = None) -> None:
         t = self._resolve(timeout)
-        if not self._writer_lock.acquire(timeout=t):
+        if self._turnstile is None:
+            if not self._writer_lock.acquire(timeout=t):
+                raise TimeoutError(f"acquire_write timed out after {t}s")
+            return
+        # Writer-priority: hold the turnstile while waiting on the
+        # writer gate — new readers block at the turnstile, the readers
+        # already in drain, and the writer acquires in bounded time
+        # regardless of reader arrival rate.
+        deadline = time.monotonic() + t if t >= 0 else None
+        if not self._turnstile.acquire(timeout=t):
             raise TimeoutError(f"acquire_write timed out after {t}s")
+        try:
+            remaining = (
+                t if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            if not self._writer_lock.acquire(timeout=remaining):
+                raise TimeoutError(f"acquire_write timed out after {t}s")
+        finally:
+            self._turnstile.release()
 
     def release_write(self) -> None:
         self._writer_lock.release()
