@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving serve-soak
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving serve-soak ha-smoke bench-ha
 
 native:
 	$(MAKE) -C native
@@ -65,6 +65,20 @@ serve-soak:
 # with the same < 1.5 KB compact-summary JSON line as the full bench.
 bench-serving:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving
+
+# Coordination-plane HA round trip alone: 3 lighthouse subprocesses,
+# SIGKILL the active leader mid-quorum-round and mid-serving-fetch —
+# the fleet re-quorums with monotone term-prefixed quorum ids, serving
+# clients complete bitwise-identical, never a wedge
+# (docs/architecture.md "Coordination-plane HA").
+ha-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_ha.py tests/test_ha_integ.py -q -m "not slow"
+
+# HA failover bench alone: leader-kill -> next-quorum latency over an
+# in-process 3-peer fleet; ends with the same < 1.5 KB compact-summary
+# JSON line as the full bench.
+bench-ha:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --ha-failover
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

@@ -1983,6 +1983,63 @@ def bench_serving() -> "Dict[str, Any]":
 COMPACT_SUMMARY_MAX_BYTES = 1500
 
 
+HA_PEERS = 3
+HA_TRIALS = 3
+HA_LEASE_MS = 500
+
+
+def bench_ha() -> "Dict[str, Any]":
+    """Coordination-plane HA failover: HA_PEERS in-process lighthouse
+    peers with leased leadership; a replica-group stub quorums through
+    the endpoint-list client, the LEADER is killed, and the headline is
+    leader-kill -> next formed quorum latency (the coordination-plane
+    twin of the recovery metric).  Also asserts what the chaos tests
+    assert: quorum_id strictly monotone with an advancing term word.
+    docs/architecture.md "Coordination-plane HA"."""
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.ha import LighthouseFleet
+
+    trials: "List[float]" = []
+    monotone = True
+    term_advanced = True
+    takeover_terms: "List[int]" = []
+    for t in range(HA_TRIALS):
+        fleet = LighthouseFleet(
+            n=HA_PEERS, min_replicas=1, lease_timeout_ms=HA_LEASE_MS,
+            quorum_tick_ms=50,
+        )
+        try:
+            fleet.wait_for_leader(20)
+            cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+            try:
+                q1 = cli.quorum(f"bench_ha:{t}a", timeout=15.0)
+                t0 = time.monotonic()
+                fleet.kill_leader()
+                q2 = cli.quorum(f"bench_ha:{t}b", timeout=30.0)
+                trials.append(time.monotonic() - t0)
+                monotone = monotone and q2.quorum_id > q1.quorum_id
+                term_advanced = term_advanced and (
+                    (q2.quorum_id >> 32) > (q1.quorum_id >> 32)
+                )
+                takeover_terms.append(q2.quorum_id >> 32)
+            finally:
+                cli.close()
+        finally:
+            fleet.shutdown()
+    trials.sort()
+    return {
+        "peers": HA_PEERS,
+        "lease_ms": HA_LEASE_MS,
+        "trials": len(trials),
+        "kill_to_quorum_p50_s": round(trials[len(trials) // 2], 3),
+        "kill_to_quorum_max_s": round(trials[-1], 3),
+        "kill_to_quorum_s": [round(x, 3) for x in trials],
+        "quorum_id_monotone": monotone,
+        "term_advanced": term_advanced,
+        "takeover_terms": takeover_terms,
+    }
+
+
 def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     """Distill the full bench result into one < 1.5 KB JSON line: the
     primary recovery metric + cycle medians, overhead + cross-check
@@ -2022,6 +2079,18 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     )
     switch = result.get("switch") or {}
     serving = result.get("serving") or {}
+    ha = result.get("ha") or {}
+    ha_compact = {
+        k: ha.get(k)
+        for k in (
+            "kill_to_quorum_p50_s",
+            "kill_to_quorum_max_s",
+            "lease_ms",
+            "quorum_id_monotone",
+            "term_advanced",
+        )
+        if ha.get(k) is not None
+    } or None
     serving_compact = {
         k: serving.get(k)
         for k in (
@@ -2066,6 +2135,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # serving-tier headline (ISSUE 12): sustained checkpoints/sec +
         # p99 fetch under churn + the post-failover bitwise verdict
         "serving": serving_compact,
+        # coordination-plane HA headline (ISSUE 13): leader-kill -> next
+        # formed quorum latency + the monotonicity verdicts
+        "ha": ha_compact,
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
         # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
@@ -2093,7 +2165,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
-        "serving",
+        "ha", "serving",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2136,6 +2208,14 @@ def main() -> None:
         # the compact tail (same last-line contract as the full run)
         serving = bench_serving()
         result = {"metric": "serving_fanout_under_churn", "serving": serving}
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
+    if "--ha-failover" in sys.argv:
+        # `make bench-ha`: the coordination-plane failover leg alone,
+        # with the compact tail (same last-line contract as the full run)
+        ha = bench_ha()
+        result = {"metric": "ha_leader_failover", "ha": ha}
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
         return
@@ -2223,6 +2303,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"serving bench failed: {e!r}")
         serving = {"error": repr(e)}
+    try:
+        # coordination-plane HA: leader-kill -> next-quorum latency over
+        # a replicated lighthouse (ISSUE 13)
+        ha = bench_ha()
+    except Exception as e:  # noqa: BLE001
+        log(f"ha bench failed: {e!r}")
+        ha = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",
@@ -2235,6 +2322,7 @@ def main() -> None:
         "wan": wan,
         "switch": switch,
         "serving": serving,
+        "ha": ha,
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

@@ -62,7 +62,8 @@ int64_t tft_lighthouse_create(const char* bind_host, int port,
                               int64_t heartbeat_timeout_ms,
                               int64_t status_page_size,
                               int64_t straggler_topk, int64_t timeline_ring,
-                              int64_t serving_fanout) {
+                              int64_t serving_fanout, const char* peers,
+                              int64_t lease_timeout_ms) {
   try {
     tft::LighthouseOpt opt;
     opt.bind_host = bind_host ? bind_host : "";
@@ -75,6 +76,10 @@ int64_t tft_lighthouse_create(const char* bind_host, int port,
     if (straggler_topk > 0) opt.straggler_topk = straggler_topk;
     if (timeline_ring > 0) opt.timeline_ring = timeline_ring;
     if (serving_fanout > 0) opt.serving_fanout = serving_fanout;
+    // Coordination-plane HA: comma list of the OTHER lighthouse peers
+    // (empty/NULL = single-process mode) + leadership lease duration.
+    opt.peers = peers ? peers : "";
+    if (lease_timeout_ms > 0) opt.lease_timeout_ms = lease_timeout_ms;
     auto server = std::make_unique<tft::LighthouseServer>(opt);
     server->start_serving();
     return register_server(
@@ -163,6 +168,20 @@ int tft_lighthouse_set_metrics_provider(int64_t h,
   }
   lighthouse->set_metrics_provider(provider);
   return 0;
+}
+
+// Coordination-plane HA introspection: one JSON object
+// {"enabled","term","is_leader","leader","peers","takeovers_total",
+// "quorum_id"} for a lighthouse handle (the fleet helper and tests poll
+// this to find the current leader without a wire round trip).
+char* tft_lighthouse_ha_info(int64_t h) {
+  tft::RpcServer* s = find_server(h);
+  auto* lighthouse = dynamic_cast<tft::LighthouseServer*>(s);
+  if (lighthouse == nullptr) {
+    g_last_error = "bad lighthouse handle";
+    return nullptr;
+  }
+  return dup_string(lighthouse->ha_info().dump());
 }
 
 // Install (or clear, with NULL) the process-wide span sink: the native

@@ -7,6 +7,7 @@
 #include <cctype>
 #include <chrono>
 #include <cstdio>
+#include <random>
 #include <set>
 #include <sstream>
 
@@ -81,18 +82,32 @@ Quorum Quorum::from_json(const Json& j) {
 }
 
 LighthouseServer::LighthouseServer(const LighthouseOpt& opt)
-    : RpcServer(opt.bind_host, opt.port), opt_(opt) {}
+    : RpcServer(opt.bind_host, opt.port), opt_(opt) {
+  peers_ = split_endpoints(opt_.peers);
+  // Normalize the lease ONCE so every consumer (rpc_lease promise
+  // stamps, become_leader, the election loop's round-validity bound)
+  // agrees on the same value — a floor applied only in the elector
+  // would let a sub-floor configuration elect on already-expired
+  // grants.
+  opt_.lease_timeout_ms = std::max<int64_t>(opt_.lease_timeout_ms, 40);
+  // HA mode starts as a follower: leadership must be won by majority
+  // lease acknowledgement, never assumed.
+  if (ha_enabled()) is_leader_ = false;
+}
 
 LighthouseServer::~LighthouseServer() { stop(); }
 
 void LighthouseServer::start_serving() {
   start();
   tick_thread_ = std::thread([this] { tick_loop(); });
+  if (ha_enabled())
+    election_thread_ = std::thread([this] { election_loop(); });
 }
 
 void LighthouseServer::stop() {
   shutdown();  // idempotent; closes conns and calls wake_blocked()
   if (tick_thread_.joinable()) tick_thread_.join();
+  if (election_thread_.joinable()) election_thread_.join();
 }
 
 void LighthouseServer::wake_blocked() {
@@ -262,6 +277,15 @@ void LighthouseServer::tick_locked(int64_t now) {
   // serving RPC traffic.  O(serving fleet), microseconds at any
   // plausible size — the quorum dirty-set gate below is unaffected.
   serving_gc_locked(now);
+  // HA: only a leader with a live lease may form quorums — a deposed or
+  // lease-lapsed peer forming one could mint an id behind the current
+  // leader's.  Heartbeat-expiry bookkeeping above keeps running.
+  if (ha_enabled() && (!is_leader_ || now_ms() >= lease_until_ms_)) {
+    observe_tick_locked(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
+    return;
+  }
   // Dirty-set gate: with no state change and no timed deadline due, the
   // last decision is still the decision — skip the O(fleet) recompute.
   if (dirty_.empty() && now < wake_deadline_ms_) {
@@ -299,7 +323,14 @@ void LighthouseServer::tick_locked(int64_t now) {
   bool commit_failure = std::any_of(
       parts.begin(), parts.end(),
       [](const QuorumMember& p) { return p.commit_failures > 0; });
-  if (membership_changed || commit_failure) quorum_id_ += 1;
+  if (membership_changed || commit_failure) {
+    // Term-prefixed id (coordination-plane HA): (term << 32) | seq stays
+    // strictly monotone across a leader change with zero state transfer
+    // — a new leader's higher term dominates any predecessor's seq.  In
+    // single-process mode term is 0 and this is the pre-HA +1.
+    quorum_seq_in_term_ += 1;
+    quorum_id_ = ha_epoch_id(term_, quorum_seq_in_term_);
+  }
 
   Quorum q;
   q.quorum_id = quorum_id_;
@@ -332,8 +363,361 @@ bool LighthouseServer::tick_for_test() {
   return quorum_seq_ != seq;
 }
 
+// ---------------------------------------------------------------------------
+// Coordination-plane HA: leased leadership among a static peer set.
+// Lighthouse state is soft, so a takeover transfers nothing — the new
+// leader's higher term prefixes every id it mints ((term << 32) | seq)
+// and clients rebuild the membership/serving tables by re-registering.
+// At-most-one-leader-per-term is enforced by the grant rule below: a
+// peer's promised term is monotone, and a term granted to one candidate
+// is never granted to another.
+// ---------------------------------------------------------------------------
+
+void LighthouseServer::require_leader_locked(const char* method) {
+  if (!ha_enabled()) return;
+  // A leader whose lease lapsed (renewals not landing) must stop
+  // serving IMMEDIATELY, not when the election thread next notices: a
+  // higher-term leader may already exist, and ids minted here would
+  // regress behind its.  The election thread still does the formal
+  // step-down/re-campaign.
+  if (is_leader_ && now_ms() < lease_until_ms_) return;
+  // Freshest hint: whoever holds this peer's current promise.  An empty
+  // hint tells the client to keep walking its endpoint list.
+  std::string hint =
+      (now_ms() < promise_expires_ms_ && promised_to_ != address())
+          ? promised_to_
+          : "";
+  throw NotLeaderError(
+      std::string("lighthouse: not the leader for ") + method +
+          (hint.empty() ? " (no leader known)" : " (leader: " + hint + ")"),
+      hint);
+}
+
+Json LighthouseServer::rpc_lease(const Json& params) {
+  std::lock_guard<std::mutex> g(mu_);
+  lease_requests_total_ += 1;
+  int64_t term = params.get("term").as_int();
+  std::string candidate = params.get("candidate").as_string();
+  if (candidate.empty()) throw std::runtime_error("lease: missing candidate");
+  int64_t now = now_ms();
+  // Grant rule (at-most-one-leader-per-term + lease safety):
+  //   - renewal: the promise holder may refresh/raise its own term;
+  //   - takeover: a NEW candidate needs a strictly higher term AND an
+  //     unshielded promise slot.  The shield is the lease: a fresh grant
+  //     to ANOTHER peer protects a live leader from impatient
+  //     candidates.  This peer's own FAILED-candidacy self-promise does
+  //     not shield (nobody leads on it; making rivals wait a lease for
+  //     it just split-votes the election into lockstep) — unless this
+  //     peer actually leads, in which case its own record shields like
+  //     any granted lease.
+  bool renewal = candidate == promised_to_ && term >= promised_term_;
+  bool shielded = now < promise_expires_ms_ &&
+                  !(promised_to_ == address() && !is_leader_) &&
+                  !promised_to_.empty();
+  bool takeover = term > promised_term_ && !shielded;
+  bool granted = renewal || takeover;
+  if (granted) {
+    promised_term_ = term;
+    promised_to_ = candidate;
+    promise_expires_ms_ = now + opt_.lease_timeout_ms;
+    if (is_leader_ && term > term_) {
+      // We just acknowledged a higher-term leadership: stop serving NOW
+      // so blocked quorum waiters fail over instead of timing out.
+      is_leader_ = false;
+      quorum_cv_.notify_all();
+    }
+  } else {
+    max_seen_term_ = std::max(max_seen_term_, term);
+  }
+  Json out = Json::object();
+  out["granted"] = granted;
+  out["term"] = promised_term_;
+  out["holder"] = promised_to_;
+  return out;
+}
+
+void LighthouseServer::become_leader_locked(int64_t term, int64_t now) {
+  // ``now`` is the winning round's START, not its end: each grantor's
+  // promise expires one lease after its grant was GIVEN (>= round
+  // start), so anchoring our own lease at the round start guarantees we
+  // stop serving before any grantor's promise can lapse and enable a
+  // successor — the grant-side and leader-side lease clocks can only
+  // disagree by clock RATE drift, never by round duration.
+  is_leader_ = true;
+  term_ = term;
+  lease_until_ms_ = now + opt_.lease_timeout_ms;
+  promised_term_ = term;
+  promised_to_ = address();
+  promise_expires_ms_ = now + opt_.lease_timeout_ms;
+  takeovers_total_ += 1;
+  // Fresh term => fresh low words: every id this leadership mints is
+  // strictly larger than anything a lower-term leader could have minted.
+  quorum_seq_in_term_ = 0;
+  serving_seq_in_term_ = 0;
+  quorum_id_ = ha_epoch_id(term_, 0);
+  serving_epoch_ = ha_epoch_id(term_, 0);
+  // Soft state from any PREVIOUS leadership of this peer is stale (the
+  // fleet re-registered elsewhere in between): drop it and let clients
+  // rebuild it, exactly as they would against a brand-new process.
+  // Supersession stamps are deliberately kept — extra zombie safety when
+  // this peer happens to remember them.
+  participants_.clear();
+  progress_.clear();
+  heartbeats_.clear();
+  hb_expiry_.clear();
+  hb_pos_.clear();
+  dirty_.clear();
+  serving_.clear();
+  prev_quorum_.reset();
+  wake_deadline_ms_ = INT64_MAX;
+  last_reason_ = "leadership takeover (term " + std::to_string(term_) +
+                 "); waiting for participants to re-register";
+  fprintf(stderr,
+          "[torchft lighthouse %s] leadership takeover: term %lld\n",
+          address().c_str(), static_cast<long long>(term_));
+}
+
+void LighthouseServer::bump_serving_epoch_locked() {
+  serving_seq_in_term_ += 1;
+  serving_epoch_ = ha_epoch_id(term_, serving_seq_in_term_);
+}
+
+namespace {
+// One lease exchange with a SINGLE connect attempt: electors probe dead
+// peers on every round, and a backoff-retry connect would burn most of
+// a round's budget on a corpse (measured: perpetual split votes at
+// small leases).  Returns false on any transport failure.
+bool lease_rpc(const std::string& addr, const Json& lease_params,
+               int64_t budget_ms, Json* reply) {
+  int64_t deadline = now_ms() + budget_ms;
+  int fd = connect_once(addr, budget_ms, nullptr);
+  if (fd < 0) return false;
+  Json req = Json::object();
+  req["method"] = "lease";
+  req["params"] = lease_params;
+  req["timeout_ms"] = budget_ms;
+  std::string raw;
+  bool ok = send_frame(fd, req.dump(), deadline, nullptr) &&
+            recv_frame(fd, &raw, deadline, nullptr);
+  ::close(fd);
+  if (!ok) return false;
+  try {
+    Json resp = Json::parse(raw);
+    if (!resp.get("ok").as_bool()) return false;
+    *reply = resp.get("result");
+    return true;
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+}  // namespace
+
+void LighthouseServer::election_loop() {
+  std::mt19937_64 rng(std::random_device{}() ^
+                      static_cast<uint64_t>(
+                          reinterpret_cast<uintptr_t>(this)));
+  const int64_t lease = opt_.lease_timeout_ms;  // floor-normalized in ctor
+  const int64_t tick = std::max<int64_t>(lease / 4, 10);
+  // Per-peer lease-RPC budget, sized so a FULL round (renewal or
+  // candidacy) fits well inside one lease window: leases are anchored
+  // at round start, so a round that outlived the window would be
+  // acting on already-expired acknowledgements.
+  const int64_t rpc_budget = std::max<int64_t>(
+      std::min<int64_t>(
+          lease / (2 * std::max<int64_t>(
+                           static_cast<int64_t>(peers_.size()), 1)),
+          1000),
+      20);
+  // Deterministic candidacy stagger: peers campaign in sorted-address
+  // order, one tick apart.  The first candidate's lease request lands on
+  // the later ones well inside their stagger window, turning them into
+  // shielded followers instead of same-term split voters.
+  int64_t stagger_ms = 0;
+  {
+    std::vector<std::string> all = peers_;
+    all.push_back(address());
+    std::sort(all.begin(), all.end());
+    for (size_t i = 0; i < all.size(); ++i)
+      if (all[i] == address()) stagger_ms = static_cast<int64_t>(i) * tick;
+  }
+  auto interruptible_sleep = [this](int64_t ms) {
+    int64_t slept = 0;
+    while (slept < ms && !stopping_.load()) {
+      int64_t slice = std::min<int64_t>(ms - slept, 50);
+      usleep(static_cast<useconds_t>(slice * 1000));
+      slept += slice;
+    }
+  };
+  while (!stopping_.load()) {
+    bool leading;
+    int64_t my_term;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      leading = is_leader_;
+      my_term = term_;
+    }
+    if (leading) {
+      // Renew: one lease RPC per peer; self + grants must stay majority.
+      // The extended lease is anchored at the ROUND START — a grantor's
+      // promise expires one lease after its grant, so an end-anchored
+      // clock would let a leader outlive its grantors by the round
+      // duration and overlap a successor (model-checker finding).
+      int64_t round_start = now_ms();
+      Json lp = Json::object();
+      lp["term"] = my_term;
+      lp["candidate"] = address();
+      int grants = 1;  // self
+      for (const auto& peer : peers_) {
+        if (stopping_.load()) return;
+        Json r;
+        if (lease_rpc(peer, lp, rpc_budget, &r)) {
+          if (r.get("granted").as_bool()) {
+            grants += 1;
+          } else {
+            std::lock_guard<std::mutex> g(mu_);
+            max_seen_term_ =
+                std::max(max_seen_term_, r.get("term").as_int());
+          }
+        }
+        // unreachable peer: counts as a missing grant
+      }
+      std::lock_guard<std::mutex> g(mu_);
+      int64_t now = now_ms();
+      if (is_leader_ && term_ == my_term) {
+        if (now - round_start < lease &&
+            grants * 2 > static_cast<int>(peers_.size()) + 1) {
+          lease_until_ms_ =
+              std::max(lease_until_ms_, round_start + lease);
+          // refresh our own promise too: a live leader's own peer must
+          // shield it from takeover exactly like every other grantor
+          promised_term_ = my_term;
+          promised_to_ = address();
+          promise_expires_ms_ =
+              std::max(promise_expires_ms_, round_start + lease);
+        } else if (now >= lease_until_ms_) {
+          // lost the majority for a full lease window: step down loudly
+          // so blocked quorum waiters fail over instead of timing out
+          is_leader_ = false;
+          quorum_cv_.notify_all();
+        }
+      }
+    } else {
+      bool stale;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        // Free to campaign when the granted promise lapsed (dead leader)
+        // OR we only ever promised ourselves (a failed candidacy — no
+        // leader is shielded by it, so waiting out our own stamp would
+        // just slow the election down).
+        stale = now_ms() >= promise_expires_ms_ ||
+                promised_to_ == address() || promised_to_.empty();
+      }
+      if (stale && stagger_ms > 0) {
+        // Give earlier-sorted candidates a head start: their lease
+        // request usually lands during the stagger and shields us into
+        // a follower (the atomic gate below then skips the campaign).
+        interruptible_sleep(stagger_ms);
+      }
+      if (stale && !stopping_.load()) {
+        // Candidacy: pick a term above anything we promised or saw
+        // refused, self-grant it (same rule as rpc_lease — our own
+        // promise lapsed), then ask the peers.  The whole round must
+        // complete within ONE lease window: each peer's grant is only
+        // valid for a lease from the moment it was given, so a round
+        // bounded by the candidacy start guarantees every counted grant
+        // is still un-expired at election time (the model checker found
+        // the stale-grant two-leader interleaving this rules out).
+        int64_t round_start = now_ms();
+        int64_t cand_term = 0;
+        {
+          // The campaign gate and the self-grant are ONE critical
+          // section, re-evaluated here rather than trusting the earlier
+          // snapshot: a rival's lease grant may have landed on this
+          // peer since (or during the stagger), and overwriting that
+          // fresh promise with a self-grant would un-shield a possibly
+          // winning leader — the check-then-grant race the model's
+          // atomic e_candidate transition cannot exhibit.
+          std::lock_guard<std::mutex> g(mu_);
+          int64_t nw = now_ms();
+          bool free_to_campaign = nw >= promise_expires_ms_ ||
+                                  promised_to_ == address() ||
+                                  promised_to_.empty();
+          if (free_to_campaign) {
+            cand_term =
+                std::max(std::max(promised_term_, max_seen_term_), term_) +
+                1;
+            promised_term_ = cand_term;
+            promised_to_ = address();
+            promise_expires_ms_ = nw + lease;
+          }
+        }
+        if (cand_term == 0) {
+          // shielded meanwhile: back to following
+          interruptible_sleep(tick);
+          continue;
+        }
+        Json lp = Json::object();
+        lp["term"] = cand_term;
+        lp["candidate"] = address();
+        int grants = 1;  // self
+        for (const auto& peer : peers_) {
+          if (stopping_.load()) return;
+          Json r;
+          if (lease_rpc(peer, lp, rpc_budget, &r)) {
+            if (r.get("granted").as_bool()) {
+              grants += 1;
+            } else {
+              std::lock_guard<std::mutex> g(mu_);
+              max_seen_term_ =
+                  std::max(max_seen_term_, r.get("term").as_int());
+            }
+          }
+        }
+        std::lock_guard<std::mutex> g(mu_);
+        if (now_ms() - round_start < lease &&
+            grants * 2 > static_cast<int>(peers_.size()) + 1 &&
+            promised_term_ == cand_term && promised_to_ == address()) {
+          // lease anchored at the round START (see become_leader_locked)
+          become_leader_locked(cand_term, round_start);
+        }
+      }
+    }
+    // Jittered sleep breaks any residual candidate symmetry the stagger
+    // missed, sliced so stop() never waits out a full tick.
+    interruptible_sleep(tick + static_cast<int64_t>(
+                                   rng() % static_cast<uint64_t>(tick + 1)));
+  }
+}
+
+Json LighthouseServer::ha_info() {
+  std::lock_guard<std::mutex> g(mu_);
+  bool leading = !ha_enabled() || is_leader_;
+  Json out = Json::object();
+  out["enabled"] = ha_enabled();
+  out["term"] = term_;
+  out["is_leader"] = leading;
+  out["leader"] =
+      leading ? address()
+              : ((now_ms() < promise_expires_ms_ && promised_to_ != address())
+                     ? promised_to_
+                     : "");
+  out["peers"] = static_cast<int64_t>(peers_.size());
+  out["takeovers_total"] = takeovers_total_;
+  out["quorum_id"] = quorum_id_;
+  return out;
+}
+
 Json LighthouseServer::handle(const std::string& method, const Json& params,
                               int64_t timeout_ms) {
+  // Peer-to-peer lease traffic is served by every peer; everything else
+  // is leader-only in HA mode — a follower answers NOT_LEADER with the
+  // freshest holder hint so clients jump straight to the leader (its
+  // soft state is the only truthful copy).
+  if (method == "lease") return rpc_lease(params);
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    require_leader_locked(method.c_str());
+  }
   if (method == "quorum") return rpc_quorum(params, timeout_ms);
   if (method == "heartbeat") return rpc_heartbeat(params);
   if (method == "serving_heartbeat") return rpc_serving_heartbeat(params);
@@ -462,6 +846,13 @@ Json LighthouseServer::rpc_quorum(const Json& params, int64_t timeout_ms) {
     }
   };
   while (true) {
+    // Leadership lost while this requester was parked: error out NOW so
+    // the client's failover walk re-registers at the new leader instead
+    // of waiting out its full quorum timeout on a deposed peer.
+    if (ha_enabled() && (!is_leader_ || now_ms() >= lease_until_ms_)) {
+      deregister_if_mine();
+      require_leader_locked("quorum");  // throws NotLeaderError
+    }
     // Superseded by a newer incarnation after we entered: abort BEFORE
     // re-registering anything (see eviction block above) — this handler
     // belongs to a replica whose replacement has already joined.  (The
@@ -559,7 +950,7 @@ void LighthouseServer::serving_gc_locked(int64_t now) {
       ++it;
     }
   }
-  if (changed) serving_epoch_ += 1;
+  if (changed) bump_serving_epoch_locked();
 }
 
 int64_t LighthouseServer::serving_latest_version_locked() const {
@@ -598,7 +989,7 @@ Json LighthouseServer::rpc_serving_heartbeat(const Json& params) {
       it == serving_.end() || it->second.address != m.address ||
       it->second.role != m.role || it->second.capacity != m.capacity;
   serving_[m.replica_id] = m;
-  if (shape_changed) serving_epoch_ += 1;
+  if (shape_changed) bump_serving_epoch_locked();
   Json out = Json::object();
   out["plan_epoch"] = serving_epoch_;
   out["latest_version"] = serving_latest_version_locked();
@@ -1013,6 +1404,28 @@ std::string LighthouseServer::render_metrics() {
           "heartbeat\n"
        << "# TYPE torchft_lighthouse_heartbeats_live gauge\n"
        << "torchft_lighthouse_heartbeats_live " << fresh << "\n";
+    // Coordination-plane HA: leadership term, role and takeover count.
+    // Exported in single-process mode too (term 0, leader 1) so alerting
+    // rules need no mode switch.
+    os << "# HELP torchft_lighthouse_term Leadership term this peer "
+          "leads/last led under (prefixes quorum_id and the serving "
+          "epoch as (term << 32) | seq)\n"
+       << "# TYPE torchft_lighthouse_term gauge\n"
+       << "torchft_lighthouse_term " << term_ << "\n"
+       << "# HELP torchft_lighthouse_is_leader 1 when this peer serves "
+          "leader-only RPCs (single-process mode: always 1)\n"
+       << "# TYPE torchft_lighthouse_is_leader gauge\n"
+       << "torchft_lighthouse_is_leader "
+       << ((!ha_enabled() || is_leader_) ? 1 : 0) << "\n"
+       << "# HELP torchft_lighthouse_takeovers_total Leadership takeovers "
+          "won by this peer since start\n"
+       << "# TYPE torchft_lighthouse_takeovers_total counter\n"
+       << "torchft_lighthouse_takeovers_total " << takeovers_total_ << "\n"
+       << "# HELP torchft_lighthouse_lease_requests_total Lease RPCs "
+          "received from peer electors\n"
+       << "# TYPE torchft_lighthouse_lease_requests_total counter\n"
+       << "torchft_lighthouse_lease_requests_total " << lease_requests_total_
+       << "\n";
     // Tick-cost telemetry: the incremental-quorum claim, measured.
     os << "# HELP torchft_lighthouse_tick_seconds Quorum tick wall time "
           "(includes the O(1) dirty-set skip path)\n"
@@ -1278,6 +1691,24 @@ Json LighthouseServer::status_json(int64_t page, int64_t per_page,
     out["serving"] = serving;
   }
 
+  // Coordination-plane HA block: served from EVERY peer over HTTP (the
+  // status RPC is leader-only, but each peer's /status.json names the
+  // leader it believes in — the fleet helper and tests read this).
+  {
+    bool leading = !ha_enabled() || is_leader_;
+    Json ha = Json::object();
+    ha["enabled"] = ha_enabled();
+    ha["term"] = term_;
+    ha["is_leader"] = leading;
+    ha["leader"] =
+        leading ? address()
+                : ((now < promise_expires_ms_ && promised_to_ != address())
+                       ? promised_to_
+                       : "");
+    ha["takeovers_total"] = takeovers_total_;
+    out["ha"] = ha;
+  }
+
   Json summary = Json::object();
   summary["replicas_tracked"] = static_cast<int64_t>(hb_total);
   summary["participants_waiting"] =
@@ -1328,8 +1759,13 @@ std::string LighthouseServer::render_status_html(int64_t page) {
         "collapse}td,th{border:1px solid #888;padding:4px 8px}"
         "tr.recovering{background:#fff3cd}li.old{color:#b00}</style>"
      << "</head><body><h1>torchft_tpu lighthouse</h1>"
-     << "<p>quorum_id: " << quorum_id_ << "</p>"
-     << "<p>next quorum status: " << live_reason << "</p>";
+     << "<p>quorum_id: " << quorum_id_ << "</p>";
+  if (ha_enabled()) {
+    os << "<p>HA: " << (is_leader_ ? "LEADER" : "follower") << " &middot; "
+       << "term " << term_ << " &middot; " << peers_.size()
+       << " peer(s) &middot; takeovers " << takeovers_total_ << "</p>";
+  }
+  os << "<p>next quorum status: " << live_reason << "</p>";
   size_t max_rows = std::max(
       heartbeats_.size(),
       prev_quorum_.has_value() ? prev_quorum_->participants.size() : 0);

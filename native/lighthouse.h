@@ -68,6 +68,15 @@ struct LighthouseOpt {
   // Weight-serving tier: children per interior node of the synthesized
   // fan-out distribution tree (serving_plan RPC).
   int64_t serving_fanout = 2;
+  // Coordination-plane HA (docs/architecture.md "Coordination-plane
+  // HA"): comma list of the OTHER lighthouse peers' RPC addresses.
+  // Empty = single-process mode — no election thread, always leader,
+  // term 0, wire-identical to the pre-HA server.
+  std::string peers;
+  // Leadership lease duration: the leader renews every lease/4; a
+  // follower whose granted promise lapses for a full lease window
+  // becomes a candidate (takeover-on-expiry).
+  int64_t lease_timeout_ms = 1000;
   // Fleet-scale status plane (see docs/observability.md):
   // default page size for /status.json row arrays (and the dashboard
   // tables) — the default document stays small at any fleet size.
@@ -100,6 +109,10 @@ class LighthouseServer : public RpcServer {
   // fine at scrape rates.
   using MetricsProvider = int (*)(char* buf, int cap);
   void set_metrics_provider(MetricsProvider provider);
+
+  // Coordination-plane HA introspection (tests, the fleet helper, the
+  // C API): {"enabled", "term", "is_leader", "leader", "peers"}.
+  Json ha_info();
 
  protected:
   Json handle(const std::string& method, const Json& params,
@@ -145,6 +158,7 @@ class LighthouseServer : public RpcServer {
   Json rpc_heartbeat(const Json& params);
   Json rpc_serving_heartbeat(const Json& params);
   Json rpc_serving_plan(const Json& params);
+  Json rpc_lease(const Json& params);
   void note_summary_locked(const std::string& rid, const Json& summary,
                            int64_t now);
   std::string render_status_html(int64_t page);
@@ -247,6 +261,41 @@ class LighthouseServer : public RpcServer {
   // The rolling cluster step-timeline (/timeline.json and the
   // "timeline" RPC); locks mu_ internally.
   Json timeline_json();
+
+  // ---- coordination-plane HA (leased leadership) -------------------------
+  // Lighthouse state is SOFT (heartbeats, registrations and serving
+  // membership rebuild through client re-registration), so failover needs
+  // no log replication — only monotonicity: the leader's term (monotone
+  // across takeovers, enforced by majority lease acknowledgement) prefixes
+  // every id the lighthouse mints, `(term << 32) | seq`, so quorum_id and
+  // the serving plan epoch stay strictly monotone across a leader change
+  // with zero state transfer.  In single-process mode term stays 0 and the
+  // ids are bit-identical to the pre-HA server.
+  bool ha_enabled() const { return !peers_.empty(); }
+  // Throws NotLeaderError naming the current holder when this peer is not
+  // the leader (caller holds mu_); no-op in single-process mode.
+  void require_leader_locked(const char* method);
+  void become_leader_locked(int64_t term, int64_t now);
+  void bump_serving_epoch_locked();
+  void election_loop();
+  static int64_t ha_epoch_id(int64_t term, int64_t seq) {
+    return (term << 32) | (seq & 0xffffffffLL);
+  }
+
+  std::vector<std::string> peers_;  // the OTHER peers (empty = single mode)
+  int64_t term_ = 0;                // term this peer currently leads under
+  bool is_leader_ = true;           // single-process mode: always leader
+  int64_t lease_until_ms_ = 0;      // self-lease validity while leading
+  int64_t promised_term_ = 0;       // highest term this peer lease-granted
+  std::string promised_to_;         // candidate holding that promise
+  int64_t promise_expires_ms_ = 0;  // grant freshness (renewals refresh it)
+  int64_t max_seen_term_ = 0;       // refusal replies teach us the ceiling
+  int64_t takeovers_total_ = 0;
+  int64_t lease_requests_total_ = 0;
+  // Low 32 bits of the term-prefixed ids; reset to 0 at takeover.
+  int64_t quorum_seq_in_term_ = 0;
+  int64_t serving_seq_in_term_ = 0;
+  std::thread election_thread_;
 
   LighthouseOpt opt_;
 

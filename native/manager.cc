@@ -177,7 +177,10 @@ void ManagerServer::report_summary(const Json& summary) {
 }
 
 void ManagerServer::heartbeat_loop() {
-  RpcClient client(opt_.lighthouse_addr);
+  // Multi-endpoint failover client: with TORCHFT_LIGHTHOUSE as a comma
+  // list this walks dead peers and follows NOT_LEADER redirects to the
+  // current lease holder; a single endpoint behaves like RpcClient.
+  HaRpcClient client(opt_.lighthouse_addr);
   while (!stopping_.load()) {
     Json params = Json::object();
     params["replica_id"] = opt_.replica_id;
@@ -333,7 +336,9 @@ void ManagerServer::run_quorum(QuorumMember member, int64_t timeout_ms) {
     try {
       // Fresh client per attempt: the lighthouse may have restarted
       // (reference resets its channel on retry, src/manager.rs:303-306).
-      RpcClient client(opt_.lighthouse_addr);
+      // The HA walk inside one attempt already covers endpoint death and
+      // leadership movement mid-call.
+      HaRpcClient client(opt_.lighthouse_addr);
       Json result = client.call("quorum", params, timeout_ms);
       quorum = Quorum::from_json(result.get("quorum"));
       error.clear();

@@ -350,6 +350,22 @@ bool call_rpc(const std::string& addr, const std::string& method,
   return true;
 }
 
+std::vector<std::string> split_endpoints(const std::string& addrs) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= addrs.size()) {
+    size_t comma = addrs.find(',', start);
+    std::string part = addrs.substr(
+        start, comma == std::string::npos ? std::string::npos : comma - start);
+    size_t a = part.find_first_not_of(" \t");
+    size_t b = part.find_last_not_of(" \t");
+    if (a != std::string::npos) out.push_back(part.substr(a, b - a + 1));
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return out;
+}
+
 RpcClient::~RpcClient() { close(); }
 
 void RpcClient::close() {
@@ -382,8 +398,10 @@ Json RpcClient::call(const std::string& method, const Json& params,
       Json resp = Json::parse(reply);
       if (!resp.get("ok").as_bool()) {
         std::string msg = resp.get("error").as_string();
-        if (resp.get("code").as_string() == "timeout")
-          throw TimeoutError(msg);
+        std::string code = resp.get("code").as_string();
+        if (code == "timeout") throw TimeoutError(msg);
+        if (code == "not_leader")
+          throw NotLeaderError(msg, resp.get("leader").as_string());
         throw std::runtime_error(msg);
       }
       return resp.get("result");
@@ -394,6 +412,126 @@ Json RpcClient::call(const std::string& method, const Json& params,
     if (err.rfind("timeout:", 0) == 0) throw TimeoutError(err);
   }
   throw std::runtime_error("rpc " + method + " to " + addr_ + " failed: " + err);
+}
+
+HaRpcClient::HaRpcClient(const std::string& addrs)
+    : endpoints_(split_endpoints(addrs)) {
+  if (endpoints_.empty()) endpoints_.push_back(addrs);
+}
+
+HaRpcClient::~HaRpcClient() { close(); }
+
+void HaRpcClient::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+  connected_addr_.clear();
+}
+
+std::string HaRpcClient::current() const {
+  if (!connected_addr_.empty()) return connected_addr_;
+  if (!redirect_.empty()) return redirect_;
+  return endpoints_[cur_ % endpoints_.size()];
+}
+
+void HaRpcClient::advance() {
+  redirect_.clear();
+  cur_ = (cur_ + 1) % endpoints_.size();
+}
+
+Json HaRpcClient::call(const std::string& method, const Json& params,
+                       int64_t timeout_ms) {
+  int64_t deadline = now_ms() + timeout_ms;
+  std::string err, last_err;
+  // Hop budget PER PASS: every endpoint may be probed directly and once
+  // more via a redirect before the pass ends — bounds a redirect cycle
+  // between two confused followers.  A pass that found no servable
+  // leader (fleet mid-election / restarting) is retried with a short
+  // growing backoff inside the caller's deadline, mirroring the Python
+  // client's _WALK_POLICY — the budget, never the pass count, bounds
+  // the wait.
+  const int max_hops = static_cast<int>(endpoints_.size()) * 2 + 2;
+  int64_t backoff_ms = 50;
+  while (true) {
+    for (int hop = 0; hop < max_hops; ++hop) {
+      int64_t remain = deadline - now_ms();
+      if (remain <= 0)
+        throw TimeoutError("timeout: rpc " + method +
+                           " exhausted its deadline walking lighthouse "
+                           "endpoints: " + last_err);
+      std::string addr = !redirect_.empty() ? redirect_ : endpoints_[cur_];
+      if (fd_ < 0 || connected_addr_ != addr) {
+        close();
+        // Bounded connect slice: with peers to fail over to, a dead
+        // endpoint must cost ~a slice, not the caller's deadline.  The
+        // single-endpoint form keeps RpcClient's full-budget retry.
+        int64_t slice = endpoints_.size() > 1
+                            ? std::min<int64_t>(remain, 1500)
+                            : remain;
+        fd_ = endpoints_.size() > 1 ? connect_once(addr, slice, &err)
+                                    : connect_with_retry(addr, slice, &err);
+        if (fd_ < 0) {
+          last_err = addr + ": " + err;
+          advance();
+          continue;
+        }
+        connected_addr_ = addr;
+      }
+      Json req = Json::object();
+      req["method"] = method;
+      req["params"] = params;
+      req["timeout_ms"] = std::max<int64_t>(deadline - now_ms(), 1);
+      if (current_trace().valid())
+        req["traceparent"] = format_traceparent(current_trace());
+      std::string reply;
+      if (!send_frame(fd_, req.dump(), deadline, &err) ||
+          !recv_frame(fd_, &reply, deadline, &err)) {
+        close();
+        last_err = addr + ": " + err;
+        // The overall deadline expiring mid-call on a live endpoint is
+        // the caller's timeout, not a dead server: surface it.
+        if (err.rfind("timeout:", 0) == 0 && deadline - now_ms() <= 0)
+          throw TimeoutError(err);
+        advance();
+        continue;
+      }
+      Json resp;
+      try {
+        resp = Json::parse(reply);
+      } catch (const std::exception& e) {
+        close();
+        last_err = addr + std::string(": bad reply: ") + e.what();
+        advance();
+        continue;
+      }
+      if (!resp.get("ok").as_bool()) {
+        std::string msg = resp.get("error").as_string();
+        std::string code = resp.get("code").as_string();
+        if (code == "not_leader") {
+          // Follow the named holder when there is one; otherwise rotate.
+          std::string leader = resp.get("leader").as_string();
+          last_err = addr + ": " + msg;
+          if (!leader.empty() && leader != addr) {
+            redirect_ = leader;
+          } else {
+            advance();
+          }
+          continue;
+        }
+        if (code == "timeout") throw TimeoutError(msg);
+        throw std::runtime_error(msg);
+      }
+      return resp.get("result");
+    }
+    int64_t remain = deadline - now_ms();
+    if (remain <= backoff_ms)
+      throw TimeoutError("timeout: rpc " + method +
+                         " found no servable lighthouse leader within "
+                         "its deadline: " + last_err);
+    usleep(static_cast<useconds_t>(backoff_ms * 1000));
+    backoff_ms = std::min<int64_t>(backoff_ms * 2, 500);
+  }
 }
 
 RpcServer::RpcServer(std::string bind_host, int port)
@@ -571,6 +709,14 @@ void RpcServer::serve_conn(int fd) {
       reply["ok"] = false;
       reply["error"] = std::string(e.what());
       reply["code"] = "timeout";
+    } catch (const NotLeaderError& e) {
+      // Coordination-plane HA: leader-only method on a follower.  The
+      // structured code + leader hint is what lets failover clients jump
+      // straight to the holder instead of guessing.
+      reply["ok"] = false;
+      reply["error"] = std::string(e.what());
+      reply["code"] = "not_leader";
+      reply["leader"] = e.leader();
     } catch (const std::exception& e) {
       reply["ok"] = false;
       reply["error"] = std::string(e.what());

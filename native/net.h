@@ -161,6 +161,24 @@ bool write_all(int fd, const char* buf, size_t n, int64_t deadline_ms,
 
 // ---- client --------------------------------------------------------------
 
+// Coordination-plane HA: a follower lighthouse answers leader-only
+// methods with {"ok":false,"code":"not_leader","leader":"host:port"} —
+// the reply's leader hint ("" when no leader is known) rides this
+// exception so failover clients can jump straight to the holder instead
+// of walking the whole endpoint list.
+class NotLeaderError : public std::runtime_error {
+ public:
+  NotLeaderError(const std::string& what, std::string leader)
+      : std::runtime_error(what), leader_(std::move(leader)) {}
+  const std::string& leader() const { return leader_; }
+
+ private:
+  std::string leader_;
+};
+
+// Split "host1:p1,host2:p2,..." into trimmed endpoint addresses.
+std::vector<std::string> split_endpoints(const std::string& addrs);
+
 // Connect to "host:port" with exponential backoff until deadline. Returns fd
 // or -1 (err filled).
 int connect_with_retry(const std::string& addr, int64_t timeout_ms,
@@ -190,6 +208,35 @@ class RpcClient {
 
  private:
   std::string addr_;
+  int fd_ = -1;
+};
+
+// Multi-endpoint failover RPC client (coordination-plane HA): walks a
+// static endpoint list, follows NOT_LEADER redirects to the named
+// holder, and pins a persistent connection to the endpoint that last
+// answered.  A dead endpoint costs one bounded connect slice, never the
+// caller's whole deadline; a live endpoint gets the full remaining
+// budget (quorum is a long-poll).  With a single endpoint the behavior
+// is wire-identical to RpcClient.
+class HaRpcClient {
+ public:
+  explicit HaRpcClient(const std::string& addrs);
+  ~HaRpcClient();
+  HaRpcClient(const HaRpcClient&) = delete;
+  HaRpcClient& operator=(const HaRpcClient&) = delete;
+
+  Json call(const std::string& method, const Json& params, int64_t timeout_ms);
+  void close();
+  // The endpoint the client is currently pinned to (last success/redirect).
+  std::string current() const;
+
+ private:
+  void advance();  // drop any redirect hint and rotate to the next endpoint
+
+  std::vector<std::string> endpoints_;
+  size_t cur_ = 0;
+  std::string redirect_;  // leader hint from a NOT_LEADER reply
+  std::string connected_addr_;
   int fd_ = -1;
 };
 

@@ -16,10 +16,16 @@
 // TSAN_OPTIONS=exitcode / halt_on_error set by the test harness
 // (tests/test_native_sanitize.py).
 
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <memory>
 #include <string>
 #include <thread>
 #include <vector>
@@ -184,6 +190,145 @@ int serving_round(const std::string& lighthouse_addr) {
   return 0;
 }
 
+// Coordination-plane HA election round: three lighthouse peers with
+// leased leadership in ONE process — election threads, lease RPC
+// handlers and the HaRpcClient failover walk all race under TSan.
+// Drives: cold-start election, a quorum through the multi-endpoint
+// client, leader kill, takeover at a higher term, and a post-takeover
+// quorum whose term-prefixed id strictly dominates the first.
+int pick_free_port() {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = 0;
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&sa), sizeof(sa)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(sa);
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&sa), &len);
+  int port = ntohs(sa.sin_port);
+  ::close(fd);
+  return port;
+}
+
+int election_round() {
+  constexpr int kPeers = 3;
+  constexpr int64_t kLeaseMs = 200;
+  std::vector<int> ports;
+  for (int i = 0; i < kPeers; ++i) {
+    int p = pick_free_port();
+    if (p < 0) {
+      fprintf(stderr, "smoke: pick_free_port failed\n");
+      return 1;
+    }
+    ports.push_back(p);
+  }
+  std::vector<std::string> endpoints;
+  endpoints.reserve(kPeers);
+  for (int p : ports)
+    endpoints.push_back("127.0.0.1:" + std::to_string(p));
+  std::vector<std::unique_ptr<tft::LighthouseServer>> peers;
+  for (int i = 0; i < kPeers; ++i) {
+    tft::LighthouseOpt opt;
+    opt.bind_host = "127.0.0.1";
+    opt.port = ports[i];
+    opt.min_replicas = 1;
+    opt.join_timeout_ms = 100;
+    opt.quorum_tick_ms = 20;
+    opt.heartbeat_timeout_ms = 5000;
+    opt.lease_timeout_ms = kLeaseMs;
+    std::string others;
+    for (int j = 0; j < kPeers; ++j) {
+      if (j == i) continue;
+      if (!others.empty()) others += ",";
+      others += endpoints[j];
+    }
+    opt.peers = others;
+    peers.push_back(std::make_unique<tft::LighthouseServer>(opt));
+    peers.back()->start_serving();
+  }
+  auto leader_of = [&](int64_t* term) -> int {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(30);
+    while (std::chrono::steady_clock::now() < deadline) {
+      for (int i = 0; i < kPeers; ++i) {
+        if (!peers[i]) continue;
+        tft::Json info = peers[i]->ha_info();
+        if (info.get("is_leader").as_bool()) {
+          if (term) *term = info.get("term").as_int();
+          return i;
+        }
+      }
+      usleep(10 * 1000);
+    }
+    return -1;
+  };
+  std::string all = endpoints[0] + "," + endpoints[1] + "," + endpoints[2];
+  int64_t term1 = 0, term2 = 0;
+  int failures = 0;
+  int leader = leader_of(&term1);
+  if (leader < 0) {
+    fprintf(stderr, "smoke: no leader elected\n");
+    failures = 1;
+  }
+  int64_t qid1 = 0;
+  if (!failures) {
+    tft::HaRpcClient cli(all);
+    try {
+      tft::Json member = tft::Json::object();
+      member["replica_id"] = std::string("ha_smoke:1");
+      member["step"] = static_cast<int64_t>(0);
+      tft::Json params = tft::Json::object();
+      params["member"] = member;
+      tft::Json r = cli.call("quorum", params, kRpcTimeoutMs);
+      qid1 = r.get("quorum").get("quorum_id").as_int();
+    } catch (const std::exception& e) {
+      fprintf(stderr, "smoke: HA quorum 1 failed: %s\n", e.what());
+      failures = 1;
+    }
+  }
+  if (!failures) {
+    peers[leader]->stop();
+    peers[leader].reset();  // SIGKILL stand-in: the endpoint goes dead
+    int next = leader_of(&term2);
+    if (next < 0 || next == leader || term2 <= term1) {
+      fprintf(stderr, "smoke: takeover failed (next=%d terms %lld->%lld)\n",
+              next, static_cast<long long>(term1),
+              static_cast<long long>(term2));
+      failures = 1;
+    }
+  }
+  if (!failures) {
+    tft::HaRpcClient cli(all);
+    try {
+      tft::Json member = tft::Json::object();
+      member["replica_id"] = std::string("ha_smoke:2");
+      member["step"] = static_cast<int64_t>(1);
+      tft::Json params = tft::Json::object();
+      params["member"] = member;
+      tft::Json r = cli.call("quorum", params, kRpcTimeoutMs);
+      int64_t qid2 = r.get("quorum").get("quorum_id").as_int();
+      if (qid2 <= qid1 || (qid2 >> 32) <= (qid1 >> 32)) {
+        fprintf(stderr,
+                "smoke: quorum_id not term-monotone across takeover "
+                "(%lld -> %lld)\n",
+                static_cast<long long>(qid1), static_cast<long long>(qid2));
+        failures = 1;
+      }
+    } catch (const std::exception& e) {
+      fprintf(stderr, "smoke: HA quorum 2 failed: %s\n", e.what());
+      failures = 1;
+    }
+  }
+  for (auto& p : peers) {
+    if (p) p->stop();
+  }
+  return failures;
+}
+
 int drive_round(const std::string& manager_addr, int round) {
   tft::Json params = tft::Json::object();
   params["group_rank"] = static_cast<int64_t>(0);
@@ -231,6 +376,12 @@ int main() {
     return 1;
   }
   printf("CODEC OK\n");
+
+  if (election_round()) {
+    printf("SMOKE FAIL\n");
+    return 1;
+  }
+  printf("ELECTION OK\n");
 
   tft::LighthouseOpt lopt;
   lopt.bind_host = "127.0.0.1";

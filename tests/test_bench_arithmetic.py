@@ -106,6 +106,13 @@ class TestCompactTailSummary:
                          "at_version": 55},
                 "bitwise_identical_after_failover": True,
             },
+            "ha": {
+                "peers": 3, "lease_ms": 500, "trials": 3,
+                "kill_to_quorum_p50_s": 0.81, "kill_to_quorum_max_s": 1.4,
+                "kill_to_quorum_s": [0.7, 0.81, 1.4],
+                "quorum_id_monotone": True, "term_advanced": True,
+                "takeover_terms": [2, 2, 2],
+            },
         }
 
     def test_summary_under_budget_with_primary_metric(self):
@@ -130,6 +137,11 @@ class TestCompactTailSummary:
         assert parsed["serving"]["fetch_p99_ms"] == 58.0
         assert parsed["serving"]["bitwise_identical_after_failover"] is True
         assert parsed["serving"]["failed_fetches"] == 0
+        # the HA failover headline survives the budget (ISSUE 13):
+        # leader-kill -> next-quorum latency + the monotonicity verdicts
+        assert parsed["ha"]["kill_to_quorum_p50_s"] == 0.81
+        assert parsed["ha"]["quorum_id_monotone"] is True
+        assert parsed["ha"]["term_advanced"] is True
 
     def test_tail_of_captured_emission_parses_to_summary(self):
         """Simulate the driver: capture full-result line + compact line,

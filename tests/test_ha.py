@@ -1,0 +1,273 @@
+"""Coordination-plane HA: endpoint parsing, the client failover walk,
+leased leadership, and the lease RPC surface.
+
+The chaos leg (SIGKILL the leader subprocess mid-quorum / mid-serving-
+fetch) lives in tests/test_ha_integ.py; this file covers the fast units:
+comma-list parsing, dead-first-endpoint walks, redirect following,
+retry-budget accounting, lease grant semantics, and the
+``lighthouse.lease`` fault site.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    NotLeaderError,
+    _RpcClient,
+    parse_endpoints,
+)
+from torchft_tpu.ha import LighthouseFleet, exclude_self, pick_free_ports
+from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.faults import FAULTS, FaultRule, InjectedFault
+
+LEASE_MS = 300
+
+
+@pytest.fixture
+def fleet():
+    f = LighthouseFleet(n=3, min_replicas=1, lease_timeout_ms=LEASE_MS)
+    try:
+        f.wait_for_leader(10)
+        yield f
+    finally:
+        f.shutdown()
+
+
+class TestEndpointParsing:
+    def test_single_address(self):
+        assert parse_endpoints("host:1234") == ["host:1234"]
+
+    def test_comma_list(self):
+        assert parse_endpoints("a:1,b:2,c:3") == ["a:1", "b:2", "c:3"]
+
+    def test_whitespace_and_empties_tolerated(self):
+        assert parse_endpoints(" a:1 , ,b:2,  ") == ["a:1", "b:2"]
+
+    def test_exclude_self_by_port(self):
+        full = ["hostA:29510", "hostB:29511", "hostC:29512"]
+        assert exclude_self(full, 29511) == ["hostA:29510", "hostC:29512"]
+
+    def test_exclude_self_same_port_everywhere_picks_local_host(self):
+        # the standard multi-host deployment: every peer on one port —
+        # only the LOCAL host's entry is "me" (port alone is ambiguous
+        # and must never guess: a wrong exclusion leaves this peer
+        # lease-voting for itself twice)
+        full = ["hostA:29510", "hostB:29510", "hostC:29510"]
+        assert exclude_self(full, 29510, local_hosts={"hostB"}) == [
+            "hostA:29510", "hostC:29510",
+        ]
+
+    def test_exclude_self_same_port_real_hostname(self):
+        import socket
+
+        me = socket.gethostname()
+        full = [f"hostA:29510", f"{me}:29510", "hostC:29512"]
+        assert exclude_self(full, 29510) == ["hostA:29510", "hostC:29512"]
+
+    def test_exclude_self_ambiguous_same_port_raises(self):
+        with pytest.raises(ValueError, match="ambiguous|match by port"):
+            exclude_self(
+                ["a:29510", "b:29510"], 29510, local_hosts={"nothing"}
+            )
+
+    def test_exclude_self_absent_list_unchanged(self):
+        full = ["a:1", "b:2"]
+        assert exclude_self(full, 9999) == full
+
+    def test_exclude_self_ephemeral_port_never_matches(self):
+        full = ["a:1", "b:2"]
+        assert exclude_self(full, 0) == full
+
+
+class TestFailoverWalk:
+    def test_dead_first_endpoint_is_walked(self):
+        # a refused port first, the live single-process lighthouse second
+        (dead_port,) = pick_free_ports(1)
+        with LighthouseServer(bind=":0", min_replicas=1) as server:
+            before = _metrics.HA_FAILOVERS.get()
+            cli = LighthouseClient(
+                f"127.0.0.1:{dead_port},{server.address()}",
+                connect_timeout=5.0,
+            )
+            try:
+                t0 = time.monotonic()
+                status = cli.status(timeout=10.0)
+                walk_s = time.monotonic() - t0
+                assert "quorum_id" in status
+                # the dead endpoint cost a bounded connect slice, not the
+                # caller's deadline
+                assert walk_s < 5.0
+                assert _metrics.HA_FAILOVERS.get() > before
+            finally:
+                cli.close()
+
+    def test_redirect_follow_from_follower(self, fleet):
+        leader = fleet.wait_for_leader(10)
+        followers = [i for i in fleet.alive() if i != leader]
+        assert followers
+        before = _metrics.HA_REDIRECTS.get()
+        # list ONLY follower endpoints: the walk must reach the leader
+        # purely by following the NOT_LEADER redirect hint
+        cli = LighthouseClient(
+            ",".join(fleet.endpoints()[i] for i in followers),
+            connect_timeout=5.0,
+        )
+        try:
+            status = cli.status(timeout=10.0)
+            assert "quorum_id" in status
+            assert _metrics.HA_REDIRECTS.get() > before
+        finally:
+            cli.close()
+
+    def test_follower_replies_not_leader_with_hint(self, fleet):
+        leader = fleet.wait_for_leader(10)
+        follower = next(i for i in fleet.alive() if i != leader)
+        raw = _RpcClient(fleet.endpoints()[follower], 5.0)
+        try:
+            with pytest.raises(NotLeaderError) as exc:
+                raw.call("status", {}, 5.0)
+            assert exc.value.leader == fleet.endpoints()[leader]
+        finally:
+            raw.close()
+
+    def test_retry_budget_never_exceeded_all_dead(self):
+        dead = pick_free_ports(3)
+        cli = LighthouseClient(
+            ",".join(f"127.0.0.1:{p}" for p in dead), connect_timeout=5.0
+        )
+        try:
+            t0 = time.monotonic()
+            with pytest.raises((TimeoutError, ConnectionError)):
+                cli.status(timeout=1.0)
+            elapsed = time.monotonic() - t0
+            # the 1 s call budget bounds the whole walk (+ scheduling
+            # slack), regardless of endpoint count or retry passes
+            assert elapsed < 2.5
+        finally:
+            cli.close()
+
+    def test_single_endpoint_error_shape_unchanged(self):
+        # pre-HA behavior: one dead endpoint surfaces the plain
+        # connection/timeout error, no walk wrapping
+        (dead_port,) = pick_free_ports(1)
+        cli = LighthouseClient(f"127.0.0.1:{dead_port}", connect_timeout=0.5)
+        try:
+            with pytest.raises((TimeoutError, ConnectionError)):
+                cli.status(timeout=1.0)
+        finally:
+            cli.close()
+
+
+class TestLeasedLeadership:
+    def test_exactly_one_leader(self, fleet):
+        leaders = [
+            i for i in fleet.alive() if fleet.ha_info(i)["is_leader"]
+        ]
+        assert len(leaders) == 1
+
+    def test_takeover_on_leader_kill_bumps_term(self, fleet):
+        term0 = fleet.term()
+        killed = fleet.kill_leader()
+        new_leader = fleet.wait_for_leader(15)
+        assert new_leader != killed
+        assert fleet.term() > term0
+
+    def test_quorum_id_monotone_across_takeover(self, fleet):
+        cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+        try:
+            q1 = cli.quorum("ha_mono:1", timeout=10.0)
+            fleet.kill_leader()
+            q2 = cli.quorum("ha_mono:2", timeout=15.0)
+            assert q2.quorum_id > q1.quorum_id
+            # term-prefixed: the new id carries a strictly higher term word
+            assert (q2.quorum_id >> 32) > (q1.quorum_id >> 32)
+        finally:
+            cli.close()
+
+    def test_serving_epoch_monotone_across_takeover(self, fleet):
+        cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+        try:
+            cli.serving_heartbeat("srv_a", "http://a:1", role="server")
+            e1 = int(cli.serving_plan()["epoch"])
+            fleet.kill_leader()
+            # re-registration on the new leader re-forms the tree under a
+            # higher-term epoch
+            reply = cli.serving_heartbeat(
+                "srv_a", "http://a:1", role="server", timeout=15.0
+            )
+            assert int(reply["plan_epoch"]) > e1
+        finally:
+            cli.close()
+
+    def test_single_process_mode_ha_info(self):
+        with LighthouseServer(bind=":0", min_replicas=1) as server:
+            info = server.ha_info()
+            assert info["enabled"] is False
+            assert info["is_leader"] is True
+            assert info["term"] == 0
+
+    def test_status_carries_ha_block(self, fleet):
+        cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+        try:
+            status = cli.status(timeout=10.0)
+            assert status["ha"]["enabled"] is True
+            assert status["ha"]["is_leader"] is True  # redirected to leader
+            assert status["ha"]["term"] >= 1
+        finally:
+            cli.close()
+
+
+class TestLeaseRpc:
+    def test_grant_refuse_renew_semantics(self, fleet):
+        leader = fleet.wait_for_leader(10)
+        follower = next(i for i in fleet.alive() if i != leader)
+        peer = LighthouseClient(fleet.endpoints()[follower])
+        try:
+            term = fleet.term() + 100  # far above anything promised
+            # the follower's promise from the live leader is fresh: a new
+            # candidate is shielded out even with a higher term
+            shielded = peer.lease(term, "cand_a:1")
+            assert shielded["granted"] is False
+            # After the promise lapses the grant path opens.  Kill BOTH
+            # other peers: the survivor alone has no majority, so no new
+            # leader can re-shield it while we probe the lease rules.
+            for i in list(fleet.alive()):
+                if i != follower:
+                    fleet.kill(i)
+            time.sleep(LEASE_MS / 1000 * 1.5)
+            first = peer.lease(term + 100, "cand_a:1")
+            # the peer may have already promised its own (or the third
+            # peer's) candidacy a term; walk above it
+            t = max(int(first["term"]), term + 100) + 1
+            granted = peer.lease(t, "cand_a:1")
+            assert granted["granted"] is True
+            assert granted["holder"] == "cand_a:1"
+            # same term, different candidate: refused (at most one leader
+            # per term)
+            rival = peer.lease(t, "cand_b:1")
+            assert rival["granted"] is False
+            assert rival["holder"] == "cand_a:1"
+            # renewal by the holder: granted
+            renewed = peer.lease(t, "cand_a:1")
+            assert renewed["granted"] is True
+        finally:
+            peer.close()
+
+    def test_lease_fault_site(self, fleet):
+        FAULTS.configure(
+            [FaultRule(site="lighthouse.lease", times=1)], seed=0
+        )
+        try:
+            cli = LighthouseClient(fleet.addresses())
+            try:
+                with pytest.raises(InjectedFault):
+                    cli.lease(1, "cand:1")
+            finally:
+                cli.close()
+        finally:
+            FAULTS.configure([])

@@ -101,6 +101,7 @@ class TestMutationGate:
                 scenario == "votes"
                 or scenario in mc.SCENARIOS
                 or scenario in mc.RESIZE_SCENARIOS
+                or scenario in mc.ELECTION_SCENARIOS
             )
 
     def test_every_invariant_is_exercised_by_a_mutation(self):
@@ -182,6 +183,65 @@ class TestResizeSubModel:
         assert len(errs) == 1
         # the violating phase renders in the Manager's vocabulary
         assert errs[0]["op"] == "layout_commit"
+
+
+class TestElectionSubModel:
+    """ISSUE 13: the coordination-plane HA (leased leader election)
+    scenario — at-most-one-leader-per-term, term monotonicity and
+    quorum-id monotonicity across failover proven over candidacies,
+    lease grants/expiry and a leader crash, with the two seeded
+    election bugs provably caught by their named invariants."""
+
+    def test_clean_election_space_reaches_quorums(self):
+        r = mc.explore_election(mc.ELECTION_SCENARIOS["election"])
+        assert r.ok, f"election scenario violated: {r.violation}"
+        # non-vacuous: the bounded space contains post-takeover quorums
+        assert r.goal_states > 0
+
+    def test_exploration_is_deterministic(self):
+        a = mc.explore_election(mc.ELECTION_SCENARIOS["election"])
+        b = mc.explore_election(mc.ELECTION_SCENARIOS["election"])
+        assert (a.states, a.transitions, a.goal_states) == (
+            b.states, b.transitions, b.goal_states
+        )
+
+    def test_space_contains_takeovers(self):
+        """The clean space must actually exercise failover: some path
+        establishes two leaderships (else quorum-id-monotone-across-
+        failover would be vacuously true)."""
+        cfg = mc.ELECTION_SCENARIOS["election"]
+        # a crash is enabled somewhere and the expire budget allows the
+        # survivors' promises to lapse afterwards
+        assert cfg.crash_budget >= 1
+        assert cfg.expire_budget >= cfg.n_peers - 1
+
+    def test_two_leaders_same_term_is_caught(self):
+        r = mc.explore_election(
+            mc.ELECTION_SCENARIOS["election"],
+            mutations=frozenset({"two_leaders_same_term"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "at-most-one-leader-per-term"
+
+    def test_reuse_quorum_seq_after_takeover_is_caught(self):
+        r = mc.explore_election(
+            mc.ELECTION_SCENARIOS["election"],
+            mutations=frozenset({"reuse_quorum_seq_after_takeover"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "quorum-id-monotone-across-failover"
+
+    def test_counterexample_renders_as_flight_dump(self, tmp_path):
+        r = mc.check_mutation("two_leaders_same_term")
+        assert not r.ok and r.trace
+        path = str(tmp_path / "election_cex.jsonl")
+        mc.write_flight_dump(r, path)
+        lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert lines[0]["flight"] == "meta"
+        errs = [rec for rec in lines[1:] if rec["status"] == "error"]
+        assert len(errs) == 1
+        # the violating phase renders in the Manager's vocabulary
+        assert errs[0]["op"] == "quorum_rpc"
 
 
 class TestDiagnoseRoundTrip:

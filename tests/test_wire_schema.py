@@ -89,7 +89,7 @@ class TestDriftGateClean:
         assert set(servers) == {"lighthouse", "manager", "store"}
         assert set(servers["lighthouse"]) == {
             "quorum", "heartbeat", "status", "timeline",
-            "serving_heartbeat", "serving_plan",
+            "serving_heartbeat", "serving_plan", "lease",
         }
         assert set(servers["manager"]) == {
             "quorum", "should_commit", "checkpoint_metadata", "kill",
@@ -190,6 +190,41 @@ class TestSeededDrift:
         drifted["lighthouse.cc"] = lh.replace(
             'out["plan_epoch"] = serving_epoch_;',
             'out["planepoch"] = serving_epoch_;',
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert "result-missing" in codes or "lock-drift" in codes
+
+    def test_python_lease_param_rename_is_caught(self):
+        """Coordination-plane HA surface (ISSUE 13): renaming a lease
+        param on the Python side means the native grant rule reads its
+        wire default — the gate must bite."""
+        py, *_ = _tree_inputs()
+        drifted = py.replace('"term": int(term)', '"trm": int(term)')
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_lease_param_rename_is_caught(self):
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'params.get("candidate").as_string()',
+            'params.get("cand").as_string()',
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_lease_result_rename_is_caught(self):
+        """Renaming the lease reply's holder field natively orphans the
+        Python client's result read."""
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'out["holder"] = promised_to_;', 'out["holdr"] = promised_to_;'
         )
         assert drifted["lighthouse.cc"] != lh
         codes = self._codes(native=drifted)

@@ -130,7 +130,12 @@ def get_lib() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             # weight-serving tier: serving_fanout (distribution-tree arity)
             ctypes.c_int64,
+            # coordination-plane HA: peers (comma list of the OTHER
+            # lighthouse peers; empty = single mode) + lease_timeout_ms
+            ctypes.c_char_p, ctypes.c_int64,
         ]
+        lib.tft_lighthouse_ha_info.restype = ctypes.c_void_p
+        lib.tft_lighthouse_ha_info.argtypes = [ctypes.c_int64]
         lib.tft_manager_create.restype = ctypes.c_int64
         lib.tft_manager_create.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,

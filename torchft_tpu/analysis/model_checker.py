@@ -35,6 +35,7 @@ from typing import Any, Dict, FrozenSet, List, NamedTuple, Optional, Tuple
 from torchft_tpu.analysis.protocol_model import (
     INVISIBLE_OPS,
     MODEL_PHASE_OPS,
+    ElectionConfig,
     ModelConfig,
     ResizeConfig,
     State,
@@ -42,6 +43,12 @@ from torchft_tpu.analysis.protocol_model import (
     Violation,
     apply_transition,
     check_invariants,
+    e_unpair,
+    election_apply,
+    election_check,
+    election_enabled,
+    election_initial,
+    election_is_goal,
     enabled_transitions,
     initial_state,
     is_goal,
@@ -61,9 +68,11 @@ __all__ = [
     "explore",
     "explore_votes",
     "explore_resize",
+    "explore_election",
     "run_schedule",
     "SCENARIOS",
     "RESIZE_SCENARIOS",
+    "ELECTION_SCENARIOS",
     "LIVENESS_SCHEDULES",
     "trace_to_flight_dump",
     "write_flight_dump",
@@ -257,6 +266,59 @@ def explore_resize(
     return CheckResult(True, len(seen), transitions, goal, None, ())
 
 
+def explore_election(
+    cfg: "ElectionConfig" = ElectionConfig(),
+    mutations: "FrozenSet[str]" = frozenset(),
+    max_states: int = 400_000,
+) -> CheckResult:
+    """Exhaustive exploration of the coordination-plane HA (leased
+    leader election) sub-model: candidacies, per-peer lease grants,
+    majority elections, leader crashes, promise expiry, and the
+    term-prefixed quorum ids a takeover must keep monotone."""
+    init = election_initial(cfg)
+    seen = {init}
+    transitions = 0
+    goal = 0
+    stack = [(init, election_enabled(cfg, init, mutations), 0)]
+    path: "List[Tuple[str, int, str, int, int]]" = []
+    while stack:
+        st, ts, idx = stack[-1]
+        if idx >= len(ts):
+            stack.pop()
+            if path:
+                path.pop()
+            continue
+        stack[-1] = (st, ts, idx + 1)
+        t = ts[idx]
+        nxt = election_apply(cfg, st, t, mutations)
+        transitions += 1
+        if nxt in seen:
+            continue
+        seen.add(nxt)
+        op, code = t
+        if op == "e_grant":
+            granter, _cand = e_unpair(code, cfg.n_peers)
+            rid = f"peer{granter}"
+        else:
+            rid = f"peer{code}"
+        term = max((p.leading_term for p in st.peers), default=0)
+        qid = st.ghost.quorum_ids[-1] if st.ghost.quorum_ids else 0
+        path.append((op, code, rid, term, qid))
+        violations = election_check(cfg, nxt)
+        if violations:
+            return CheckResult(
+                False, len(seen), transitions, goal, violations[0], tuple(path)
+            )
+        if election_is_goal(cfg, nxt):
+            goal += 1
+            path.pop()
+            continue
+        if len(seen) >= max_states:
+            raise RuntimeError("election state-space bound exceeded")
+        stack.append((nxt, election_enabled(cfg, nxt, mutations), 0))
+    return CheckResult(True, len(seen), transitions, goal, None, ())
+
+
 # ---------------------------------------------------------------------------
 # scenarios (the bounded state spaces tier-1 proves clean)
 # ---------------------------------------------------------------------------
@@ -337,6 +399,16 @@ RESIZE_SCENARIOS: "Dict[str, ResizeConfig]" = {
     ),
 }
 
+#: coordination-plane HA sub-model scenarios (explore_election): three
+#: lighthouse peers, one leader crash, quorums formed across the
+#: takeover — the full candidacy/grant/expiry interleaving space of the
+#: leased election plus the term-prefixed id discipline.
+ELECTION_SCENARIOS: "Dict[str, ElectionConfig]" = {
+    "election": ElectionConfig(
+        n_peers=3, target_quorums=2, crash_budget=1, expire_budget=3
+    ),
+}
+
 #: scenario used to catch each mutation (the smallest space where the
 #: mutated behavior is reachable)
 MUTATION_SCENARIOS: "Dict[str, str]" = {
@@ -350,6 +422,8 @@ MUTATION_SCENARIOS: "Dict[str, str]" = {
     "resend_vote": "votes",  # vote-barrier sub-model
     "commit_mixed_epochs": "resize",  # parallelism-switching sub-model
     "reuse_epoch_after_rollback": "resize",
+    "two_leaders_same_term": "election",  # coordination-plane HA sub-model
+    "reuse_quorum_seq_after_takeover": "election",
 }
 
 
@@ -362,6 +436,10 @@ def check_mutation(name: str) -> CheckResult:
     if scenario in RESIZE_SCENARIOS:
         return explore_resize(
             RESIZE_SCENARIOS[scenario], mutations=frozenset({name})
+        )
+    if scenario in ELECTION_SCENARIOS:
+        return explore_election(
+            ELECTION_SCENARIOS[scenario], mutations=frozenset({name})
         )
     return explore(SCENARIOS[scenario], mutations=frozenset({name}))
 

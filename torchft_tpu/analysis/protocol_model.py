@@ -56,6 +56,13 @@ __all__ = [
     "resize_apply",
     "resize_check",
     "resize_is_goal",
+    "ElectionConfig",
+    "ElectionState",
+    "election_initial",
+    "election_enabled",
+    "election_apply",
+    "election_check",
+    "election_is_goal",
     "MODEL_PHASE_OPS",
 ]
 
@@ -90,6 +97,13 @@ MODEL_PHASE_OPS: "Dict[str, str]" = {
     "quorum": "quorum_rpc",
     "plan": "quorum_rpc",
     "commit_layout": "layout_commit",
+    # election (coordination-plane HA) sub-model ops
+    "e_candidate": "quorum_rpc",
+    "e_grant": "quorum_rpc",
+    "e_elect": "quorum_rpc",
+    "e_form": "quorum_rpc",
+    "e_crash": "crash",
+    "e_expire": "quorum_rpc",
 }
 
 
@@ -242,6 +256,22 @@ MUTATIONS: "Tuple[Mutation, ...]" = (
         "instead of advancing past it — a straggler still holding the "
         "burned stage could later commit stale data under the fresh plan",
         "layout-epoch-monotone",
+    ),
+    Mutation(
+        "two_leaders_same_term",
+        "a lighthouse peer grants a leadership lease for a term it has "
+        "already promised to a DIFFERENT candidate (the strict "
+        "term-monotone grant rule dropped to >=) — two candidates can "
+        "each assemble a majority at the same term",
+        "at-most-one-leader-per-term",
+    ),
+    Mutation(
+        "reuse_quorum_seq_after_takeover",
+        "a freshly elected lighthouse leader mints quorum ids from its "
+        "own local counter without the term prefix — its first ids "
+        "repeat values the dead leader already served, so quorum_id "
+        "regresses across the failover",
+        "quorum-id-monotone-across-failover",
     ),
 )
 
@@ -1425,3 +1455,346 @@ def vote_check(st: VoteState) -> "List[Violation]":
                 )
             )
     return out
+
+
+# ---------------------------------------------------------------------------
+# coordination-plane HA sub-model: leased leader election
+# ---------------------------------------------------------------------------
+#
+# N lighthouse peers over a static endpoint list elect a leader by
+# majority lease acknowledgement (native/lighthouse.cc election_loop /
+# rpc_lease).  Modeled faithfully where it matters for safety:
+#
+#   - each peer holds ONE promise (term, candidate) — monotone in term,
+#     and a term granted to one candidate is never granted to another
+#     (the at-most-one-leader-per-term rule);
+#   - a fresh grant to ANOTHER peer shields the holder (the lease); a
+#     peer's own failed-candidacy self-promise does not;
+#   - promise freshness decays only by an explicit ``e_expire`` event
+#     (renewals stopped: the promised leader is dead or deposed), which
+#     is how takeover-on-expiry enters the model;
+#   - lighthouse state is soft, so a takeover transfers nothing: the new
+#     leader mints quorum ids as ``(term << 32) | seq`` with seq reset —
+#     the ONLY mechanism keeping quorum_id monotone across failover, and
+#     exactly what the reuse_quorum_seq_after_takeover mutation breaks.
+#
+# Ghost fields record every leadership and every minted quorum id in
+# global order; the invariants read only the ghosts, so a mutated
+# behavior cannot corrupt the judge.
+
+_E_TERM_SHIFT = 32  # matches native lighthouse.h ha_epoch_id
+
+
+class ElectionConfig(NamedTuple):
+    """One bounded election scenario."""
+
+    n_peers: int = 3
+    target_quorums: int = 2  # goal: quorums formed across leaderships
+    crash_budget: int = 1  # leader deaths
+    expire_budget: int = 3  # promise-expiry (renewals-stopped) events
+
+
+class EPeer(NamedTuple):
+    alive: bool
+    promised_term: int
+    promised_to: int  # peer index; -1 = never granted
+    promise_fresh: bool  # the lease shield (renewed by a live leader)
+    leading_term: int  # term this peer leads under (0 = follower)
+    # candidacy in flight: (term, frozenset of granting peer indices)
+    candidacy: "Optional[Tuple[int, FrozenSet[int]]]"
+    quorum_seq: int  # low word of ids minted under this leadership
+
+
+class EGhost(NamedTuple):
+    """Spec-side bookkeeping; never read by the (mutable) behavior."""
+
+    # every leadership ever established, in establishment order
+    leaderships: "Tuple[Tuple[int, int], ...]"  # (term, peer)
+    # last grant: (peer, old_promised_term, new_promised_term)
+    last_grant: "Optional[Tuple[int, int, int]]"
+    # every quorum id minted, in formation order
+    quorum_ids: "Tuple[int, ...]"
+
+
+class ElectionState(NamedTuple):
+    peers: "Tuple[EPeer, ...]"
+    ghost: EGhost
+    crashes: int
+    expires: int
+
+
+def election_initial(cfg: ElectionConfig) -> ElectionState:
+    peers = tuple(
+        EPeer(
+            alive=True,
+            promised_term=0,
+            promised_to=-1,
+            promise_fresh=False,
+            leading_term=0,
+            candidacy=None,
+            quorum_seq=0,
+        )
+        for _ in range(cfg.n_peers)
+    )
+    return ElectionState(
+        peers=peers,
+        ghost=EGhost(leaderships=(), last_grant=None, quorum_ids=()),
+        crashes=cfg.crash_budget,
+        expires=cfg.expire_budget,
+    )
+
+
+def _e_pair(granter: int, candidate: int, n: int) -> int:
+    """Encode a (granter, candidate) pair into the Transition int."""
+    return granter * n + candidate
+
+
+def e_unpair(code: int, n: int) -> "Tuple[int, int]":
+    return code // n, code % n
+
+
+def _e_can_campaign(p: EPeer, i: int) -> bool:
+    """The elector's candidacy gate: free when never/self-promised or
+    the granted promise lapsed (native election_loop 'stale')."""
+    return (
+        not p.promise_fresh or p.promised_to == i or p.promised_to == -1
+    )
+
+
+def election_enabled(
+    cfg: ElectionConfig,
+    st: ElectionState,
+    mutations: "FrozenSet[str]" = frozenset(),
+) -> "List[Transition]":
+    del mutations  # mutated behaviors live in election_apply
+    n = cfg.n_peers
+    out: "List[Transition]" = []
+    for i, p in enumerate(st.peers):
+        if not p.alive:
+            continue
+        if (
+            p.leading_term == 0
+            and p.candidacy is None
+            and _e_can_campaign(p, i)
+        ):
+            out.append(("e_candidate", i))
+        if p.candidacy is not None:
+            term, granted = p.candidacy
+            for j, q in enumerate(st.peers):
+                if j != i and q.alive and j not in granted:
+                    out.append(("e_grant", _e_pair(j, i, n)))
+            # the election post-check (native election_loop): the
+            # candidate's own promise must still back THIS candidacy — a
+            # higher-term grant it gave away meanwhile aborts the round
+            if (
+                2 * len(granted) > n
+                and p.promised_to == i
+                and p.promised_term == term
+            ):
+                out.append(("e_elect", i))
+        if p.leading_term > 0:
+            if len(st.ghost.quorum_ids) < cfg.target_quorums:
+                out.append(("e_form", i))
+            if st.crashes > 0:
+                out.append(("e_crash", i))
+    if st.expires > 0:
+        for j, q in enumerate(st.peers):
+            # renewals stop only when the promised leader cannot renew:
+            # dead, deposed, or never a leader (a failed candidacy)
+            if q.alive and q.promise_fresh and q.promised_to >= 0:
+                holder = st.peers[q.promised_to]
+                if not holder.alive or holder.leading_term == 0:
+                    out.append(("e_expire", j))
+    return sorted(out)
+
+
+def election_apply(
+    cfg: ElectionConfig,
+    st: ElectionState,
+    t: Transition,
+    mutations: "FrozenSet[str]" = frozenset(),
+) -> ElectionState:
+    op, code = t
+    n = cfg.n_peers
+    peers = list(st.peers)
+    ghost = st.ghost
+
+    if op == "e_candidate":
+        i = code
+        p = peers[i]
+        term = max(p.promised_term, p.leading_term) + 1
+        # self-grant under the same rule rpc_lease applies locally
+        peers[i] = p._replace(
+            promised_term=term,
+            promised_to=i,
+            promise_fresh=True,
+            candidacy=(term, frozenset({i})),
+        )
+        ghost = ghost._replace(last_grant=(i, p.promised_term, term))
+        return st._replace(peers=tuple(peers), ghost=ghost)
+
+    if op == "e_grant":
+        j, i = e_unpair(code, n)
+        granter = peers[j]
+        cand = peers[i]
+        assert cand.candidacy is not None
+        term, granted = cand.candidacy
+        # the grant rule (native rpc_lease): strictly higher term, and an
+        # unshielded slot.  A fresh grant shields its holder — including
+        # the granter's OWN record while it actually leads; only a
+        # failed-candidacy self-promise (holder == granter, not leading)
+        # does not shield.
+        shielded = (
+            granter.promise_fresh
+            and granter.promised_to != -1
+            and not (
+                granter.promised_to == j and granter.leading_term == 0
+            )
+        )
+        if "two_leaders_same_term" in mutations:
+            ok = term >= granter.promised_term and not shielded
+        else:
+            ok = term > granter.promised_term and not shielded
+        if ok:
+            ghost = ghost._replace(
+                last_grant=(j, granter.promised_term, term)
+            )
+            peers[j] = granter._replace(
+                promised_term=term, promised_to=i, promise_fresh=True
+            )
+            peers[i] = cand._replace(candidacy=(term, granted | {j}))
+        else:
+            # a refusal teaches the candidate nothing in-model (max_seen
+            # only accelerates convergence; safety is grant-side)
+            peers[i] = cand._replace(candidacy=(term, granted))
+        return st._replace(peers=tuple(peers), ghost=ghost)
+
+    if op == "e_elect":
+        i = code
+        p = peers[i]
+        assert p.candidacy is not None
+        term, granted = p.candidacy
+        assert 2 * len(granted) > n
+        # winning refreshes the leader's own promise record (native
+        # become_leader_locked): its slot now shields like any lease
+        peers[i] = p._replace(
+            leading_term=term,
+            candidacy=None,
+            quorum_seq=0,
+            promised_term=term,
+            promised_to=i,
+            promise_fresh=True,
+        )
+        ghost = ghost._replace(leaderships=ghost.leaderships + ((term, i),))
+        return st._replace(peers=tuple(peers), ghost=ghost)
+
+    if op == "e_form":
+        i = code
+        p = peers[i]
+        assert p.leading_term > 0
+        seq = p.quorum_seq + 1
+        if "reuse_quorum_seq_after_takeover" in mutations:
+            qid = seq  # no term prefix: repeats the dead leader's values
+        else:
+            qid = (p.leading_term << _E_TERM_SHIFT) | seq
+        peers[i] = p._replace(quorum_seq=seq)
+        ghost = ghost._replace(quorum_ids=ghost.quorum_ids + (qid,))
+        return st._replace(peers=tuple(peers), ghost=ghost)
+
+    if op == "e_crash":
+        i = code
+        peers[i] = peers[i]._replace(
+            alive=False, leading_term=0, candidacy=None
+        )
+        return st._replace(peers=tuple(peers), crashes=st.crashes - 1)
+
+    if op == "e_expire":
+        j = code
+        holder = peers[j].promised_to
+        peers[j] = peers[j]._replace(promise_fresh=False)
+        # A lapsed promise withdraws its grant from any still-open
+        # candidacy it backed — including the candidate's own self-grant:
+        # the implementation bounds each candidacy round to the lease
+        # window precisely so an election can never complete on expired
+        # acknowledgements (election_loop's round-deadline check).
+        if holder >= 0:
+            h = peers[holder]
+            if h.candidacy is not None:
+                term, granted = h.candidacy
+                if j in granted and term == peers[j].promised_term:
+                    peers[holder] = h._replace(
+                        candidacy=(term, granted - {j})
+                    )
+        return st._replace(peers=tuple(peers), expires=st.expires - 1)
+
+    raise AssertionError(f"unknown election transition {t}")
+
+
+def election_check(
+    cfg: ElectionConfig, st: ElectionState
+) -> "List[Violation]":
+    out: "List[Violation]" = []
+    # at-most-one-leader-per-term: no term ever establishes two leaders.
+    by_term: "Dict[int, int]" = {}
+    for term, peer in st.ghost.leaderships:
+        if term in by_term and by_term[term] != peer:
+            out.append(
+                Violation(
+                    "at-most-one-leader-per-term",
+                    f"term {term} established two leaders (peer "
+                    f"{by_term[term]} and peer {peer}) — a granter "
+                    f"acknowledged the same term twice",
+                    f"peer{peer}",
+                    "e_elect",
+                )
+            )
+        by_term.setdefault(term, peer)
+    # term-monotone: (a) a grant never lowers a peer's promised term;
+    # (b) successive leaderships carry strictly increasing terms.
+    lg = st.ghost.last_grant
+    if lg is not None and lg[2] < lg[1]:
+        out.append(
+            Violation(
+                "term-monotone",
+                f"peer {lg[0]}'s promised term regressed {lg[1]} -> "
+                f"{lg[2]}",
+                f"peer{lg[0]}",
+                "e_grant",
+            )
+        )
+    for k in range(1, len(st.ghost.leaderships)):
+        prev_t, _ = st.ghost.leaderships[k - 1]
+        cur_t, cur_p = st.ghost.leaderships[k]
+        if cur_t < prev_t or (
+            cur_t == prev_t and by_term.get(cur_t) == cur_p
+        ):
+            out.append(
+                Violation(
+                    "term-monotone",
+                    f"leadership terms did not advance: term {prev_t} "
+                    f"then term {cur_t}",
+                    f"peer{cur_p}",
+                    "e_elect",
+                )
+            )
+    # quorum-id-monotone-across-failover: every minted id strictly
+    # exceeds all earlier ones, INCLUDING across a leader change.
+    ids = st.ghost.quorum_ids
+    for k in range(1, len(ids)):
+        if ids[k] <= ids[k - 1]:
+            out.append(
+                Violation(
+                    "quorum-id-monotone-across-failover",
+                    f"quorum_id regressed across formations: "
+                    f"{ids[k - 1]} then {ids[k]} — a takeover minted ids "
+                    f"a previous leader already served",
+                    "lighthouse",
+                    "e_form",
+                )
+            )
+            break
+    return out
+
+
+def election_is_goal(cfg: ElectionConfig, st: ElectionState) -> bool:
+    return len(st.ghost.quorum_ids) >= cfg.target_quorums

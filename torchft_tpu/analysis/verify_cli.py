@@ -84,6 +84,10 @@ def run_explore_all(verbose: bool = False) -> int:
         r = mc.explore_resize(rcfg)
         _print_result(name, r, verbose)
         bad += 0 if r.ok else 1
+    for name, ecfg in mc.ELECTION_SCENARIOS.items():
+        r = mc.explore_election(ecfg)
+        _print_result(name, r, verbose)
+        bad += 0 if r.ok else 1
     print(f"explored clean in {time.monotonic() - t0:.1f}s"
           if not bad else f"{bad} scenario(s) violated")
     return 1 if bad else 0
@@ -228,6 +232,8 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
             print(f"scenario {name:12s} {cfg}")
         for name, rcfg in mc.RESIZE_SCENARIOS.items():
             print(f"scenario {name:12s} {rcfg}")
+        for name, ecfg in mc.ELECTION_SCENARIOS.items():
+            print(f"scenario {name:12s} {ecfg}")
         for m in MUTATIONS:
             print(f"mutation {m.name:26s} -> {m.catches}: {m.doc}")
         for name, scenario, rotation in mc.LIVENESS_SCHEDULES:
@@ -255,6 +261,10 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
     if args.scenario:
         if args.scenario in mc.RESIZE_SCENARIOS:
             r = mc.explore_resize(mc.RESIZE_SCENARIOS[args.scenario])
+            _print_result(args.scenario, r, args.verbose)
+            return 0 if r.ok else 1
+        if args.scenario in mc.ELECTION_SCENARIOS:
+            r = mc.explore_election(mc.ELECTION_SCENARIOS[args.scenario])
             _print_result(args.scenario, r, args.verbose)
             return 0 if r.ok else 1
         if args.scenario not in mc.SCENARIOS:

@@ -26,10 +26,12 @@ import threading
 import time
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from torchft_tpu import _native
 from torchft_tpu.utils import faults as _faults
+from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.retry import RetryPolicy
 
@@ -40,9 +42,11 @@ __all__ = [
     "ManagerClient",
     "StoreServer",
     "StoreClient",
+    "NotLeaderError",
     "Quorum",
     "QuorumMember",
     "QuorumResult",
+    "parse_endpoints",
 ]
 
 
@@ -186,8 +190,28 @@ def parse_host_port(addr: str) -> "tuple[str, int]":
     return host or "127.0.0.1", int(port)
 
 
+def parse_endpoints(addrs: str) -> "List[str]":
+    """Split a ``TORCHFT_LIGHTHOUSE`` value into endpoint addresses:
+    ``"host1:p1,host2:p2,host3:p3"`` -> ``["host1:p1", ...]`` (whitespace
+    around entries tolerated; empty entries dropped).  A single-address
+    value parses to a one-element list — every lighthouse client accepts
+    both forms (coordination-plane HA, docs/architecture.md)."""
+    return [part.strip() for part in addrs.split(",") if part.strip()]
+
+
 class RpcError(RuntimeError):
     pass
+
+
+class NotLeaderError(RpcError):
+    """A follower lighthouse peer declined a leader-only method
+    (coordination-plane HA).  ``leader`` is the follower's freshest hint
+    for the current lease holder ("" when it knows none) — failover
+    clients jump straight to it instead of walking the whole list."""
+
+    def __init__(self, message: str, leader: str = "") -> None:
+        super().__init__(message)
+        self.leader = leader
 
 
 #: Frame-size ceiling shared with the native side (native/net.h
@@ -344,6 +368,11 @@ class _RpcClient:
             if not resp.get("ok"):
                 if resp.get("code") == "timeout":
                     raise TimeoutError(resp.get("error", "timeout"))
+                if resp.get("code") == "not_leader":
+                    raise NotLeaderError(
+                        resp.get("error", "not the leader"),
+                        leader=resp.get("leader", ""),
+                    )
                 raise RpcError(resp.get("error", "rpc failed"))
             return resp.get("result", {})
 
@@ -376,6 +405,197 @@ class _RpcClient:
             except OSError:
                 pass
             self._sock = None
+
+
+#: Per-hop connect budget inside a failover walk: a DEAD endpoint (port
+#: refused/unreachable) must cost this long, not the caller's deadline —
+#: the walk itself is the retry layer across endpoints, and endpoints
+#: that were merely slow get revisited by the next walk pass anyway.
+_FAILOVER_CONNECT_SLICE_S = 0.35
+
+# A full failover-walk pass that found no servable leader (every peer
+# dead or answering NOT_LEADER — the fleet is mid-election) is retried
+# on this policy: short jittered backoff inside the caller's deadline
+# budget.  The budget, never the attempt count, bounds the wait.
+_WALK_POLICY = RetryPolicy(
+    name="rpc.failover",
+    base_delay=0.05,
+    multiplier=1.5,
+    max_delay=0.5,
+    retryable=(ConnectionError, NotLeaderError),
+)
+
+
+class _FailoverRpcClient:
+    """Multi-endpoint framed-JSON client (coordination-plane HA).
+
+    Wraps one :class:`_RpcClient` per endpoint of a comma-list address,
+    walks dead endpoints, follows ``NOT_LEADER`` redirects to the named
+    holder, and stays pinned to whichever endpoint last answered.  One
+    walk pass visits every endpoint at most once (plus bounded redirect
+    hops); passes are retried on the unified retry layer while the fleet
+    elects, inside the caller's deadline.  A dead endpoint costs a
+    bounded connect slice, never the whole deadline — the endpoint that
+    answers gets all remaining budget (quorum is a long-poll).
+
+    With a single endpoint the behavior is exactly ``_RpcClient``'s (no
+    walk, no policy wrap) — the pre-HA wire behavior.
+    """
+
+    def __init__(
+        self,
+        addrs: str,
+        connect_timeout: float = 10.0,
+        fault_site: "Optional[str]" = None,
+    ) -> None:
+        self._endpoints = parse_endpoints(addrs)
+        if not self._endpoints:
+            raise ValueError(f"no lighthouse endpoints in {addrs!r}")
+        self._connect_timeout = connect_timeout
+        self._fault_site = fault_site
+        self._clients: "Dict[str, _RpcClient]" = {}
+        self._cur = 0
+        self._redirect = ""  # leader hint from a NOT_LEADER reply
+
+    def endpoints(self) -> "List[str]":
+        return list(self._endpoints)
+
+    def current(self) -> str:
+        """The endpoint the next call will try first."""
+        return self._redirect or self._endpoints[self._cur]
+
+    def _client_for(self, addr: str, connect_slice: float) -> _RpcClient:
+        client = self._clients.get(addr)
+        if client is None:
+            client = _RpcClient(
+                addr, connect_slice, fault_site=self._fault_site
+            )
+            self._clients[addr] = client
+        else:
+            # per-hop connect budget: bounded by the walk, not the ctor
+            client._connect_timeout = connect_slice
+        return client
+
+    def _advance(self) -> None:
+        self._redirect = ""
+        self._cur = (self._cur + 1) % len(self._endpoints)
+
+    def _walk_once(
+        self,
+        method: str,
+        params: "Dict[str, Any]",
+        budget: float,
+        idempotent: bool,
+        stats: "Dict[str, int]",
+    ) -> "Dict[str, Any]":
+        deadline = time.monotonic() + budget
+        n = len(self._endpoints)
+        # every endpoint once + a redirect hop per follower answer
+        last: "Optional[Exception]" = None
+        for _hop in range(2 * n + 2):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            addr = self._redirect or self._endpoints[self._cur]
+            connect_slice = min(
+                self._connect_timeout,
+                _FAILOVER_CONNECT_SLICE_S,
+                max(remaining, 0.05),
+            )
+            client = self._client_for(addr, connect_slice)
+            try:
+                return client.call(
+                    method, params, remaining, idempotent=idempotent
+                )
+            except NotLeaderError as e:
+                last = e
+                stats["redirects"] += 1
+                _metrics.HA_REDIRECTS.inc()
+                if e.leader and e.leader != addr:
+                    self._redirect = e.leader
+                else:
+                    self._advance()
+            except (ConnectionError, TimeoutError, OSError) as e:
+                # the caller's own deadline expiring on a live endpoint is
+                # a timeout, not a dead peer: surface it unchanged
+                if (
+                    isinstance(e, TimeoutError)
+                    and deadline - time.monotonic() <= 0.001
+                ):
+                    raise
+                last = e
+                stats["failovers"] += 1
+                _metrics.HA_FAILOVERS.inc()
+                # dead peer (or dead hinted leader): resume the list walk
+                self._advance()
+        if isinstance(last, NotLeaderError):
+            raise last  # fleet mid-election: retryable by the walk policy
+        raise ConnectionError(
+            f"rpc {method} failed on every lighthouse endpoint "
+            f"{self._endpoints}: {last}"
+        ) from last
+
+    def call(
+        self,
+        method: str,
+        params: "Dict[str, Any]",
+        timeout: "float | timedelta",
+        idempotent: bool = True,
+    ) -> "Dict[str, Any]":
+        timeout_s = (
+            timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
+        )
+        if len(self._endpoints) == 1:
+            return self._client_for(
+                self._endpoints[0], self._connect_timeout
+            ).call(method, params, timeout_s, idempotent=idempotent)
+        stats = {"failovers": 0, "redirects": 0}
+        t0_ns = time.time_ns()
+
+        def attempt(budget: "Optional[float]") -> "Dict[str, Any]":
+            return self._walk_once(
+                method,
+                params,
+                budget if budget is not None else timeout_s,
+                idempotent,
+                stats,
+            )
+
+        try:
+            return _WALK_POLICY.run(attempt, timeout=timeout_s, op="rpc.failover")
+        finally:
+            if stats["failovers"] or stats["redirects"]:
+                # one record per walked call: who we ended up on and what
+                # the walk cost — the post-mortem trail of a failover
+                _flightrec.record(
+                    "ha.failover",
+                    start_ns=t0_ns,
+                    method=method,
+                    endpoint=self.current(),
+                    failovers=stats["failovers"],
+                    redirects=stats["redirects"],
+                )
+                tracer = _tracing.get_tracer()
+                ctx = _tracing.get_current()
+                if tracer is not None and ctx is not None and ctx.sampled:
+                    tracer.export_span(
+                        name="rpc.failover",
+                        trace_id=ctx.trace_id,
+                        parent_span_id=ctx.span_id,
+                        start_ns=t0_ns,
+                        end_ns=time.time_ns(),
+                        attributes={
+                            "method": method,
+                            "endpoint": self.current(),
+                            "failovers": stats["failovers"],
+                            "redirects": stats["redirects"],
+                        },
+                    )
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+        self._clients.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -440,10 +660,23 @@ class LighthouseServer(_NativeServer):
         straggler_topk: "Optional[int]" = None,
         timeline_ring: "Optional[int]" = None,
         serving_fanout: "Optional[int]" = None,
+        peers: "Optional[Sequence[str] | str]" = None,
+        lease_timeout_ms: "Optional[int]" = None,
     ) -> None:
         from torchft_tpu.utils.env import env_int
 
         host, _, port = bind.rpartition(":")
+        # Coordination-plane HA: ``peers`` names the OTHER lighthouse
+        # peers of the replicated coordination plane (list or comma
+        # string; self-exclusion is the caller's job — ha.fleet and the
+        # CLI handle it).  Empty = single-process mode, wire-identical to
+        # the pre-HA server.
+        if peers is None:
+            peers_csv = ""
+        elif isinstance(peers, str):
+            peers_csv = peers
+        else:
+            peers_csv = ",".join(peers)
         lib = _native.get_lib()
         handle = lib.tft_lighthouse_create(
             host.encode(),
@@ -468,10 +701,24 @@ class LighthouseServer(_NativeServer):
             serving_fanout
             if serving_fanout is not None
             else env_int("TORCHFT_SERVING_FANOUT", 2, minimum=1),
+            peers_csv.encode(),
+            lease_timeout_ms
+            if lease_timeout_ms is not None
+            else env_int("TORCHFT_LIGHTHOUSE_LEASE_MS", 1000, minimum=40),
         )
         super().__init__(handle)
         self._metrics_cb: Any = None
         self._install_metrics_provider()
+
+    def ha_info(self) -> "Dict[str, Any]":
+        """Coordination-plane HA introspection: ``{"enabled", "term",
+        "is_leader", "leader", "peers", "takeovers_total", "quorum_id"}``.
+        Single-process mode reports ``enabled=False``, ``is_leader=True``,
+        term 0."""
+        if self._handle is None:
+            raise RuntimeError("lighthouse server is shut down")
+        ptr = _native.get_lib().tft_lighthouse_ha_info(self._handle)
+        return json.loads(_native.take_string(ptr))
 
     def _install_metrics_provider(self) -> None:
         from torchft_tpu.utils import metrics as _metrics
@@ -581,7 +828,15 @@ class ManagerServer(_NativeServer):
 
 
 class LighthouseClient:
-    """Client for LighthouseServer. Reference: src/lib.rs:483-591."""
+    """Client for LighthouseServer. Reference: src/lib.rs:483-591.
+
+    ``addr`` may be a single ``host:port`` or the HA comma list
+    (``TORCHFT_LIGHTHOUSE=h1:p,h2:p,h3:p``): with multiple endpoints
+    every call rides the failover walk — dead peers are skipped within a
+    bounded connect slice, ``NOT_LEADER`` replies are followed to the
+    current lease holder, and mid-election passes are retried on the
+    unified retry layer inside the caller's timeout.
+    """
 
     def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0) -> None:
         ct = (
@@ -589,7 +844,7 @@ class LighthouseClient:
             if isinstance(connect_timeout, timedelta)
             else connect_timeout
         )
-        self._client = _RpcClient(addr, ct, fault_site="lighthouse.rpc")
+        self._client = _FailoverRpcClient(addr, ct, fault_site="lighthouse.rpc")
 
     def quorum(
         self,
@@ -741,6 +996,35 @@ class LighthouseClient:
             "publishers": result["publishers"],
             "nodes": result["nodes"],
             "depth": result["depth"],
+        }
+
+    def lease(
+        self,
+        term: int,
+        candidate: str,
+        timeout: "float | timedelta" = 5.0,
+    ) -> Dict[str, Any]:
+        """One leadership-lease request against a single lighthouse peer
+        (coordination-plane HA; the native electors drive this RPC in
+        production — this client exists for tests, chaos drills and
+        external election tooling).  ``term`` is the candidate's proposed
+        monotone term, ``candidate`` its advertised RPC address.  Reply:
+        ``{"granted", "term", "holder"}`` — ``granted`` is False when the
+        peer already promised this term to another candidate or its
+        current promise has not lapsed (lease shielding).  Note this RPC
+        is served by every peer, leader or follower."""
+        # chaos site: the lease/election path must itself be
+        # chaos-testable (docs/robustness.md site table)
+        _faults.check("lighthouse.lease", step=term)
+        params: "Dict[str, Any]" = {
+            "term": int(term),
+            "candidate": candidate,
+        }
+        result = self._client.call("lease", params, timeout)
+        return {
+            "granted": result["granted"],
+            "term": result["term"],
+            "holder": result["holder"],
         }
 
     def timeline(self, timeout: "float | timedelta" = 5.0) -> Dict[str, Any]:

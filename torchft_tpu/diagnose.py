@@ -239,9 +239,23 @@ def load_records(
 def load_timeline(src: str) -> "Dict[str, Any]":
     """Load a lighthouse ``/timeline.json`` document from a file path or
     an ``http(s)://`` URL (``host:port`` shorthand fetches
-    ``http://host:port/timeline.json``).  Raises on unreadable/invalid
-    input — a requested timeline that cannot be read is an error, not a
-    silently thinner report."""
+    ``http://host:port/timeline.json``; a ``h1:p,h2:p`` comma list rides
+    the coordination-plane-HA failover walk to whichever peer currently
+    leads).  Raises on unreadable/invalid input — a requested timeline
+    that cannot be read is an error, not a silently thinner report."""
+    if "," in src and ":" in src and not os.path.exists(src):
+        # replicated-lighthouse endpoint list: the RPC client walks dead
+        # peers and follows NOT_LEADER redirects (coordination.py)
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(src)
+        try:
+            doc = client.timeline(timeout=10.0)
+        finally:
+            client.close()
+        if not isinstance(doc, dict) or "steps" not in doc:
+            raise ValueError(f"{src}: not a /timeline.json document")
+        return doc
     if src.startswith(("http://", "https://")) or (
         "/" not in src and ":" in src and not os.path.exists(src)
     ):

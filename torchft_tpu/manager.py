@@ -15,7 +15,12 @@ JAX-first adaptations:
   changes never change compiled shapes — no re-jit on fail/join.
 
 Env knobs (parity with reference manager.py:76-89):
-``TORCHFT_LIGHTHOUSE``, ``TORCHFT_MANAGER_PORT``, ``TORCHFT_TIMEOUT_SEC``,
+``TORCHFT_LIGHTHOUSE`` (a single ``host:port`` or the coordination-plane
+HA comma list ``h1:p,h2:p,h3:p`` — the native manager's lighthouse
+client walks dead peers and follows ``NOT_LEADER`` redirects to the
+current lease holder, so a replicated lighthouse needs no Manager-side
+changes; docs/architecture.md "Coordination-plane HA"),
+``TORCHFT_MANAGER_PORT``, ``TORCHFT_TIMEOUT_SEC``,
 ``TORCHFT_QUORUM_TIMEOUT_SEC``, ``TORCHFT_CONNECT_TIMEOUT_SEC``,
 ``TORCHFT_QUORUM_RETRIES`` (quorum RPC attempts on connection failure,
 with exponential backoff + full jitter via ``utils.retry.RetryPolicy``

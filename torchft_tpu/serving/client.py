@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.serving import payload as _payload
+from torchft_tpu.serving import wire as _wire
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
@@ -66,9 +67,12 @@ def fetch_resource(
             headers={"traceparent": traceparent} if traceparent else {},
         )
         with urllib.request.urlopen(req, timeout=t) as resp:
-            _metrics.SERVING_FETCH_BYTES.labels(role="client").inc(
-                int(resp.headers.get("Content-Length") or 0)
-            )
+            nbytes = int(resp.headers.get("Content-Length") or 0)
+            _metrics.SERVING_FETCH_BYTES.labels(role="client").inc(nbytes)
+            # WAN wire model (serving/wire.py): one RTT + bytes/rate of
+            # bucket debt per fetch message crossing the topology
+            # boundary; zero-cost when unshaped
+            _wire.get_shaper().charge(base, nbytes)
             skeleton, leaves, n = ser.deserialize_from(resp)
             return ser.reassemble(skeleton, leaves, n)
 
@@ -86,6 +90,17 @@ class ServingClient:
         client_id: spreads initial source choice across clients (leaves
             are rotated by its hash) so a client fleet does not dogpile
             one leaf.
+        pin_version: serve EXACTLY this weight version: every
+            ``fetch()`` without an explicit ``version`` targets it, and
+            its eviction from the staging window is an error (the 503
+            poll exhausts the deadline), never a silent substitution.
+        min_version: rollback floor — a fetch that would RESOLVE OR
+            RETURN a version below this raises instead (e.g. a restarted
+            publisher re-advertising an older checkpoint must not roll
+            an inference fleet back).  The floor also ratchets up to
+            every version successfully fetched, so "never serve older
+            than what I already serve" needs no bookkeeping by the
+            caller.
     """
 
     def __init__(
@@ -93,6 +108,8 @@ class ServingClient:
         lighthouse_addr: str,
         plan_ttl: "Optional[float]" = None,
         client_id: "Optional[str]" = None,
+        pin_version: "Optional[int]" = None,
+        min_version: int = 0,
     ) -> None:
         from torchft_tpu.coordination import LighthouseClient
 
@@ -113,6 +130,22 @@ class ServingClient:
         # previous decoded version for delta fetches
         self._held: "Optional[Tuple[Dict[str, Any], Dict[int, Any]]]" = None
         self._held_version = 0
+        # version pinning / rollback floor (coordination with rolling
+        # deploys: a pinned canary, a fleet that must never regress)
+        self._pin_version = (
+            int(pin_version) if pin_version is not None else None
+        )
+        if self._pin_version is not None and self._pin_version <= 0:
+            raise ValueError("pin_version must be a positive version")
+        self._min_version = int(min_version)
+        if (
+            self._pin_version is not None
+            and self._pin_version < self._min_version
+        ):
+            raise ValueError(
+                f"pin_version={self._pin_version} is below "
+                f"min_version={self._min_version}"
+            )
 
     # -- discovery ---------------------------------------------------------
 
@@ -165,13 +198,28 @@ class ServingClient:
         manifest plus changed fragments cross the wire.  Sources are
         tried leaves-first within the deadline; a source failure (killed
         server, staging lag past its budget slice) fails over to the
-        next and counts in ``torchft_serving_failovers_total``."""
+        next and counts in ``torchft_serving_failovers_total``.
+
+        A client constructed with ``pin_version=`` targets that version
+        whenever ``version`` is omitted; one constructed with
+        ``min_version=`` (or that has fetched before — the floor
+        ratchets) refuses any resolution below the floor with a
+        ``RuntimeError`` instead of rolling back."""
         deadline = time.monotonic() + timeout
         plan = self.plan()
+        if version is None and self._pin_version is not None:
+            version = self._pin_version
         pinned = version is not None
         v = int(version) if pinned else int(plan["latest_version"])
         if v <= 0:
             raise RuntimeError("serving tier has no published version yet")
+        if v < self._min_version:
+            raise RuntimeError(
+                f"serving fetch refused: version {v} is below the "
+                f"client's rollback floor (min_version="
+                f"{self._min_version}) — the tier would roll this "
+                f"client back to an older checkpoint"
+            )
         _faults.check("serving.fetch", step=v)
         t0 = time.perf_counter()
         t0_ns = time.time_ns()
@@ -180,6 +228,8 @@ class ServingClient:
                 v, plan, deadline, delta, pinned
             )
             op.update(failovers=failovers, version=v)
+        # ratchet: this client never serves older than what it has served
+        self._min_version = max(self._min_version, v)
         dt = time.perf_counter() - t0
         _metrics.SERVING_FETCH_SECONDS.labels(role="client").observe(dt)
         tracer = _tracing.get_tracer()

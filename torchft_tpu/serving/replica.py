@@ -21,6 +21,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.serving import wire as _wire
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
@@ -230,6 +231,12 @@ class ServingReplica:
                 try:
                     doc = self._transport.recv_checkpoint(
                         0, src, step=target, timeout=budget
+                    )
+                    # WAN wire model (serving/wire.py): the relay pull
+                    # pays one RTT + payload/rate when the parent/peer
+                    # sits across the topology boundary
+                    _wire.get_shaper().charge(
+                        src, _wire.payload_nbytes(doc)
                     )
                     break
                 except Exception as e:  # noqa: BLE001 - failover path

@@ -20,6 +20,11 @@ site                  fires in
                       heartbeat/progress-piggyback client (tests and
                       custom FT algorithms; the native manager's C++
                       heartbeat loop does not consult this registry)
+``lighthouse.lease``  ``LighthouseClient.lease`` — the Python
+                      leadership-lease client of the replicated
+                      lighthouse (``step`` = proposed term; the native
+                      electors' C++ lease exchanges do not consult this
+                      registry)
 ``manager.quorum``    ``Manager._async_quorum`` before the quorum RPC
 ``manager.heal``      ``Manager._async_quorum`` heal send/recv branches
 ``pg.reconfigure``    ``ProcessGroupTCP.configure`` /
@@ -116,6 +121,7 @@ __all__ = [
 KNOWN_SITES: "Tuple[str, ...]" = (
     "lighthouse.rpc",
     "lighthouse.heartbeat",
+    "lighthouse.lease",
     "manager.quorum",
     "manager.heal",
     "pg.reconfigure",

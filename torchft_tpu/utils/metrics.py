@@ -1068,3 +1068,23 @@ SERVING_VERSION = gauge(
     "Newest weight version this process holds/has published, by role",
     ("role",),
 )
+SERVING_WIRE_WAIT = counter(
+    "torchft_serving_wire_wait_seconds_total",
+    "Seconds serving-tier fetches slept to honor the WAN wire model "
+    "(TORCHFT_WIRE_RTT_MS + TORCHFT_WIRE_GBPS across the "
+    "TORCHFT_TOPOLOGY boundary; serving/wire.py)",
+    (),
+)
+HA_FAILOVERS = counter(
+    "torchft_ha_failovers_total",
+    "Lighthouse RPCs that moved to another endpoint of the "
+    "TORCHFT_LIGHTHOUSE list after a dead/unreachable peer "
+    "(coordination-plane HA failover walk)",
+    (),
+)
+HA_REDIRECTS = counter(
+    "torchft_ha_redirects_total",
+    "Lighthouse RPCs redirected to the current leader after a "
+    "NOT_LEADER reply from a follower peer",
+    (),
+)
