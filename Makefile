@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving serve-soak ha-smoke bench-ha
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha
 
 native:
 	$(MAKE) -C native
@@ -65,6 +65,15 @@ serve-soak:
 # with the same < 1.5 KB compact-summary JSON line as the full bench.
 bench-serving:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving
+
+# Streaming-relay depth axis alone (ISSUE 14): publish->leaf latency
+# over a fanout-1 relay chain at depth {1,2,3} x simulated RTT
+# {0,10,50} ms, whole-payload store-and-forward vs cut-through fragment
+# streaming + the single-fragment delta rows (docs/benchmarks.md);
+# ends with the same < 1.5 KB compact-summary JSON line as the full
+# bench.
+bench-serving-depth:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving-depth
 
 # Coordination-plane HA round trip alone: 3 lighthouse subprocesses,
 # SIGKILL the active leader mid-quorum-round and mid-serving-fetch —

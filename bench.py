@@ -56,11 +56,15 @@ import sys
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from torchft_tpu.coordination import LighthouseServer, StoreServer
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    StoreServer,
+)
 from torchft_tpu.diagnose import dominant_contributor
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.process_group import (
@@ -1980,6 +1984,189 @@ def bench_serving() -> "Dict[str, Any]":
     }
 
 
+# ---------------------------------------------------------------------------
+# serving depth axis (ISSUE 14): publish->leaf latency, flat vs streaming
+# ---------------------------------------------------------------------------
+
+SERVING_DEPTHS = (1, 2, 3)
+SERVING_DEPTH_RTTS_MS = (0.0, 10.0, 50.0)
+SERVING_DEPTH_GBPS = 0.02       # per-SOURCE uplink (serving/wire.py)
+SERVING_DEPTH_BURST_MB = 0.25
+SERVING_DEPTH_LEAVES = 8        # == fragments: one leaf per fragment
+SERVING_DEPTH_LEAF_ELEMS = 128 * 1024  # 8 x 512 KB fp32 = 4 MB payload
+SERVING_DEPTH_PUBLISHES = 4     # measured publishes per config (+1 warm)
+SERVING_DEPTH_PARALLEL = 8      # in-flight frag window: overlap all RTTs
+
+
+def _serving_depth_trial(
+    base: "Dict[str, np.ndarray]", depth: int, stream: bool
+) -> "Tuple[List[float], List[float]]":
+    """One (depth, mode) config: a fanout-1 CHAIN of ``depth`` relays;
+    returns (full-change publish->leaf latencies, single-fragment delta
+    latencies) in seconds.  publish->leaf = publish() call to the LEAF
+    relay holding the version complete."""
+    from torchft_tpu.serving import ServingReplica, WeightPublisher
+
+    lh = LighthouseServer(
+        min_replicas=1, heartbeat_timeout_ms=3000, quorum_tick_ms=50,
+        serving_fanout=1,
+    )
+    pub = WeightPublisher(
+        lh.address(), wire="f32", fragments=SERVING_DEPTH_LEAVES,
+        heartbeat_interval=0.05,
+    )
+    reps = [
+        ServingReplica(
+            lh.address(), replica_id=f"depth{i:02d}", poll_interval=0.02,
+            fetch_timeout=60.0, stream=stream,
+        )
+        for i in range(depth)
+    ]
+    leaf = reps[-1]
+    full: "List[float]" = []
+    delta: "List[float]" = []
+    try:
+        # wait for the full chain to form before measuring — and fail
+        # LOUDLY if it never does: measuring a shallower tree would
+        # silently mislabel the depth axis the headline is judged on
+        cl = LighthouseClient(lh.address())
+        t_end = time.monotonic() + 20
+        while True:
+            plan = cl.serving_plan()
+            if sorted(n["depth"] for n in plan["nodes"]) == list(
+                range(depth)
+            ):
+                break
+            if time.monotonic() > t_end:
+                cl.close()
+                raise TimeoutError(
+                    f"serving depth bench: chain of depth {depth} never "
+                    f"formed (plan depths: "
+                    f"{sorted(n['depth'] for n in plan['nodes'])})"
+                )
+            time.sleep(0.05)
+        cl.close()
+
+        def _publish_and_wait(state: "Dict[str, np.ndarray]") -> float:
+            t0 = time.perf_counter()
+            v = pub.publish(state)
+            t_dead = time.monotonic() + 120
+            while leaf.version() < v:
+                if time.monotonic() > t_dead:
+                    raise TimeoutError(
+                        f"leaf never converged to v{v} "
+                        f"(depth={depth} stream={stream})"
+                    )
+                time.sleep(0.005)
+            return time.perf_counter() - t0
+
+        for t in range(SERVING_DEPTH_PUBLISHES + 1):
+            # every leaf changes: the full payload moves each publish
+            state = {k: a + np.float32(t + 1) for k, a in base.items()}
+            dt = _publish_and_wait(state)
+            if t > 0:  # first publish warms the chain/tree
+                full.append(dt)
+        for t in range(2):
+            # one leaf changes: the delta path moves ~1 fragment/hop
+            state["layer0"] = base["layer0"] + np.float32(100 + t)
+            delta.append(_publish_and_wait(dict(state)))
+    finally:
+        for r in reps:
+            try:
+                r.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        pub.shutdown()
+        lh.shutdown()
+    return full, delta
+
+
+def bench_serving_depth() -> "Dict[str, Any]":
+    """The streaming-relay acceptance leg (ISSUE 14): publish->leaf
+    propagation latency over a fanout-1 relay CHAIN at depth {1,2,3} x
+    simulated WAN RTT {0,10,50} ms, whole-payload store-and-forward
+    (``flat``) vs cut-through fragment streaming (``stream``).  Every
+    measured publish changes EVERY leaf, so the full payload moves; the
+    ``delta`` rows change one leaf, so streaming relays move ~one
+    fragment per hop.  Headline: the depth-3 / 50 ms speedup (flat
+    store-and-forward costs ~depth x T_payload; cut-through costs
+    ~T_payload + depth x T_frag)."""
+    import os as _os
+
+    rng = np.random.RandomState(11)
+    base = {
+        f"layer{i}": rng.randn(SERVING_DEPTH_LEAF_ELEMS).astype(np.float32)
+        for i in range(SERVING_DEPTH_LEAVES)
+    }
+    payload_bytes = sum(a.nbytes for a in base.values())
+    prior = {
+        k: _os.environ.get(k)
+        for k in ("TORCHFT_WIRE_RTT_MS", "TORCHFT_WIRE_GBPS",
+                  "TORCHFT_WIRE_BURST_MB", "TORCHFT_TOPOLOGY",
+                  "TORCHFT_SERVING_PARALLEL")
+    }
+    # flat/unset topology: every fetch crosses the WAN boundary; each
+    # serving node's uplink is its own token bucket (per-source model)
+    _os.environ.pop("TORCHFT_TOPOLOGY", None)
+    _os.environ["TORCHFT_WIRE_GBPS"] = str(SERVING_DEPTH_GBPS)
+    _os.environ["TORCHFT_WIRE_BURST_MB"] = str(SERVING_DEPTH_BURST_MB)
+    # one in-flight slot per fragment: the per-message RTTs of a hop
+    # overlap into ~one RTT instead of ceil(F/K) batches
+    _os.environ["TORCHFT_SERVING_PARALLEL"] = str(SERVING_DEPTH_PARALLEL)
+
+    def _pcts(lat: "List[float]") -> "Tuple[float, float]":
+        lat = sorted(lat)
+        p50 = lat[len(lat) // 2]
+        return round(p50 * 1e3, 1), round(lat[-1] * 1e3, 1)
+
+    out: "Dict[str, Any]" = {
+        "payload_mb": round(payload_bytes / 2**20, 2),
+        "fragments": SERVING_DEPTH_LEAVES,
+        "gbps_per_uplink": SERVING_DEPTH_GBPS,
+        "publishes": SERVING_DEPTH_PUBLISHES,
+    }
+    try:
+        for rtt in SERVING_DEPTH_RTTS_MS:
+            _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
+            leg: "Dict[str, Any]" = {}
+            for depth in SERVING_DEPTHS:
+                flat_full, _ = _serving_depth_trial(base, depth, False)
+                stream_full, stream_delta = _serving_depth_trial(
+                    base, depth, True
+                )
+                f50, f99 = _pcts(flat_full)
+                s50, s99 = _pcts(stream_full)
+                d50, _d99 = _pcts(stream_delta)
+                leg[f"d{depth}"] = {
+                    "flat_p50_ms": f50, "flat_p99_ms": f99,
+                    "stream_p50_ms": s50, "stream_p99_ms": s99,
+                    "stream_delta_p50_ms": d50,
+                    "stream_speedup_x": round(f50 / max(s50, 1e-9), 2),
+                }
+                log(
+                    f"serving depth d={depth} rtt={rtt}ms: flat p50 "
+                    f"{f50}ms stream p50 {s50}ms delta p50 {d50}ms"
+                )
+            out[f"rtt_{int(rtt)}ms"] = leg
+        d3 = out.get("rtt_50ms", {}).get("d3", {})
+        out["d3_rtt50_speedup_x"] = d3.get("stream_speedup_x")
+        out["d3_rtt50_flat_p50_ms"] = d3.get("flat_p50_ms")
+        out["d3_rtt50_stream_p50_ms"] = d3.get("stream_p50_ms")
+        out["d3_rtt50_delta_p50_ms"] = d3.get("stream_delta_p50_ms")
+        out["winner"] = (
+            "stream"
+            if (d3.get("stream_speedup_x") or 0) > 1.0
+            else "flat"
+        )
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    return out
+
+
 COMPACT_SUMMARY_MAX_BYTES = 1500
 
 
@@ -1988,22 +2175,19 @@ HA_TRIALS = 3
 HA_LEASE_MS = 500
 
 
-def bench_ha() -> "Dict[str, Any]":
-    """Coordination-plane HA failover: HA_PEERS in-process lighthouse
-    peers with leased leadership; a replica-group stub quorums through
-    the endpoint-list client, the LEADER is killed, and the headline is
-    leader-kill -> next formed quorum latency (the coordination-plane
-    twin of the recovery metric).  Also asserts what the chaos tests
-    assert: quorum_id strictly monotone with an advancing term word.
-    docs/architecture.md "Coordination-plane HA"."""
-    from torchft_tpu.coordination import LighthouseClient
+HA_RTTS_MS = (0.0, 50.0)
+
+
+def _ha_failover_trials(n_trials: int, tag: str) -> "Dict[str, Any]":
+    """``n_trials`` leader-kill -> next-quorum measurements (one fleet
+    per trial); the per-leg body of :func:`bench_ha`."""
     from torchft_tpu.ha import LighthouseFleet
 
     trials: "List[float]" = []
     monotone = True
     term_advanced = True
     takeover_terms: "List[int]" = []
-    for t in range(HA_TRIALS):
+    for t in range(n_trials):
         fleet = LighthouseFleet(
             n=HA_PEERS, min_replicas=1, lease_timeout_ms=HA_LEASE_MS,
             quorum_tick_ms=50,
@@ -2012,10 +2196,10 @@ def bench_ha() -> "Dict[str, Any]":
             fleet.wait_for_leader(20)
             cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
             try:
-                q1 = cli.quorum(f"bench_ha:{t}a", timeout=15.0)
+                q1 = cli.quorum(f"bench_ha:{tag}{t}a", timeout=15.0)
                 t0 = time.monotonic()
                 fleet.kill_leader()
-                q2 = cli.quorum(f"bench_ha:{t}b", timeout=30.0)
+                q2 = cli.quorum(f"bench_ha:{tag}{t}b", timeout=30.0)
                 trials.append(time.monotonic() - t0)
                 monotone = monotone and q2.quorum_id > q1.quorum_id
                 term_advanced = term_advanced and (
@@ -2028,8 +2212,6 @@ def bench_ha() -> "Dict[str, Any]":
             fleet.shutdown()
     trials.sort()
     return {
-        "peers": HA_PEERS,
-        "lease_ms": HA_LEASE_MS,
         "trials": len(trials),
         "kill_to_quorum_p50_s": round(trials[len(trials) // 2], 3),
         "kill_to_quorum_max_s": round(trials[-1], 3),
@@ -2037,6 +2219,64 @@ def bench_ha() -> "Dict[str, Any]":
         "quorum_id_monotone": monotone,
         "term_advanced": term_advanced,
         "takeover_terms": takeover_terms,
+    }
+
+
+def bench_ha() -> "Dict[str, Any]":
+    """Coordination-plane HA failover: HA_PEERS in-process lighthouse
+    peers with leased leadership; a replica-group stub quorums through
+    the endpoint-list client, the LEADER is killed, and the headline is
+    leader-kill -> next formed quorum latency (the coordination-plane
+    twin of the recovery metric).  Also asserts what the chaos tests
+    assert: quorum_id strictly monotone with an advancing term word.
+
+    WAN-shaped legs (ISSUE 14 satellite, the PR 13 carry-over): the
+    sweep re-runs the measurement with ``TORCHFT_WIRE_RTT_MS`` in
+    HA_RTTS_MS and ``TORCHFT_WIRE_RPC=1``, pricing one first-byte RTT on
+    every Python coordination RPC round trip — the client-visible share
+    of lease/election cost under WAN (the native peers' own lease
+    exchanges are in-process and unshaped; docs/observability.md
+    ``TORCHFT_WIRE_RPC``).  docs/architecture.md "Coordination-plane
+    HA"."""
+    import os as _os
+
+    prior = {
+        k: _os.environ.get(k)
+        for k in ("TORCHFT_WIRE_RTT_MS", "TORCHFT_WIRE_RPC",
+                  "TORCHFT_TOPOLOGY")
+    }
+    _os.environ.pop("TORCHFT_TOPOLOGY", None)  # flat: every RPC is WAN
+    _os.environ["TORCHFT_WIRE_RPC"] = "1"
+    wan: "Dict[str, Any]" = {}
+    try:
+        for rtt in HA_RTTS_MS:
+            _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
+            n = HA_TRIALS if rtt == 0.0 else max(HA_TRIALS - 1, 1)
+            wan[f"rtt_{int(rtt)}ms"] = _ha_failover_trials(
+                n, f"r{int(rtt)}_"
+            )
+            log(
+                f"ha failover rtt={rtt}ms: p50 "
+                f"{wan[f'rtt_{int(rtt)}ms']['kill_to_quorum_p50_s']}s"
+            )
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    base = wan.get("rtt_0ms", {})
+    return {
+        "peers": HA_PEERS,
+        "lease_ms": HA_LEASE_MS,
+        **base,
+        "wan": {
+            leg: {
+                "kill_to_quorum_p50_s": d.get("kill_to_quorum_p50_s"),
+                "kill_to_quorum_max_s": d.get("kill_to_quorum_max_s"),
+            }
+            for leg, d in sorted(wan.items())
+        },
     }
 
 
@@ -2091,6 +2331,26 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         )
         if ha.get(k) is not None
     } or None
+    # WAN-shaped HA legs (ISSUE 14 satellite): kill->quorum p50 per RTT
+    ha_wan = {
+        leg: d.get("kill_to_quorum_p50_s")
+        for leg, d in sorted((ha.get("wan") or {}).items())
+        if isinstance(d, dict)
+    }
+    if ha_compact is not None and ha_wan:
+        ha_compact["wan_p50_s"] = ha_wan
+    sdepth = result.get("serving_depth") or {}
+    serving_depth_compact = {
+        k: sdepth.get(k)
+        for k in (
+            "d3_rtt50_speedup_x",
+            "d3_rtt50_flat_p50_ms",
+            "d3_rtt50_stream_p50_ms",
+            "d3_rtt50_delta_p50_ms",
+            "winner",
+        )
+        if sdepth.get(k) is not None
+    } or None
     serving_compact = {
         k: serving.get(k)
         for k in (
@@ -2135,6 +2395,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # serving-tier headline (ISSUE 12): sustained checkpoints/sec +
         # p99 fetch under churn + the post-failover bitwise verdict
         "serving": serving_compact,
+        # streaming-relay headline (ISSUE 14): publish->leaf at depth 3 /
+        # 50 ms RTT, cut-through vs store-and-forward + the delta row
+        "serving_depth": serving_depth_compact,
         # coordination-plane HA headline (ISSUE 13): leader-kill -> next
         # formed quorum latency + the monotonicity verdicts
         "ha": ha_compact,
@@ -2165,7 +2428,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
-        "ha", "serving",
+        "ha", "serving", "serving_depth",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2211,9 +2474,22 @@ def main() -> None:
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
         return
+    if "--serving-depth" in sys.argv:
+        # `make bench-serving-depth`: the streaming-relay depth axis
+        # alone (flat vs cut-through publish->leaf at depth x RTT), with
+        # the compact tail (same last-line contract as the full run)
+        sdepth = bench_serving_depth()
+        result = {
+            "metric": "serving_publish_to_leaf_latency",
+            "serving_depth": sdepth,
+        }
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
     if "--ha-failover" in sys.argv:
-        # `make bench-ha`: the coordination-plane failover leg alone,
-        # with the compact tail (same last-line contract as the full run)
+        # `make bench-ha`: the coordination-plane failover leg alone
+        # (incl. the WAN-shaped RTT legs), with the compact tail (same
+        # last-line contract as the full run)
         ha = bench_ha()
         result = {"metric": "ha_leader_failover", "ha": ha}
         print(json.dumps(result), flush=True)
@@ -2304,6 +2580,13 @@ def main() -> None:
         log(f"serving bench failed: {e!r}")
         serving = {"error": repr(e)}
     try:
+        # streaming-relay depth axis (ISSUE 14): publish->leaf flat vs
+        # cut-through at depth {1,2,3} x RTT {0,10,50} ms
+        serving_depth = bench_serving_depth()
+    except Exception as e:  # noqa: BLE001
+        log(f"serving depth bench failed: {e!r}")
+        serving_depth = {"error": repr(e)}
+    try:
         # coordination-plane HA: leader-kill -> next-quorum latency over
         # a replicated lighthouse (ISSUE 13)
         ha = bench_ha()
@@ -2322,6 +2605,7 @@ def main() -> None:
         "wan": wan,
         "switch": switch,
         "serving": serving,
+        "serving_depth": serving_depth,
         "ha": ha,
     }
     print(json.dumps(result), flush=True)

@@ -20,6 +20,7 @@ from torchft_tpu.serving import (
     changed_fragments,
     decode_payload,
     encode_payload,
+    fetch_resource,
 )
 from torchft_tpu.utils import faults as _faults
 
@@ -504,6 +505,448 @@ class TestServingChaos:
         finally:
             _faults.FAULTS.clear()
             pub.shutdown()
+            lh.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# streaming relay (ISSUE 14): cut-through, delta relay pulls, zero-decode
+# passthrough, poisoned-fragment integrity, deep-tree chaos
+# ---------------------------------------------------------------------------
+
+
+def _chain_tier(n_relays, fragments=4, wire="f32", stream=None,
+                poll=0.02):
+    """fanout=1 lighthouse + publisher + a CHAIN of n relays (depth
+    0..n-1): the deep-tree shape the cut-through path exists for."""
+    lh = LighthouseServer(
+        min_replicas=1, heartbeat_timeout_ms=1500, quorum_tick_ms=50,
+        serving_fanout=1,
+    )
+    pub = WeightPublisher(
+        lh.address(), wire=wire, fragments=fragments,
+        heartbeat_interval=0.05,
+    )
+    reps = [
+        ServingReplica(
+            lh.address(), replica_id=f"chain{i}", poll_interval=poll,
+            fetch_timeout=10.0, stream=stream,
+        )
+        for i in range(n_relays)
+    ]
+    return lh, pub, reps
+
+
+def _teardown(lh, pub, reps):
+    for r in reps:
+        try:
+            r.shutdown()
+        except Exception:  # noqa: BLE001 - some are killed by the test
+            pass
+    pub.shutdown()
+    lh.shutdown()
+
+
+class TestStreamingRelay:
+    def test_chain_converges_bitwise_and_decode_stays_manifest_only(self):
+        """Depth-3 chain on the streaming path: every relay ends up
+        serving bitwise-identical raw fragment bytes (zero-decode
+        passthrough — the relay never re-encodes), and the relay decode
+        histogram's stream leg stays manifest-sized (~0) while a flat
+        pull decodes the whole payload."""
+        from torchft_tpu.serving import fetcher as _fetcher
+        from torchft_tpu.utils import metrics as _m
+        from torchft_tpu.utils.bufpool import POOL
+
+        dec0 = _m.SERVING_RELAY_DECODE.labels(mode="stream").get()
+        lh, pub, reps = _chain_tier(3, fragments=4, wire="int8")
+        try:
+            sd = _state(40)
+            v = pub.publish(sd)
+            _wait_until(
+                lambda: all(r.version() == v for r in reps),
+                msg="chain converged",
+            )
+            # depth really is a chain
+            plan = LighthouseClient(lh.address()).serving_plan()
+            assert sorted(n["depth"] for n in plan["nodes"]) == [0, 1, 2]
+            # passthrough: the raw fragment bytes on every relay are the
+            # PUBLISHER'S bytes, verbatim
+            man = fetch_resource(
+                pub.address(), v, "frag_manifest", timeout=10
+            )
+            for name in man["fragments"]:
+                src = _fetcher.fetch_raw(
+                    pub.address(), v, f"frag_{name}", timeout=10
+                )
+                want = bytes(memoryview(src))
+                POOL.give(src)
+                for r in reps:
+                    got = _fetcher.fetch_raw(
+                        r.address(), v, f"frag_{name}", timeout=10
+                    )
+                    assert bytes(memoryview(got)) == want, (
+                        f"relay {r.replica_id()} frag {name} not verbatim"
+                    )
+                    POOL.give(got)
+            # relay decode on the streaming path = manifests only: the
+            # 3-relay chain pulled a multi-fragment int8 payload, yet
+            # total decode time stays ~0 (no payload codec pass)
+            dec = _m.SERVING_RELAY_DECODE.labels(mode="stream").get()
+            assert dec["count"] - dec0["count"] >= 3
+            assert dec["sum"] - dec0["sum"] < 0.25
+            # cut-through occupancy gauge was set to a sane value
+            occ = _m.SERVING_CUT_OCCUPANCY.get()
+            assert 0.0 <= occ <= 1.0
+        finally:
+            _teardown(lh, pub, reps)
+
+    def test_relay_delta_pull_moves_only_changed_fragment_bytes(self):
+        """Steady-state relay wire bytes scale with the update delta:
+        a publish changing ONE leaf moves ~one fragment + manifest per
+        relay, not the payload (asserted via
+        torchft_serving_fetch_bytes{role=relay})."""
+        from torchft_tpu.utils import metrics as _m
+
+        lh, pub, reps = _chain_tier(2, fragments=4, wire="f32")
+        try:
+            rng = np.random.RandomState(3)
+            sd = {
+                f"l{i}": rng.randn(256, 32).astype(np.float32)
+                for i in range(4)
+            }
+            payload_bytes = sum(a.nbytes for a in sd.values())
+            v1 = pub.publish(sd)
+            _wait_until(
+                lambda: all(r.version() == v1 for r in reps),
+                msg="v1 converged",
+            )
+            b0 = _m.SERVING_FETCH_BYTES.labels(role="relay").get()
+            sd2 = dict(sd)
+            sd2["l0"] = sd["l0"] + 1.0
+            v2 = pub.publish(sd2)
+            _wait_until(
+                lambda: all(r.version() == v2 for r in reps),
+                msg="v2 converged",
+            )
+            moved = _m.SERVING_FETCH_BYTES.labels(role="relay").get() - b0
+            # 2 relays x (manifest + 1 changed fragment of 4): well under
+            # one full payload, let alone two
+            assert moved < payload_bytes, (
+                f"delta relay pull moved {moved} bytes "
+                f">= payload {payload_bytes}"
+            )
+            # and the content is right everywhere
+            state, _, _ = decode_payload(
+                fetch_resource(reps[-1].address(), v2, "full", timeout=10)
+            )
+            np.testing.assert_array_equal(state["l0"], sd2["l0"])
+            np.testing.assert_array_equal(state["l1"], sd["l1"])
+        finally:
+            _teardown(lh, pub, reps)
+
+    def test_flat_mode_roundtrip_still_works(self):
+        """TORCHFT_SERVING_STREAM=0 (stream=False) keeps the whole-
+        payload store-and-forward path functional — the depth-bench
+        baseline — and its decode histogram leg is NON-zero."""
+        from torchft_tpu.utils import metrics as _m
+
+        dec0 = _m.SERVING_RELAY_DECODE.labels(mode="flat").get()
+        lh, pub, reps = _chain_tier(2, fragments=2, wire="int8",
+                                    stream=False)
+        try:
+            sd = _state(41)
+            v = pub.publish(sd)
+            _wait_until(
+                lambda: all(r.version() == v for r in reps),
+                msg="flat chain converged",
+            )
+            state, _, _ = decode_payload(
+                fetch_resource(reps[-1].address(), v, "full", timeout=10)
+            )
+            np.testing.assert_array_equal(
+                state["w"], _int8_roundtrip(sd["w"])
+            )
+            dec = _m.SERVING_RELAY_DECODE.labels(mode="flat").get()
+            assert dec["count"] - dec0["count"] >= 2
+        finally:
+            _teardown(lh, pub, reps)
+
+    def test_torn_version_never_serves_whole_document(self):
+        """Cut-through safety at the transport: while a version streams
+        in, staged fragments serve individually but full/metadata 503
+        (retryable) — a torn payload can never be read whole."""
+        import urllib.error
+        import urllib.request
+
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        tr = HTTPTransport(timeout=5.0)
+        try:
+            doc = encode_payload(_state(42), 7, fragments=2)
+            manifest = doc["frag:manifest"]
+            tr.begin_streamed_checkpoint(7, {"frag:manifest": manifest})
+            tr.stage_streamed_part(7, "frag:0", doc["frag:0"])
+            base = tr.metadata()
+            # staged fragment serves mid-stream (this IS cut-through)
+            raw = urllib.request.urlopen(
+                f"{base}/checkpoint/7/frag_0", timeout=5
+            ).read()
+            assert raw == doc["frag:0"]
+            # missing fragment: retryable 503, not 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/checkpoint/7/frag_1", timeout=5
+                )
+            assert ei.value.code == 503
+            # whole-document reads refuse the torn version
+            for what in ("full", "metadata"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"{base}/checkpoint/7/{what}", timeout=5
+                    )
+                assert ei.value.code == 503, what
+            tr.stage_streamed_part(7, "frag:1", doc["frag:1"])
+            tr.finish_streamed_checkpoint(7)
+            got = urllib.request.urlopen(
+                f"{base}/checkpoint/7/full", timeout=5
+            )
+            assert got.status == 200
+            # complete document: an unknown fragment is back to 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/checkpoint/7/frag_9", timeout=5
+                )
+            assert ei.value.code == 404
+        finally:
+            tr.shutdown()
+
+
+class TestRelayIntegrity:
+    def _poisoned_pair(self, version=1):
+        """Two standalone staged sources for one version: POISONED (one
+        fragment's bytes flipped, manifest digests untouched) and GOOD."""
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        sd = _state(50)
+        doc = encode_payload(sd, version, fragments=2)
+        bad = dict(doc)
+        raw = bytearray(doc["frag:0"])
+        raw[-1] ^= 0xFF
+        bad["frag:0"] = bytes(raw)
+        poisoned = HTTPTransport(timeout=5.0)
+        poisoned.send_checkpoint([], version, bad, timeout=5)
+        good = HTTPTransport(timeout=5.0)
+        good.send_checkpoint([], version, doc, timeout=5)
+        return sd, doc, poisoned, good
+
+    def test_poisoned_fragment_refetched_from_other_source(self):
+        """Digest mismatch on a relayed fragment = dead source: the pull
+        fails over and completes from a good source, and the poisoned
+        bytes are NEVER staged or served."""
+        lh = LighthouseServer(
+            min_replicas=1, heartbeat_timeout_ms=1500, quorum_tick_ms=50
+        )
+        sd, doc, poisoned, good = self._poisoned_pair()
+        rep = ServingReplica(
+            lh.address(), replica_id="victim", poll_interval=5.0,
+            fetch_timeout=8.0,
+        )
+        try:
+            rep._parent = poisoned.metadata()
+            rep._root_source = good.metadata()
+            rep._pull(1)
+            assert rep.version() == 1
+            # served fragment bytes are the GOOD ones
+            from torchft_tpu.serving import fetcher as _fetcher
+            from torchft_tpu.utils.bufpool import POOL
+
+            buf = _fetcher.fetch_raw(rep.address(), 1, "frag_0", timeout=5)
+            got = bytes(memoryview(buf))
+            POOL.give(buf)
+            assert got == doc["frag:0"]
+            state, _, _ = decode_payload(
+                fetch_resource(rep.address(), 1, "full", timeout=5)
+            )
+            np.testing.assert_array_equal(state["w"], sd["w"])
+        finally:
+            rep.shutdown()
+            poisoned.shutdown()
+            good.shutdown()
+            lh.shutdown()
+
+    def test_poisoned_only_source_never_stages(self):
+        """With no clean source, the pull fails loudly and the relay
+        keeps advertising nothing — children polling the fragment get
+        503s, never poisoned bytes."""
+        import urllib.error
+        import urllib.request
+
+        lh = LighthouseServer(
+            min_replicas=1, heartbeat_timeout_ms=1500, quorum_tick_ms=50
+        )
+        _sd, _doc, poisoned, good = self._poisoned_pair()
+        good.shutdown()  # only the poisoned source remains
+        rep = ServingReplica(
+            lh.address(), replica_id="victim2", poll_interval=5.0,
+            fetch_timeout=2.0,
+        )
+        try:
+            rep._parent = poisoned.metadata()
+            rep._root_source = ""
+            with pytest.raises(ConnectionError):
+                rep._pull(1)
+            assert rep.version() == 0
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{rep.address()}/checkpoint/1/frag_0", timeout=5
+                )
+            assert ei.value.code == 503
+        finally:
+            rep.shutdown()
+            poisoned.shutdown()
+            lh.shutdown()
+
+
+class TestDeepTreeChaos:
+    def test_depth3_kill_interior_mid_stream_bitwise(self):
+        """Depth-3 chaos variant of the tree test: an INTERIOR relay is
+        killed while the cut-through stream is in flight (serving.frag
+        delay stretches it); the chain re-forms, every concurrent client
+        completes bitwise-identical, and the leaf still converges."""
+        lh, pub, reps = _chain_tier(3, fragments=6, wire="int8",
+                                    poll=0.02)
+        try:
+            sd0 = _state(60)
+            v0 = pub.publish(sd0)
+            _wait_until(
+                lambda: all(r.version() == v0 for r in reps),
+                msg="warm converge",
+            )
+            plan = LighthouseClient(lh.address()).serving_plan()
+            interior = [
+                n for n in plan["nodes"] if 0 < n["depth"] < 2
+            ][0]
+            victim = next(
+                r for r in reps if r.replica_id() == interior["replica_id"]
+            )
+            # stretch every fragment fetch so the kill lands mid-stream
+            _faults.FAULTS.configure(
+                [_faults.FaultRule(site="serving.frag", action="delay",
+                                   delay=0.08, times=-1)],
+                seed=11,
+            )
+            sd1 = _state(61)
+            expected = {
+                k: (_int8_roundtrip(a) if isinstance(a, np.ndarray) else a)
+                for k, a in sd1.items()
+            }
+            results = {}
+
+            def _fetch(i):
+                try:
+                    c = ServingClient(
+                        lh.address(), plan_ttl=0.1, client_id=f"deep{i}"
+                    )
+                    state, got = c.fetch(version=v0 + 1, timeout=45)
+                    c.close()
+                    results[i] = (state, got)
+                except Exception as e:  # noqa: BLE001 - asserted below
+                    results[i] = e
+
+            v1 = pub.publish(sd1)
+            threads = [
+                threading.Thread(target=_fetch, args=(i,), daemon=True)
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.15)  # the stream is mid-flight (6 x 80 ms/hop)
+            victim.shutdown()
+            for t in threads:
+                t.join(timeout=90)
+                assert not t.is_alive(), "client fetch wedged"
+            _faults.FAULTS.clear()
+            for i, res in results.items():
+                assert not isinstance(res, Exception), f"client {i}: {res}"
+                state, got = res
+                assert got == v1
+                np.testing.assert_array_equal(state["w"], expected["w"])
+                np.testing.assert_array_equal(state["b"], expected["b"])
+            # survivors (root + leaf) converge to v1 despite the corpse
+            survivors = [r for r in reps if r is not victim]
+            _wait_until(
+                lambda: all(r.version() >= v1 for r in survivors),
+                timeout=30, msg="survivors converged past the kill",
+            )
+        finally:
+            _faults.FAULTS.clear()
+            _teardown(lh, pub, reps)
+
+
+class TestClientDeterminism:
+    def test_rotation_stable_across_processes(self):
+        """Source rotation must not depend on PYTHONHASHSEED: the seed
+        is a sha256 digest of the client id (pinned literal), so a
+        restarted client lands on the same leaf."""
+        import hashlib
+
+        lh = LighthouseServer(min_replicas=1)
+        try:
+            a = ServingClient(lh.address(), client_id="client_a")
+            b = ServingClient(lh.address(), client_id="client_a")
+            c = ServingClient(lh.address(), client_id="client_b")
+            want = int.from_bytes(
+                hashlib.sha256(b"client_a").digest()[:8], "big"
+            )
+            assert a._rot == b._rot == want
+            assert c._rot != a._rot
+            for cl in (a, b, c):
+                cl.close()
+        finally:
+            lh.shutdown()
+
+    def test_frag_drop_absorbed_by_poll_policy(self):
+        """The documented serving.frag contract: an injected drop takes
+        the broken-connection path INSIDE the 503-poll policy and is
+        retried within the budget — the fetch still completes."""
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+        from torchft_tpu.serving import fetcher as _fetcher
+        from torchft_tpu.utils.bufpool import POOL
+
+        tr = HTTPTransport(timeout=5.0)
+        try:
+            doc = encode_payload(_state(70), 1, fragments=2)
+            tr.send_checkpoint([], 1, doc, timeout=5)
+            _faults.FAULTS.configure(
+                [_faults.FaultRule(site="serving.frag", action="drop",
+                                   times=1)],
+                seed=2,
+            )
+            buf = _fetcher.fetch_raw(tr.metadata(), 1, "frag_0", timeout=10)
+            assert bytes(memoryview(buf)) == doc["frag:0"]
+            POOL.give(buf)
+            assert _faults.FAULTS.injected("serving.frag") == 1
+        finally:
+            _faults.FAULTS.clear()
+            tr.shutdown()
+
+    def test_exhausted_budget_never_goes_negative(self):
+        """Satellite regression: the delta manifest fetch clamps its
+        deadline — an exhausted budget surfaces as a timeout/connection
+        error, never a negative-timeout ValueError from the socket
+        layer."""
+        lh = LighthouseServer(min_replicas=1)
+        try:
+            client = ServingClient(lh.address())
+            client._held = ({"fragments": [], "digests": {},
+                             "num_leaves": 0}, {})
+            client._held_version = 1
+            with pytest.raises((TimeoutError, ConnectionError, OSError)):
+                client._fetch_from(
+                    "http://127.0.0.1:9", 2, budget=-1.0, delta=True
+                )
+            client.close()
+        finally:
             lh.shutdown()
 
 

@@ -67,15 +67,6 @@ class TestWireShaperUnits:
         elapsed = time.monotonic() - t0
         assert elapsed >= (8 << 20) / 1e9 * 0.8
 
-    def test_payload_nbytes_counts_array_and_bytes_leaves(self):
-        doc = {
-            "a": np.zeros(1000, dtype=np.float32),
-            "nested": [b"xyz", {"c": np.zeros(10, dtype=np.int8)}],
-            "meta": "ignored",
-            "n": 7,
-        }
-        assert _wire.payload_nbytes(doc) == 4000 + 3 + 10
-
     def test_get_shaper_tracks_env(self, monkeypatch):
         monkeypatch.setenv("TORCHFT_WIRE_RTT_MS", "0")
         monkeypatch.setenv("TORCHFT_WIRE_GBPS", "0")

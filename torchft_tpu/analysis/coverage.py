@@ -53,6 +53,10 @@ _FLIGHT_ANCHORS: "Tuple[Tuple[str, str], ...]" = (
     ("checkpointing/http_transport.py", "recv_checkpoint"),
     ("checkpointing/pg_transport.py", "send_checkpoint"),
     ("checkpointing/pg_transport.py", "recv_checkpoint"),
+    # the serving tier's streaming data path (ISSUE 14): every raw
+    # fragment fetch and every relay pull must stay post-mortem-visible
+    ("serving/fetcher.py", "fetch_raw"),
+    ("serving/replica.py", "_pull"),
 )
 
 _FLIGHT_CALLS = ("record", "start", "track", "dump", "update", "add_bytes", "finish")

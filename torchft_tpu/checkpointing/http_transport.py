@@ -56,6 +56,35 @@ _FETCH_POLICY = RetryPolicy(
 )
 
 
+class _Staged:
+    """One staged snapshot slot.
+
+    ``complete=False`` is the serving tier's CUT-THROUGH state: the
+    document is still streaming in fragment by fragment
+    (``stage_streamed_part``).  While incomplete, a missing ``frag_*``
+    resource is a retryable 503 (the child/client polls until the relay
+    stages it — that IS the cut-through overlap) and whole-document
+    resources (``full``/``metadata``/``chunk_*``) 503 too: a torn
+    version must never serve.  ``pooled`` tracks bufpool-backed buffers
+    this slot owns; they return to the pool when the slot is retired.
+    """
+
+    __slots__ = ("sd", "num_chunks", "complete", "pooled")
+
+    def __init__(self, sd: Any, num_chunks: int = 1, complete: bool = True):
+        self.sd = sd
+        self.num_chunks = num_chunks
+        self.complete = complete
+        self.pooled: "List[Any]" = []
+
+    def release(self) -> None:
+        from torchft_tpu.utils.bufpool import POOL
+
+        for buf in self.pooled:
+            POOL.give(buf)
+        self.pooled = []
+
+
 class _HTTPServerIPv6(ThreadingHTTPServer):
     address_family = socket.AF_INET6
     daemon_threads = True
@@ -71,12 +100,42 @@ def _make_server() -> ThreadingHTTPServer:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Idle keep-alive reap: persistent fetcher connections (serving tier,
+    # serving/fetcher.py) would otherwise pin one server thread each for
+    # the life of the client; a timed-out WAIT for the next request
+    # closes the connection.  Scoped to the between-requests wait only
+    # (re-armed below, disarmed before serving): an in-flight response
+    # body — a multi-GB heal stream stalling on a congested link — must
+    # block like it always did, not die at the idle timeout.
+    timeout = 30.0
     transport: "HTTPTransport"  # injected per-server subclass attr
+
+    def handle_one_request(self) -> None:
+        self.connection.settimeout(self.timeout)
+        super().handle_one_request()
 
     def log_message(self, fmt: str, *args: Any) -> None:  # quiet
         logger.debug("http: " + fmt, *args)
 
+    def _retry_later(self, message: str) -> None:
+        # Retryable 503 WITHOUT closing the connection (``send_error``
+        # sends ``Connection: close``): the cut-through pollers re-ask
+        # the same keep-alive connection every few ms — a reconnect per
+        # poll would dominate the poll itself at WAN RTTs.
+        body = message.encode("utf-8", "replace")
+        self.send_response(503, "retry later")
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
+        # request received: the idle-reap timeout must not bound the
+        # serve itself (see class docstring; re-armed per request above)
+        self.connection.settimeout(None)
         transport = self.server.transport  # type: ignore[attr-defined]
         parts = self.path.strip("/").split("/")
         # /checkpoint/{step}/{what}
@@ -89,6 +148,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(400, "bad step")
             return
         what = parts[2]
+        if what.startswith("frag_"):
+            # Cut-through long-poll: when the step is STREAMING in and
+            # this fragment hasn't landed yet, block briefly server-side
+            # until the relay stages it — a child's fragment request
+            # then costs one round trip, not a client poll loop whose
+            # backoff would add dead time between fragment arrivals.
+            # Returns immediately for complete/absent steps (those take
+            # the plain 404/503 paths below).  Its read-lock timeout
+            # maps to the same retryable busy-503 every other lock
+            # timeout in this request takes, never an unhandled raise.
+            try:
+                transport.await_streamed_part(
+                    step, f"frag:{what[len('frag_'):]}", max_wait=0.25
+                )
+            except TimeoutError:
+                self.send_error(503, "checkpoint busy")
+                return
         try:
             # Hold the read lock for the whole serve so the snapshot can't be
             # retired mid-stream (reference http_transport.py:77-131).
@@ -98,12 +174,20 @@ class _Handler(BaseHTTPRequestHandler):
                     # Healer raced the sender's staging: retryable 503 (the
                     # receiver polls until its deadline). Permanent problems
                     # (bad path, chunk out of range) stay 404 and fail fast.
-                    self.send_error(
-                        503,
-                        f"no checkpoint staged for step {step}",
+                    self._retry_later(
+                        f"no checkpoint staged for step {step}"
                     )
                     return
-                state_dict, num_chunks = staged
+                state_dict, num_chunks = staged.sd, staged.num_chunks
+                raw: "Optional[memoryview]" = None
+                if not staged.complete and not what.startswith("frag_"):
+                    # A streaming (cut-through) slot serves ONLY its
+                    # staged fragments: a whole-document read of a torn
+                    # version must never complete — poll until finished.
+                    self._retry_later(
+                        f"step {step} is still streaming in"
+                    )
+                    return
                 if what == "full":
                     indices = None
                 elif what == "metadata":
@@ -117,16 +201,27 @@ class _Handler(BaseHTTPRequestHandler):
                     indices = chunks[idx]
                 elif what.startswith("frag_"):
                     # Version-keyed fragment serving (serving/ tier): the
-                    # staged doc maps "frag:<name>" to one fragment's
-                    # sub-dict; serve exactly that fragment so delta
-                    # updates move one fragment, not the checkpoint.  A
-                    # missing fragment name is a permanent 404 (the
-                    # staged manifest names every fragment), distinct
-                    # from the retryable not-yet-staged 503 above.
+                    # staged doc maps "frag:<name>" to one fragment.  A
+                    # fragment staged as raw wire bytes (publisher encode
+                    # or relay cut-through passthrough) is served
+                    # VERBATIM — no serialize pass, Content-Length is the
+                    # buffer length; a decoded sub-dict takes the pytree
+                    # path.  A missing name on a COMPLETE document is a
+                    # permanent 404 (the staged manifest names every
+                    # fragment); on a streaming document it is the
+                    # retryable not-yet-relayed 503 — that poll IS the
+                    # cut-through overlap.
                     frag = state_dict.get(f"frag:{what[len('frag_'):]}")
                     if frag is None:
-                        self.send_error(404, "unknown fragment")
+                        if not staged.complete:
+                            self._retry_later(
+                                f"fragment {what} of step {step} not "
+                                f"relayed yet"
+                            )
+                        else:
+                            self.send_error(404, "unknown fragment")
                         return
+                    raw = ser.raw_view(frag)
                     state_dict = frag
                     indices = None
                 elif what.startswith("part_"):
@@ -150,7 +245,18 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 # Stream straight to the socket: no materialized copy per
                 # fetcher (multi-GB state dicts, N concurrent healers).
-                total, writer = ser.prepare(state_dict, chunk_indices=indices)
+                # Raw passthrough fragments skip the serialize pass
+                # entirely — the relay's verified bytes go out verbatim.
+                if raw is not None:
+                    total = len(raw)
+
+                    def writer(out: Any, _raw: memoryview = raw) -> None:
+                        out.write(_raw)
+
+                else:
+                    total, writer = ser.prepare(
+                        state_dict, chunk_indices=indices
+                    )
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(total))
@@ -236,12 +342,15 @@ class HTTPTransport(CheckpointTransport[Any]):
         # derived from the layout epoch so it survives the per-step heal
         # retirement until the switch commits or rolls back.  Bounded:
         # oldest slots are evicted past _MAX_STAGED.
-        self._staged: "dict[int, tuple[Any, int]]" = {}
+        self._staged: "dict[int, _Staged]" = {}
         # writer_priority: staging/retirement must acquire in bounded
         # time even under a dense fetch storm (the serving tier's
         # 503-polling clients keep the read side continuously occupied —
         # a reader-preferring lock starves the stager forever).
         self._staged_lock = RWLock(timeout=timeout, writer_priority=True)
+        # wakes fragment long-pollers (await_streamed_part) whenever the
+        # staged set changes — never held together with _staged_lock
+        self._stream_cond = threading.Condition()
         self._server = _make_server()
         self._server.transport = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
@@ -273,13 +382,132 @@ class HTTPTransport(CheckpointTransport[Any]):
             lambda x: np.asarray(x) if hasattr(x, "__array__") else x, state_dict
         )
         with self._staged_lock.w_lock(timeout=timeout):
-            self._staged[step] = (host_sd, max(self._num_chunks, 1))
-            while len(self._staged) > self._max_staged:
-                self._staged.pop(next(iter(self._staged)))
+            self._put_locked(step, _Staged(host_sd, max(self._num_chunks, 1)))
+        self._wake_stream_waiters()
         _flightrec.record(
             "checkpoint.http.stage", start_ns=t0_ns, step=step,
             dst_ranks=list(dst_ranks),
         )
+
+    def _put_locked(self, step: int, staged: _Staged) -> None:
+        old = self._staged.pop(step, None)
+        if old is not None:
+            old.release()
+        self._staged[step] = staged
+        while len(self._staged) > self._max_staged:
+            self._staged.pop(next(iter(self._staged))).release()
+
+    # -- per-fragment (cut-through) staging ---------------------------------
+    #
+    # The serving tier's streaming relay (serving/replica.py, ISSUE 14)
+    # stages one version FRAGMENT BY FRAGMENT: children and clients poll
+    # ``frag_<name>`` and get each fragment the moment it lands (503
+    # while missing), while whole-document reads 503 until the version
+    # is finished — cut-through can never serve a torn version.
+
+    def _wake_stream_waiters(self) -> None:
+        with self._stream_cond:
+            self._stream_cond.notify_all()
+
+    def await_streamed_part(
+        self, step: int, key: str, max_wait: float
+    ) -> None:
+        """Server-side fragment long-poll: block up to ``max_wait``
+        while the slot for ``step`` is STREAMING and ``key`` has not
+        landed.  Returns immediately for absent/complete slots and when
+        the part arrives — the caller re-reads state under the lock and
+        takes the normal serve/503/404 path."""
+        deadline = time.monotonic() + max_wait
+        while True:
+            with self._staged_lock.r_lock(timeout=self._lock_timeout):
+                staged = self._staged.get(step)
+                if staged is None or staged.complete or key in staged.sd:
+                    return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            with self._stream_cond:
+                self._stream_cond.wait(min(remaining, 0.05))
+
+    def begin_streamed_checkpoint(
+        self, step: int, state_dict: Any, timeout: "Optional[float]" = None
+    ) -> None:
+        """Stage an INCOMPLETE document (normally just the manifest);
+        fragments arrive via :meth:`stage_streamed_part`."""
+        with self._staged_lock.w_lock(timeout=timeout or self._lock_timeout):
+            self._put_locked(step, _Staged(dict(state_dict), 1, complete=False))
+        self._wake_stream_waiters()
+
+    def stage_streamed_part(
+        self,
+        step: int,
+        key: str,
+        value: Any,
+        pooled: bool = False,
+        timeout: "Optional[float]" = None,
+    ) -> None:
+        """Add one part (``frag:<name>`` -> raw wire bytes) to a
+        streaming slot.  ``pooled=True`` transfers ownership of a
+        bufpool-backed buffer to the slot (returned to the pool on
+        retirement).  Raises ``KeyError`` when the slot was evicted
+        mid-stream (version window overrun by newer publishes)."""
+        with self._staged_lock.w_lock(timeout=timeout or self._lock_timeout):
+            staged = self._staged.get(step)
+            if staged is None:
+                raise KeyError(
+                    f"streamed staging slot for step {step} was evicted"
+                )
+            staged.sd[key] = value
+            if pooled:
+                staged.pooled.append(value)
+        self._wake_stream_waiters()
+
+    def finish_streamed_checkpoint(
+        self, step: int, timeout: "Optional[float]" = None
+    ) -> None:
+        """Mark a streaming slot complete: whole-document reads serve."""
+        with self._staged_lock.w_lock(timeout=timeout or self._lock_timeout):
+            staged = self._staged.get(step)
+            if staged is None:
+                raise KeyError(
+                    f"streamed staging slot for step {step} was evicted"
+                )
+            staged.complete = True
+        self._wake_stream_waiters()
+
+    def streamed_parts(self, step: int) -> "Optional[set]":
+        """Part keys of a still-streaming slot (``None`` when absent or
+        already complete) — lets an interrupted relay pull RESUME from
+        the fragments it already verified instead of refetching."""
+        with self._staged_lock.r_lock(timeout=self._lock_timeout):
+            staged = self._staged.get(step)
+            if staged is None or staged.complete:
+                return None
+            return set(staged.sd)
+
+    def copy_staged_part(
+        self, step: int, key: str, timeout: "Optional[float]" = None
+    ) -> "Optional[Any]":
+        """Pooled copy of one raw part of a COMPLETE staged document
+        (``None`` when absent or not raw wire bytes) — the delta relay
+        pull reuses unchanged fragments from version v-1 without wire.
+        A copy, not a shared reference: the source slot may retire (and
+        return ITS buffer to the pool) while the new slot still serves.
+        """
+        import numpy as np
+
+        from torchft_tpu.utils.bufpool import POOL
+
+        with self._staged_lock.r_lock(timeout=timeout or self._lock_timeout):
+            staged = self._staged.get(step)
+            if staged is None or not staged.complete:
+                return None
+            raw = ser.raw_view(staged.sd.get(key))
+            if raw is None:
+                return None
+            buf = POOL.take(len(raw), np.uint8)
+            buf[:] = np.frombuffer(raw, dtype=np.uint8)
+            return buf
 
     def recv_checkpoint(
         self,
@@ -392,13 +620,18 @@ class HTTPTransport(CheckpointTransport[Any]):
         stays until its switch commits/rolls back — peers may still be
         mid-fetch when this group's step commits."""
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
-            self._staged = {k: v for k, v in self._staged.items() if k < 0}
+            for k in [k for k in self._staged if k >= 0]:
+                self._staged.pop(k).release()
+        self._wake_stream_waiters()
 
     def retire_checkpoint(self, step: int) -> None:
         """Drop one staged snapshot (the reshard slots' explicit
         retirement path); no-op when absent."""
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
-            self._staged.pop(step, None)
+            staged = self._staged.pop(step, None)
+            if staged is not None:
+                staged.release()
+        self._wake_stream_waiters()
 
     def staged_steps(self) -> "List[int]":
         """Step/version keys currently staged (insertion order — the

@@ -99,6 +99,24 @@ def num_leaves(state_dict: Any) -> int:
     return len(jax.tree_util.tree_flatten(state_dict)[0])
 
 
+def raw_view(value: Any) -> "Optional[memoryview]":
+    """Memoryview of a value that is ALREADY serialized wire bytes
+    (``bytes``/``bytearray``/contiguous ``uint8`` ndarray — the serving
+    tier's zero-decode passthrough forms), ``None`` otherwise."""
+    if isinstance(value, (bytes, bytearray)):
+        return memoryview(value)
+    if isinstance(value, memoryview):
+        return value
+    if (
+        isinstance(value, np.ndarray)
+        and value.dtype == np.uint8
+        and value.ndim == 1
+        and value.flags.c_contiguous
+    ):
+        return memoryview(value)
+    return None
+
+
 def _read_exact(src: BinaryIO, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:

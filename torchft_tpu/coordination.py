@@ -33,6 +33,7 @@ from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
+from torchft_tpu.utils.env import env_bool
 from torchft_tpu.utils.retry import RetryPolicy
 
 __all__ = [
@@ -312,6 +313,19 @@ class _RpcClient:
         # is off or the step is unsampled — the disabled path is one
         # module-global check (budget-tested in tests/test_tracing.py).
         traceparent = _tracing.current_traceparent()
+        # Opt-in WAN realism for coordination RPCs (TORCHFT_WIRE_RPC=1):
+        # one serving-wire-model charge per round trip — first-byte RTT
+        # across the TORCHFT_TOPOLOGY boundary (payloads are sub-KB, so
+        # bandwidth debt is noise; nbytes=0 skips the bucket).  Scope:
+        # the Python client side only — native peer-to-peer traffic
+        # (lease exchanges, C++ heartbeats) is in-process and unshaped.
+        # Default off (one env test per call; the bench flips it
+        # mid-process, so it cannot be latched at import); the serving
+        # import resolves lazily only when enabled.
+        if env_bool("TORCHFT_WIRE_RPC", False):
+            from torchft_tpu.serving import wire as _serving_wire
+
+            _serving_wire.get_shaper().charge(self._addr, 0)
         with self._lock:  # tft-lint: allow(lock-discipline)
             for attempt in range(attempts):
                 if self._sock is None:

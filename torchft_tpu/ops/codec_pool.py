@@ -105,16 +105,7 @@ class CodecTrace:
 
     @staticmethod
     def _merged(intervals: "List[Tuple[float, float]]") -> float:
-        total = 0.0
-        end = float("-inf")
-        for t0, t1 in sorted(intervals):
-            if t0 > end:
-                total += t1 - t0
-                end = t1
-            elif t1 > end:
-                total += t1 - end
-                end = t1
-        return total
+        return merged_seconds(intervals)
 
     def busy_seconds(self) -> float:
         """Merged codec-busy wall across all tasks of this collective."""
@@ -123,6 +114,23 @@ class CodecTrace:
     def wire_seconds(self) -> float:
         """Merged wire-busy wall (collective-op execution intervals)."""
         return self._merged(self.wire_intervals)
+
+
+def merged_seconds(intervals: "List[Tuple[float, float]]") -> float:
+    """Total seconds covered by the UNION of (start, end) intervals —
+    concurrent busy windows must not double-count.  Shared by the codec
+    trace (busy/wire walls) and the serving relay's cut-through
+    occupancy gauge."""
+    total = 0.0
+    end = float("-inf")
+    for t0, t1 in sorted(intervals):
+        if t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total
 
 
 def block_bounds(n_rows: int, min_rows: int = MIN_BLOCK_ROWS) -> "List[Tuple[int, int]]":

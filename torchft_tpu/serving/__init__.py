@@ -11,16 +11,25 @@ mid-fetch.  Discovery, health and tree synthesis ride the existing
 lighthouse (``serving_heartbeat`` / ``serving_plan`` RPCs,
 ``/serving.json``); the wire path is the existing HTTP checkpoint
 transport's version-keyed multi-slot staging.
+
+The data path is fragment-streamed (ISSUE 14): relays CUT THROUGH —
+restaging each digest-verified fragment the moment it arrives, pulling
+only digest-changed fragments when they hold the previous version, and
+never decoding payload bytes (``serving/fetcher.py`` +
+``serving/payload.py``; docs/architecture.md "Streaming relay").
 """
 
 from torchft_tpu.serving.client import ServingClient, fetch_resource
+from torchft_tpu.serving.fetcher import FragmentFetcher
 from torchft_tpu.serving.payload import (
     MANIFEST_FRAG,
     WIRE_F32,
     WIRE_INT8,
     changed_fragments,
+    decode_manifest,
     decode_payload,
     encode_payload,
+    verify_fragment,
 )
 from torchft_tpu.serving.publisher import WeightPublisher
 from torchft_tpu.serving.replica import ServingReplica
@@ -29,10 +38,13 @@ __all__ = [
     "WeightPublisher",
     "ServingReplica",
     "ServingClient",
+    "FragmentFetcher",
     "fetch_resource",
     "encode_payload",
     "decode_payload",
+    "decode_manifest",
     "changed_fragments",
+    "verify_fragment",
     "MANIFEST_FRAG",
     "WIRE_F32",
     "WIRE_INT8",

@@ -7,27 +7,30 @@ widest tier — and fails over to siblings/the root source when a server
 dies mid-fetch.  Holding the previous version enables delta fetches:
 manifest + changed fragments only (publisher-computed digests decide).
 
-The fetch itself is plain HTTP against the checkpoint transport's
-``/checkpoint/<version>/<resource>`` surface with the unified retry
-layer polling retryable 503s (version staged but not yet on this node)
-inside each source's budget slice.
+The fetch rides the shared fragment-fetch plane (``serving/fetcher.py``,
+ISSUE 14): persistent HTTP connections against the checkpoint
+transport's ``/checkpoint/<version>/<resource>`` surface, the unified
+retry layer polling retryable 503s (version staged but not yet on this
+node) inside each source's budget slice, and — on the delta path — a
+bounded-parallel pipeline that overlaps digest verify + decode of
+fragment *i* with the wire of fragment *i+1*.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import time
-import urllib.error
-import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from torchft_tpu.checkpointing import serialization as ser
+from torchft_tpu.serving import fetcher as _fetcher
 from torchft_tpu.serving import payload as _payload
-from torchft_tpu.serving import wire as _wire
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
+from torchft_tpu.utils.bufpool import POOL
 from torchft_tpu.utils.env import env_float
 from torchft_tpu.utils.retry import RetryPolicy
 
@@ -35,20 +38,24 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["ServingClient", "fetch_resource"]
 
-# Serving fetch retry: 503 = the version exists fleet-wide but this node
-# has not finished staging it (publisher still encoding, relay still
-# pulling) — poll within the source's budget slice.  Connection errors
-# (server killed mid-fetch) retry here too; budget expiry surfaces so
-# the caller fails over to the next source.
-_FETCH_POLICY = RetryPolicy(
-    name="serving.fetch",
-    base_delay=0.02,
-    multiplier=2.0,
+
+class _NoServableNodes(RuntimeError):
+    """The current plan names zero servable nodes — transient right
+    after a coordination-plane failover (lighthouse serving state is
+    soft; a fresh leader serves an EMPTY plan until the serving fleet's
+    next heartbeats re-register it)."""
+
+
+# Empty-plan poll: re-ask the lighthouse until nodes re-register or the
+# caller's deadline expires.  Connection errors ride too (the plan RPC
+# itself may be walking a mid-election endpoint list).
+_PLAN_POLICY = RetryPolicy(
+    name="serving.plan",
+    base_delay=0.05,
+    multiplier=1.5,
     max_delay=0.5,
-    retry_if=lambda e: (
-        e.code == 503
-        if isinstance(e, urllib.error.HTTPError)
-        else isinstance(e, (urllib.error.URLError, ConnectionError, OSError))
+    retry_if=lambda e: isinstance(
+        e, (_NoServableNodes, ConnectionError, OSError, TimeoutError)
     ),
 )
 
@@ -57,26 +64,13 @@ def fetch_resource(
     base: str, version: int, resource: str, timeout: float
 ) -> Any:
     """Fetch + deserialize one resource of a staged version from a
-    serving node's transport (``full``, ``frag_<name>``, ...)."""
-    traceparent = _tracing.current_traceparent()
-
-    def attempt(budget: "Optional[float]") -> Any:
-        t = max(budget if budget is not None else 0.001, 0.001)
-        req = urllib.request.Request(
-            f"{base}/checkpoint/{version}/{resource}",
-            headers={"traceparent": traceparent} if traceparent else {},
-        )
-        with urllib.request.urlopen(req, timeout=t) as resp:
-            nbytes = int(resp.headers.get("Content-Length") or 0)
-            _metrics.SERVING_FETCH_BYTES.labels(role="client").inc(nbytes)
-            # WAN wire model (serving/wire.py): one RTT + bytes/rate of
-            # bucket debt per fetch message crossing the topology
-            # boundary; zero-cost when unshaped
-            _wire.get_shaper().charge(base, nbytes)
-            skeleton, leaves, n = ser.deserialize_from(resp)
-            return ser.reassemble(skeleton, leaves, n)
-
-    return _FETCH_POLICY.run(attempt, timeout=timeout, op="serving.fetch")
+    serving node's transport (``full``, ``frag_<name>``, ...) — decoded
+    straight off the socket (a multi-GB ``full`` document lands in its
+    final buffers, never a raw intermediate copy)."""
+    skeleton, leaves, n = _fetcher.fetch_serialized(
+        base, version, resource, timeout, role="client"
+    )
+    return ser.reassemble(skeleton, leaves, n)
 
 
 class ServingClient:
@@ -119,7 +113,18 @@ class ServingClient:
             if plan_ttl is not None
             else env_float("TORCHFT_SERVING_PLAN_TTL_S", 2.0, minimum=0.0)
         )
-        self._rot = hash(client_id) if client_id is not None else id(self)
+        # Stable rotation seed: hash() varies per process under
+        # PYTHONHASHSEED, which would land a RESTARTED client on a
+        # different leaf — a sha256 digest keeps the spread deterministic
+        # (tests pin it; anonymous clients still spread by identity).
+        self._rot = (
+            int.from_bytes(
+                hashlib.sha256(str(client_id).encode()).digest()[:8], "big"
+            )
+            if client_id is not None
+            else id(self)
+        )
+        self._frag_fetcher = _fetcher.FragmentFetcher(role="client")
         # non-final sources are capped at the failover bound (a killed
         # server costs seconds, not the fetch deadline)
         self._failover_s = env_float(
@@ -261,7 +266,23 @@ class ServingClient:
         newer version satisfies the caller strictly better."""
         sources = self._sources(plan)
         if not sources:
-            raise RuntimeError("serving plan has no servable nodes")
+            # transient after a lighthouse failover (soft serving state):
+            # poll the plan inside the caller's deadline rather than
+            # failing the fetch while the fleet re-registers
+            def attempt(_budget: "Optional[float]") -> "Tuple[Any, Any]":
+                p = self.plan(refresh=True)
+                s = self._sources(p)
+                if not s:
+                    raise _NoServableNodes(
+                        "serving plan has no servable nodes"
+                    )
+                return p, s
+
+            plan, sources = _PLAN_POLICY.run(
+                attempt,
+                timeout=max(deadline - time.monotonic(), 0.001),
+                op="serving.plan",
+            )
         failovers = 0
         last: "Optional[Exception]" = None
         i = 0
@@ -324,23 +345,33 @@ class ServingClient:
     ) -> Any:
         t_end = time.monotonic() + budget
         if delta and self._held is not None and self._held_version > 0:
-            frag_doc = fetch_resource(
+            # Delta path, pipelined (ISSUE 14): manifest first, then the
+            # digest-changed fragments through the bounded-parallel
+            # fetcher — raw bytes verified against the publisher's
+            # sha256, decode of fragment i overlapping the wire of
+            # fragment i+1, all on persistent connections.  The timeout
+            # clamp matters: an exhausted budget must hand the retry
+            # layer a zero-ish deadline, never a negative one.
+            mbuf = self._frag_fetcher.fetch_raw(
                 base, v, f"frag_{_payload.MANIFEST_FRAG}",
-                timeout=t_end - time.monotonic(),
+                timeout=max(t_end - time.monotonic(), 0.001),
             )
-            manifest = frag_doc
+            try:
+                manifest = _payload.decode_manifest(mbuf)
+            finally:
+                POOL.give(mbuf)
             names = _payload.changed_fragments(manifest, self._held[0])
-            doc: "Dict[str, Any]" = {
-                f"frag:{_payload.MANIFEST_FRAG}": manifest
-            }
-            for name in names:
-                doc[f"frag:{name}"] = fetch_resource(
-                    base, v, f"frag_{name}",
-                    timeout=max(t_end - time.monotonic(), 0.001),
-                )
-            state, manifest, leaves = _payload.decode_payload(
-                doc, prev=self._held
-            )
+            leaves: "Dict[int, Any]" = dict(self._held[1])
+            for res, buf, _span in self._frag_fetcher.fetch_stream(
+                base, v, [f"frag_{n}" for n in names], deadline=t_end
+            ):
+                name = res[len("frag_"):]
+                try:
+                    _payload.verify_fragment(name, buf, manifest)
+                    leaves.update(_payload.decode_fragment(buf))
+                finally:
+                    POOL.give(buf)
+            state = _payload.assemble(manifest, leaves)
         else:
             doc = fetch_resource(base, v, "full", timeout=budget)
             state, manifest, leaves = _payload.decode_payload(doc)
@@ -354,4 +385,5 @@ class ServingClient:
         return state
 
     def close(self) -> None:
+        self._frag_fetcher.close()
         self._client.close()

@@ -8,35 +8,46 @@ A published weight version is one staged checkpoint-transport document
     {
       "frag:manifest": {version, wire, fragments, digests, skeleton,
                         num_leaves, created_ns},
-      "frag:0": {"<slot>": <encoded leaf>, ...},
+      "frag:0": <serialized fragment wire bytes>,
       ...
-      "frag:<F-1>": {...},
+      "frag:<F-1>": <bytes>,
     }
 
 Every fragment is independently fetchable via the transport's
 ``frag_<name>`` resource, so a client that already holds version ``V``
 can pull version ``V+1`` as *manifest + changed fragments only* — the
-per-fragment ``digests`` (publisher-computed over the encoded leaf
-bytes) say which fragments moved.  A DiLoCo fragment maps naturally onto
-one payload fragment (the delta unit the training side already syncs).
+per-fragment ``digests`` say which fragments moved.  A DiLoCo fragment
+maps naturally onto one payload fragment (the delta unit the training
+side already syncs).
+
+Fragments are stored (and staged, and relayed) as the **serialized wire
+stream itself** (``checkpointing/serialization.py`` format), and the
+publisher's digest is the sha256 of exactly those bytes.  That is the
+contract the streaming relay path (ISSUE 14) is built on: a relay can
+verify a fragment on receipt and re-serve it **verbatim** — zero decode
+passes, zero Python-object copies — and every node in the tree holds
+bitwise-identical bytes by construction, not by re-encoding
+deterministically.  A fragment travelling the tree may therefore appear
+as ``bytes`` (publisher-encoded), a bufpool-backed ``uint8`` ndarray
+(relay passthrough), or a decoded ``{slot: leaf}`` dict (tests/legacy);
+:func:`fragment_wire` normalizes the raw forms.
 
 Leaves are optionally int8-quantized through the same per-row absmax
 codec the quantized collectives use (``ops/quantization.py``, reusing
 its GIL-free native kernels): a float32 leaf becomes
 ``{"q8": int8 payload, "scale": f32 row scales, "shape": [...]}``.
-Encoding is deterministic, so two serving replicas relaying the same
-published version hold — and serve — bitwise-identical bytes: the
-property the chaos tests pin (failover mid-fetch completes with
-identical weights).
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from torchft_tpu.checkpointing import serialization as ser
 
 __all__ = [
     "WIRE_F32",
@@ -44,8 +55,12 @@ __all__ = [
     "MANIFEST_FRAG",
     "encode_payload",
     "decode_fragment",
+    "decode_manifest",
     "decode_payload",
+    "assemble",
     "changed_fragments",
+    "fragment_wire",
+    "verify_fragment",
 ]
 
 WIRE_F32 = "f32"
@@ -97,16 +112,50 @@ def _decode_leaf(leaf: Any) -> Any:
     return leaf
 
 
-def _leaf_bytes(leaf: Any) -> bytes:
-    """Stable byte view of an encoded leaf for digesting."""
-    if isinstance(leaf, dict) and _Q8_KEY in leaf:
-        return (
-            np.ascontiguousarray(leaf[_Q8_KEY]).tobytes()
-            + np.ascontiguousarray(leaf["scale"]).tobytes()
+def fragment_wire(frag: Any) -> "Optional[memoryview]":
+    """Raw wire view of a fragment in passthrough form (``bytes`` from
+    the publisher's encode, a bufpool-backed ``uint8`` ndarray on a
+    relay); ``None`` for decoded/pytree fragments."""
+    return ser.raw_view(frag)
+
+
+class _ViewReader(io.RawIOBase):
+    """Zero-copy BinaryIO over a memoryview: ``deserialize_from`` reads
+    straight out of the received buffer into the final leaf arrays —
+    ``io.BytesIO(raw)`` would copy the whole fragment first."""
+
+    def __init__(self, view: memoryview) -> None:
+        self._view = view
+        self._off = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b: Any) -> int:
+        n = min(len(b), len(self._view) - self._off)
+        b[:n] = self._view[self._off:self._off + n]
+        self._off += n
+        return n
+
+
+def verify_fragment(name: str, frag: Any, manifest: "Dict[str, Any]") -> None:
+    """Check a raw fragment against the publisher-computed sha256 in the
+    manifest; raises ``ValueError`` on mismatch.  Decoded fragments (no
+    raw view) and fragments the manifest carries no digest for pass —
+    integrity is a property of the wire form."""
+    raw = fragment_wire(frag)
+    if raw is None:
+        return
+    want = (manifest.get("digests") or {}).get(name)
+    if want is None:
+        return
+    got = hashlib.sha256(raw).hexdigest()
+    if got != want:
+        raise ValueError(
+            f"serving fragment {name!r} v{manifest.get('version')}: digest "
+            f"mismatch ({got[:12]} != {want[:12]}) — corrupted or torn "
+            f"fragment must never be staged or served"
         )
-    if isinstance(leaf, np.ndarray) or hasattr(leaf, "__array__"):
-        return np.ascontiguousarray(np.asarray(leaf)).tobytes()
-    return repr(leaf).encode()
 
 
 def encode_payload(
@@ -120,6 +169,8 @@ def encode_payload(
     ``fragments``: leaf slots are split round-robin into this many
     independently fetchable fragments (the delta unit); pass the DiLoCo
     fragment count to align delta fetches with training's sync unit.
+    Fragment values are the serialized wire bytes; ``digests`` is the
+    sha256 of those bytes, so relays verify and re-serve them verbatim.
     """
     import jax
 
@@ -133,14 +184,11 @@ def encode_payload(
     digests: "Dict[str, str]" = {}
     for fi, name in enumerate(frag_names):
         frag: "Dict[str, Any]" = {}
-        h = hashlib.sha256()
         for slot in range(fi, len(leaves), len(frag_names)):
-            enc = _encode_leaf(leaves[slot], wire)
-            frag[str(slot)] = enc
-            h.update(str(slot).encode())
-            h.update(_leaf_bytes(enc))
-        doc[f"frag:{name}"] = frag
-        digests[name] = h.hexdigest()
+            frag[str(slot)] = _encode_leaf(leaves[slot], wire)
+        raw = ser.serialize(frag)
+        doc[f"frag:{name}"] = raw
+        digests[name] = hashlib.sha256(raw).hexdigest()
     doc[f"frag:{MANIFEST_FRAG}"] = {
         "version": int(version),
         "wire": wire,
@@ -153,9 +201,26 @@ def encode_payload(
     return doc
 
 
-def decode_fragment(frag: "Dict[str, Any]") -> "Dict[int, Any]":
-    """Decode one fetched fragment into ``{leaf slot: decoded leaf}``."""
+def decode_fragment(frag: Any) -> "Dict[int, Any]":
+    """Decode one fragment (raw wire bytes or an already-deserialized
+    sub-dict) into ``{leaf slot: decoded leaf}``."""
+    raw = fragment_wire(frag)
+    if raw is not None:
+        skeleton, leaves, n = ser.deserialize_from(_ViewReader(raw))
+        frag = ser.reassemble(skeleton, leaves, n)
     return {int(slot): _decode_leaf(leaf) for slot, leaf in frag.items()}
+
+
+def decode_manifest(raw: Any) -> "Dict[str, Any]":
+    """Decode a raw ``frag_manifest`` fetch into the manifest dict."""
+    view = fragment_wire(raw)
+    skeleton, leaves, n = ser.deserialize_from(
+        _ViewReader(view) if view is not None else io.BytesIO(raw)
+    )
+    manifest = ser.reassemble(skeleton, leaves, n)
+    if not isinstance(manifest, dict) or "fragments" not in manifest:
+        raise ValueError("serving fetch: frag_manifest is not a manifest")
+    return manifest
 
 
 def changed_fragments(
@@ -172,6 +237,27 @@ def changed_fragments(
     return [n for n in names if manifest["digests"].get(n) != prev.get(n)]
 
 
+def assemble(
+    manifest: "Dict[str, Any]", leaves: "Dict[int, Any]"
+) -> Any:
+    """Rebuild the state dict from a complete ``{slot: decoded leaf}``
+    map and the manifest skeleton (the tail of :func:`decode_payload`,
+    split out so pipelined fetchers can merge leaves incrementally)."""
+    import jax
+
+    n = int(manifest["num_leaves"])
+    missing = [i for i in range(n) if i not in leaves]
+    if missing:
+        raise ValueError(
+            f"serving payload v{manifest.get('version')}: missing leaf "
+            f"slots {missing[:5]}{'...' if len(missing) > 5 else ''} "
+            f"(delta fetch without a complete previous version?)"
+        )
+    return jax.tree_util.tree_map(
+        lambda slot: leaves[slot], manifest["skeleton"]
+    )
+
+
 def decode_payload(
     doc: "Dict[str, Any]",
     prev: "Optional[Tuple[Dict[str, Any], Dict[int, Any]]]" = None,
@@ -182,23 +268,12 @@ def decode_payload(
     Returns ``(state_dict, manifest, leaves)`` — keep ``(manifest,
     leaves)`` around to decode the next delta fetch.
     """
-    import jax
-
     manifest = doc[f"frag:{MANIFEST_FRAG}"]
     leaves: "Dict[int, Any]" = dict(prev[1]) if prev is not None else {}
     for name in manifest["fragments"]:
         frag = doc.get(f"frag:{name}")
         if frag is not None:
+            verify_fragment(name, frag, manifest)
             leaves.update(decode_fragment(frag))
-    n = int(manifest["num_leaves"])
-    missing = [i for i in range(n) if i not in leaves]
-    if missing:
-        raise ValueError(
-            f"serving payload v{manifest.get('version')}: missing leaf "
-            f"slots {missing[:5]}{'...' if len(missing) > 5 else ''} "
-            f"(delta fetch without a complete previous version?)"
-        )
-    state = jax.tree_util.tree_map(
-        lambda slot: leaves[slot], manifest["skeleton"]
-    )
+    state = assemble(manifest, leaves)
     return state, manifest, leaves

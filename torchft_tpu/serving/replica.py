@@ -10,6 +10,32 @@ in its own HTTP checkpoint transport for its children and for inference
 clients.  A dead parent is routed around: the pull fails over to the
 publisher/root source, so a killed interior node degrades depth, never
 availability.
+
+The pull is a **cut-through fragment stream** (ISSUE 14, default;
+``TORCHFT_SERVING_STREAM=0`` restores the whole-payload
+store-and-forward path): the relay fetches the ``frag_manifest`` doc
+first, then streams fragments one at a time and restages each the
+moment its publisher-computed sha256 verifies — a child at depth *d*
+overlaps its pull of fragment *i* with this node's pull of fragment
+*i+1*, so publish→leaf propagation scales like T_payload + depth×T_frag
+instead of depth×T_payload.  Three properties ride along:
+
+- **delta relay pulls** — holding version *v−1*, only digest-changed
+  fragments cross the wire; unchanged ones are copied from the local
+  staging slot (steady-state relay bytes scale with the update delta,
+  not the model);
+- **zero-decode passthrough** — fragments are opaque verified bytes
+  (bufpool-backed), re-served verbatim: no ``deserialize``/
+  ``reassemble``/re-serialize on the relay hot path
+  (``torchft_serving_relay_decode_seconds{mode="stream"}`` is
+  manifest-only, ~0);
+- **torn-version safety** — a streaming version serves ONLY its staged
+  fragments (children 503-poll the rest); whole-document reads 503
+  until the stream finishes, and the replica advertises the version
+  only after the last fragment verified.  A mid-stream parent death
+  resumes from the fragments already staged (digests pin content, so
+  mixing sources is safe) — and a digest mismatch is treated as a dead
+  source, never staged.
 """
 
 from __future__ import annotations
@@ -18,15 +44,19 @@ import logging
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
-from torchft_tpu.serving import wire as _wire
+from torchft_tpu.ops.codec_pool import merged_seconds
+from torchft_tpu.serving import fetcher as _fetcher
+from torchft_tpu.serving import payload as _payload
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
-from torchft_tpu.utils.env import env_float, env_int
+from torchft_tpu.utils.bufpool import POOL
+from torchft_tpu.utils.env import env_bool, env_float, env_int
 
 logger = logging.getLogger(__name__)
 
@@ -48,6 +78,10 @@ class ServingReplica:
             (default ``TORCHFT_SERVING_POLL_S``).
         fetch_timeout: per-pull deadline (default
             ``TORCHFT_SERVING_FETCH_TIMEOUT_S``).
+        stream: cut-through fragment streaming (default
+            ``TORCHFT_SERVING_STREAM``, on); off = whole-payload
+            store-and-forward (the pre-ISSUE-14 path, kept for the
+            depth-axis bench comparison).
     """
 
     def __init__(
@@ -58,6 +92,7 @@ class ServingReplica:
         max_versions: "Optional[int]" = None,
         poll_interval: "Optional[float]" = None,
         fetch_timeout: "Optional[float]" = None,
+        stream: "Optional[bool]" = None,
     ) -> None:
         from torchft_tpu.coordination import LighthouseClient
 
@@ -86,8 +121,17 @@ class ServingReplica:
         # remaining deadline, so a slow-but-alive fleet still completes).
         self._failover_s = env_float("TORCHFT_SERVING_FAILOVER_S", 2.0,
                                      minimum=0.05)
+        self._stream = (
+            stream
+            if stream is not None
+            else env_bool("TORCHFT_SERVING_STREAM", True)
+        )
+        self._frag_fetcher = _fetcher.FragmentFetcher(role="relay")
         self._lock = threading.Lock()
         self._version = 0
+        # delta base: manifest of the newest COMPLETELY staged version
+        # (digest diff against it decides which fragments need wire)
+        self._held_manifest: "Optional[Dict[str, Any]]" = None
         self._plan_epoch = -1
         self._parent = ""       # adopted parent address ("" = unplaced)
         self._root_source = ""  # publisher address (failover of last resort)
@@ -110,7 +154,8 @@ class ServingReplica:
         return self._replica_id
 
     def version(self) -> int:
-        """Newest weight version staged and servable on this node."""
+        """Newest weight version staged COMPLETE and servable on this
+        node (a mid-stream version is never advertised)."""
         with self._lock:
             return self._version
 
@@ -194,77 +239,241 @@ class ServingReplica:
                 attributes={"epoch": epoch, "depth": self._depth},
             )
 
-    def _pull(self, target: int) -> None:
-        """Pull version ``target`` from the parent; fail over to the
-        root source, then any peer, when the parent is dead or lags."""
-        _faults.check("serving.fetch", replica=self._replica_id, step=target)
+    # -- pull path ---------------------------------------------------------
+
+    def _sources(self) -> "List[str]":
+        """Failover order: parent -> root source -> two peers (bounded
+        walk: a stale target is cheaper to re-resolve on the next beat
+        than to chase across the whole fleet); self deduped out."""
         with self._lock:
             sources = [s for s in (self._parent, self._root_source) if s]
             peers = list(self._peers)
         own = self.address()
-        # dedupe, drop self, keep order: parent -> root source -> two
-        # peers (bounded walk: a stale target is cheaper to re-resolve
-        # on the next beat than to chase across the whole fleet)
         seen = {own}
         ordered: "List[str]" = []
         for s in sources + peers:
             if s not in seen:
                 seen.add(s)
                 ordered.append(s)
-        ordered = ordered[:4]
+        return ordered[:4]
+
+    def _source_budget(
+        self, deadline: float, i: int, total: int
+    ) -> float:
+        """Split the remaining deadline over the sources left, capping
+        every non-final source at the failover bound so a dead parent
+        costs seconds, not the whole deadline."""
+        remaining = max(deadline - time.monotonic(), 0.1)
+        budget = max(remaining / max(total - i, 1), 0.5)
+        if i < total - 1:
+            budget = min(budget, self._failover_s)
+        return min(budget, remaining)
+
+    def _pull(self, target: int) -> None:
+        """Pull version ``target``; fail over to the root source, then
+        any peer, when the parent is dead, lags, or serves bytes whose
+        digest does not verify."""
+        _faults.check("serving.fetch", replica=self._replica_id, step=target)
+        ordered = self._sources()
         if not ordered:
             return
         t0 = time.perf_counter()
         with _flightrec.track(
             "serving.fetch", step=target, role="relay",
         ) as op:
-            last: "Optional[Exception]" = None
-            deadline = time.monotonic() + self._fetch_timeout
-            for i, src in enumerate(ordered):
-                # Per-source budget: split the remaining deadline, but
-                # cap every non-final source at the failover bound so a
-                # dead parent costs seconds, not the whole deadline.
-                remaining = max(deadline - time.monotonic(), 0.1)
-                budget = max(remaining / max(len(ordered) - i, 1), 0.5)
-                if i < len(ordered) - 1:
-                    budget = min(budget, self._failover_s)
-                try:
-                    doc = self._transport.recv_checkpoint(
-                        0, src, step=target, timeout=budget
-                    )
-                    # WAN wire model (serving/wire.py): the relay pull
-                    # pays one RTT + payload/rate when the parent/peer
-                    # sits across the topology boundary
-                    _wire.get_shaper().charge(
-                        src, _wire.payload_nbytes(doc)
-                    )
-                    break
-                except Exception as e:  # noqa: BLE001 - failover path
-                    last = e
-                    if i < len(ordered) - 1:
-                        # count only pulls that actually MOVE to another
-                        # source; a terminal failure is not a failover
-                        _metrics.SERVING_FAILOVERS.labels(role="relay").inc()
-                    logger.warning(
-                        "serving relay %s: pull v%d from %s failed (%s); "
-                        "failing over",
-                        self._replica_id, target, src, e,
-                    )
+            if self._stream:
+                self._pull_streamed(target, ordered, op)
             else:
-                op.update(status="error")
-                raise ConnectionError(
-                    f"serving relay {self._replica_id}: no source served "
-                    f"v{target} within {self._fetch_timeout}s"
-                ) from last
-            self._transport.send_checkpoint(
-                [], target, doc, timeout=self._fetch_timeout
-            )
+                self._pull_flat(target, ordered, op)
         with self._lock:
             if target > self._version:
                 self._version = target
         dt = time.perf_counter() - t0
         _metrics.SERVING_FETCH_SECONDS.labels(role="relay").observe(dt)
         _metrics.SERVING_VERSION.labels(role="server").set(self.version())
+
+    def _pull_flat(
+        self, target: int, ordered: "List[str]", op: Any
+    ) -> None:
+        """Whole-payload store-and-forward (the pre-streaming path):
+        fetch ``full``, decode the stream, restage — children cannot see
+        any byte of ``target`` until this node holds all of them."""
+        deadline = time.monotonic() + self._fetch_timeout
+        last: "Optional[Exception]" = None
+        for i, src in enumerate(ordered):
+            budget = self._source_budget(deadline, i, len(ordered))
+            try:
+                # streamed straight off the socket (no raw intermediate
+                # copy); the decode interleaves with the reads, exactly
+                # what the store-and-forward baseline always paid
+                t_dec = time.perf_counter()
+                skeleton, leaves, n = _fetcher.fetch_serialized(
+                    src, target, "full", timeout=budget, role="relay"
+                )
+                doc = ser.reassemble(skeleton, leaves, n)
+                _metrics.SERVING_RELAY_DECODE.labels(
+                    mode="flat"
+                ).observe(time.perf_counter() - t_dec)
+                break
+            except Exception as e:  # noqa: BLE001 - failover path
+                last = e
+                if i < len(ordered) - 1:
+                    # count only pulls that actually MOVE to another
+                    # source; a terminal failure is not a failover
+                    _metrics.SERVING_FAILOVERS.labels(role="relay").inc()
+                logger.warning(
+                    "serving relay %s: pull v%d from %s failed (%s); "
+                    "failing over",
+                    self._replica_id, target, src, e,
+                )
+        else:
+            op.update(status="error")
+            raise ConnectionError(
+                f"serving relay {self._replica_id}: no source served "
+                f"v{target} within {self._fetch_timeout}s"
+            ) from last
+        self._transport.send_checkpoint(
+            [], target, doc, timeout=self._fetch_timeout
+        )
+        with self._lock:
+            self._held_manifest = doc.get(f"frag:{_payload.MANIFEST_FRAG}")
+
+    def _begin_staging(
+        self, target: int, manifest: "Dict[str, Any]"
+    ) -> "Tuple[List[str], int]":
+        """Open (or RESUME) the streamed staging slot for ``target``;
+        reuse unchanged fragments from the held version's local staging
+        (the delta relay pull — zero wire for fragments whose digest
+        did not move).  Returns ``(names still needing wire, reused)``.
+        """
+        names = list(manifest["fragments"])
+        with self._lock:
+            held_v, held_m = self._version, self._held_manifest
+        existing = self._transport.streamed_parts(target)
+        if existing is None:
+            self._transport.begin_streamed_checkpoint(
+                target,
+                {f"frag:{_payload.MANIFEST_FRAG}": manifest},
+                timeout=self._fetch_timeout,
+            )
+            existing = {f"frag:{_payload.MANIFEST_FRAG}"}
+        changed = set(_payload.changed_fragments(manifest, held_m))
+        todo: "List[str]" = []
+        reused = 0
+        for name in names:
+            key = f"frag:{name}"
+            if key in existing:
+                continue  # staged by an earlier interrupted pull
+            if name not in changed:
+                buf = self._transport.copy_staged_part(held_v, key)
+                if buf is not None:
+                    self._transport.stage_streamed_part(
+                        target, key, buf, pooled=True
+                    )
+                    reused += 1
+                    continue
+                # held version fell out of the staging window: pay wire
+            todo.append(name)
+        return todo, reused
+
+    def _pull_streamed(
+        self, target: int, ordered: "List[str]", op: Any
+    ) -> None:
+        deadline = time.monotonic() + self._fetch_timeout
+        manifest: "Optional[Dict[str, Any]]" = None
+        todo: "List[str]" = []
+        reused = 0
+        total = 0
+        wire_spans: "List[Tuple[float, float]]" = []
+        proc_busy = 0.0
+        t_stream0 = time.perf_counter()
+        last: "Optional[Exception]" = None
+        for i, src in enumerate(ordered):
+            budget = self._source_budget(deadline, i, len(ordered))
+            src_deadline = time.monotonic() + budget
+            try:
+                if manifest is None:
+                    mbuf = self._frag_fetcher.fetch_raw(
+                        src, target, f"frag_{_payload.MANIFEST_FRAG}",
+                        timeout=budget,
+                    )
+                    try:
+                        t_dec = time.perf_counter()
+                        manifest = _payload.decode_manifest(mbuf)
+                        _metrics.SERVING_RELAY_DECODE.labels(
+                            mode="stream"
+                        ).observe(time.perf_counter() - t_dec)
+                    finally:
+                        POOL.give(mbuf)
+                    if int(manifest["version"]) != target:
+                        v_got = manifest["version"]
+                        manifest = None
+                        raise ConnectionError(
+                            f"wanted v{target}, {src} served v{v_got}"
+                        )
+                    todo, reused = self._begin_staging(target, manifest)
+                    total = len(manifest["fragments"])
+                    t_stream0 = time.perf_counter()
+                # Cut-through: stage each fragment the moment its digest
+                # verifies — children polling frag_<name> get it while
+                # this node is still pulling the next one.  Fragments
+                # already staged (earlier source died mid-stream) are
+                # skipped; digests pin content, so resuming from another
+                # source is bitwise-safe.
+                parts = self._transport.streamed_parts(target) or set()
+                pend = [
+                    f"frag_{n}" for n in todo if f"frag:{n}" not in parts
+                ]
+                for res, buf, span in self._frag_fetcher.fetch_stream(
+                    src, target, pend, deadline=src_deadline
+                ):
+                    name = res[len("frag_"):]
+                    wire_spans.append(span)
+                    t_proc = time.perf_counter()
+                    try:
+                        _payload.verify_fragment(name, buf, manifest)
+                        self._transport.stage_streamed_part(
+                            target, f"frag:{name}", buf, pooled=True
+                        )
+                    except BaseException:
+                        # poisoned or unstageable bytes never serve
+                        POOL.give(buf)
+                        raise
+                    proc_busy += time.perf_counter() - t_proc
+                break
+            except Exception as e:  # noqa: BLE001 - failover path
+                last = e
+                if i < len(ordered) - 1:
+                    _metrics.SERVING_FAILOVERS.labels(role="relay").inc()
+                logger.warning(
+                    "serving relay %s: streamed pull v%d from %s failed "
+                    "(%s); failing over",
+                    self._replica_id, target, src, e,
+                )
+        else:
+            # terminal: keep the partial slot — the next beat RESUMES
+            # from the staged fragments (or the window evicts it when
+            # the fleet moves on)
+            op.update(status="error")
+            raise ConnectionError(
+                f"serving relay {self._replica_id}: no source served "
+                f"v{target} within {self._fetch_timeout}s"
+            ) from last
+        self._transport.finish_streamed_checkpoint(target)
+        with self._lock:
+            self._held_manifest = manifest
+        wall = time.perf_counter() - t_stream0
+        # union of fetch intervals, NOT a sum: K-parallel in-flight
+        # fetches would otherwise exceed wall on their own and pin the
+        # gauge at 1.0 regardless of actual overlap
+        wire_busy = merged_seconds(wire_spans)
+        if wire_busy > 0.0 and proc_busy > 0.0 and wall > 0.0:
+            occ = (wire_busy + proc_busy - wall) / min(wire_busy, proc_busy)
+            _metrics.SERVING_CUT_OCCUPANCY.set(min(max(occ, 0.0), 1.0))
+        op.update(
+            fragments=total, reused=reused,
+            wire_s=round(wire_busy, 4),
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -278,5 +487,6 @@ class ServingReplica:
     def shutdown(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+        self._frag_fetcher.close()
         self._client.close()
         self._transport.shutdown()

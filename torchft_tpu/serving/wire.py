@@ -27,14 +27,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Iterable, Optional, Tuple
+from typing import Iterable, Optional, Tuple
 from urllib.parse import urlparse
 
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.env import env_float, env_str
 from torchft_tpu.utils.hostident import local_host_identities
 
-__all__ = ["WireShaper", "get_shaper", "payload_nbytes", "source_host"]
+__all__ = ["WireShaper", "get_shaper", "source_host"]
 
 
 def source_host(source: str) -> str:
@@ -46,33 +46,19 @@ def source_host(source: str) -> str:
     return host or "127.0.0.1"
 
 
-def payload_nbytes(doc: Any) -> int:
-    """Approximate wire size of a fetched payload/checkpoint document:
-    the sum of its array/bytes leaves (metadata is noise at any size the
-    shaper matters for)."""
-    total = 0
-    stack = [doc]
-    while stack:
-        node = stack.pop()
-        if isinstance(node, dict):
-            stack.extend(node.values())
-        elif isinstance(node, (list, tuple)):
-            stack.extend(node)
-        elif isinstance(node, (bytes, bytearray)):
-            total += len(node)
-        else:
-            nb = getattr(node, "nbytes", None)
-            if isinstance(nb, int):
-                total += nb
-    return total
-
-
 class WireShaper:
-    """One shaped serving link: per-message RTT + shared token bucket.
+    """One shaped serving link: per-message RTT + per-SOURCE token
+    buckets.
 
-    The bucket is shared by every fetch this process makes (relay pulls
-    and client fetches alike) — the serving tier's WAN uplink is one
-    pipe, exactly like the PG's egress bucket across sender threads.
+    Each bucket models one serving node's WAN egress uplink (keyed by
+    the source address — the sender-side egress semantics of the PG
+    shaper): fetches from the SAME source share its pipe, fetches from
+    different sources (distinct relays on distinct machines in a real
+    deployment) shape independently — which is what lets the depth-axis
+    bench see cut-through relays of a chain forwarding concurrently
+    instead of serializing every hop through one process-wide bucket.
+    ``burst_bytes`` (``TORCHFT_WIRE_BURST_MB``) is each uplink's bucket
+    depth.
     """
 
     def __init__(
@@ -81,6 +67,7 @@ class WireShaper:
         gbps: float,
         topology_spec: str,
         local_hosts: "Optional[Iterable[str]]" = None,
+        burst_bytes: int = 4 << 20,
     ) -> None:
         self._rtt_s = max(rtt_ms, 0.0) / 1e3
         self._rate = max(gbps, 0.0) * 1e9  # decimal GB/s, like the PG
@@ -88,9 +75,9 @@ class WireShaper:
         self._local = (
             frozenset(local_hosts) if local_hosts else local_host_identities()
         )
-        self._burst = 4 << 20
-        self._tokens = float(self._burst)
-        self._t = time.monotonic()
+        self._burst = max(int(burst_bytes), 1)
+        # source address -> [tokens, last refill time]
+        self._buckets: "dict[str, list[float]]" = {}
         self._lock = threading.Lock()
 
     @property
@@ -111,14 +98,19 @@ class WireShaper:
         wait = self._rtt_s
         if self._rate > 0.0 and nbytes > 0:
             with self._lock:
+                bucket = self._buckets.get(source)
+                if bucket is None:
+                    bucket = self._buckets[source] = [
+                        float(self._burst), time.monotonic(),
+                    ]
                 now = time.monotonic()
-                self._tokens = min(
+                bucket[0] = min(
                     float(self._burst),
-                    self._tokens + (now - self._t) * self._rate,
+                    bucket[0] + (now - bucket[1]) * self._rate,
                 )
-                self._t = now
-                self._tokens -= nbytes
-                debt = -self._tokens
+                bucket[1] = now
+                bucket[0] -= nbytes
+                debt = -bucket[0]
             if debt > 0:
                 wait += debt / self._rate
         if wait > 0:
@@ -129,7 +121,7 @@ class WireShaper:
 
 _shaper_lock = threading.Lock()
 _shaper: "Optional[WireShaper]" = None
-_shaper_key: "Optional[Tuple[float, float, str]]" = None
+_shaper_key: "Optional[Tuple[float, float, str, float]]" = None
 
 
 def get_shaper() -> WireShaper:
@@ -141,9 +133,13 @@ def get_shaper() -> WireShaper:
         env_float("TORCHFT_WIRE_RTT_MS", 0.0),
         env_float("TORCHFT_WIRE_GBPS", 0.0),
         env_str("TORCHFT_TOPOLOGY", "") or "",
+        env_float("TORCHFT_WIRE_BURST_MB", 4.0, minimum=0.001),
     )
     with _shaper_lock:
         if _shaper is None or key != _shaper_key:
-            _shaper = WireShaper(key[0], key[1], key[2])
+            _shaper = WireShaper(
+                key[0], key[1], key[2],
+                burst_bytes=int(key[3] * (1 << 20)),
+            )
             _shaper_key = key
         return _shaper

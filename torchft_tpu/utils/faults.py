@@ -48,6 +48,11 @@ site                  fires in
 ``serving.fetch``     serving-tier fetch attempts — relay pull from the
                       tree parent and client fetches (``step`` =
                       version)
+``serving.frag``      serving-tier per-fragment raw fetches
+                      (serving/fetcher.py) — manifest and fragment
+                      pulls of the streaming relay and the pipelined
+                      client delta path (``step`` = fragment index in
+                      the stream, version for single fetches)
 ``serving.tree_commit``  ``ServingReplica`` adopting a new
                       distribution-tree plan epoch (``step`` = epoch)
 ``store.barrier``     blocking ``StoreClient.get(wait=True)`` (the
@@ -134,6 +139,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "transport.recv",
     "serving.publish",
     "serving.fetch",
+    "serving.frag",
     "serving.tree_commit",
     "store.barrier",
     "local_sgd.sync",

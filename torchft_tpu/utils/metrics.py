@@ -1075,6 +1075,25 @@ SERVING_WIRE_WAIT = counter(
     "TORCHFT_TOPOLOGY boundary; serving/wire.py)",
     (),
 )
+SERVING_RELAY_DECODE = histogram(
+    "torchft_serving_relay_decode_seconds",
+    "Seconds a serving relay spent deserializing pulled payload content "
+    "per pull, by mode (serving/replica.py): flat = whole-payload "
+    "store-and-forward decode, stream = cut-through passthrough — "
+    "manifest-only, ~0 (fragments are verified opaque bytes, never "
+    "decoded on the relay)",
+    ("mode",),
+)
+SERVING_CUT_OCCUPANCY = gauge(
+    "torchft_serving_cut_through_occupancy",
+    "Pipeline occupancy of the last streamed relay pull: overlap of "
+    "fragment wire time (UNION of the in-flight fetch intervals, so "
+    "parallel fetches don't double-count) with verify/stage time, "
+    "(wire_s + proc_s - wall_s) / min(wire_s, proc_s) clamped to "
+    "[0, 1] — the serving twin of torchft_quant_overlap_efficiency "
+    "(serving/replica.py)",
+    (),
+)
 HA_FAILOVERS = counter(
     "torchft_ha_failovers_total",
     "Lighthouse RPCs that moved to another endpoint of the "
