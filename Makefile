@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal
 
 native:
 	$(MAKE) -C native
@@ -88,6 +88,21 @@ ha-smoke:
 # JSON line as the full bench.
 bench-ha:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --ha-failover
+
+# Striped-heal round trip alone (ISSUE 15): streamed fragment staging,
+# multi-source striping with per-fragment failover (kill a stripe source
+# mid-heal, poisoned-fragment rejection), delta rejoins, the delta-heal
+# golden fixture, and the fleet-level striped recovery chaos test
+# (docs/architecture.md "Striped heal").
+heal-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_heal_striped.py tests/test_golden_fixtures.py -q -m "not slow"
+
+# Striped-heal bench alone: heal wire time striped across {1,2,4}
+# sources x RTT {0,10,50} ms on shaped per-source uplinks + the
+# delta-rejoin row (docs/benchmarks.md §8); ends with the same < 1.5 KB
+# compact-summary JSON line as the full bench.
+bench-heal:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --heal
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

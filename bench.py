@@ -283,8 +283,9 @@ class RecoveryBench:
             "teardown": (self.teardown_s or 0.0) * 1e3,
             "manager_init": (self.manager_init_s or 0.0) * 1e3,
         }
-        for k in ("quorum_rpc", "pg_configure", "heal_recv", "ring",
-                  "commit", "quorum_wait", "host_sync"):
+        for k in ("quorum_rpc", "pg_configure", "heal_recv",
+                  "heal_manifest", "heal_diff", "heal_wire", "heal_decode",
+                  "ring", "commit", "quorum_wait", "host_sync"):
             if k in self.healed_phases:
                 phases_ms[k] = self.healed_phases[k] * 1e3
         return {
@@ -2167,6 +2168,165 @@ def bench_serving_depth() -> "Dict[str, Any]":
     return out
 
 
+# ---------------------------------------------------------------------------
+# striped multi-source heal (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+HEAL_STATE_LEAVES = 16
+HEAL_LEAF_ELEMS = 1 << 17  # 16 x 512 KB = 8 MB f32 heal state
+HEAL_FRAGMENTS = 16
+HEAL_SOURCES = (1, 2, 4)
+HEAL_RTTS_MS = (0.0, 10.0, 50.0)
+HEAL_GBPS = 0.02  # per-SOURCE uplink: striping aggregates them
+HEAL_BURST_MB = 0.25
+HEAL_PARALLEL = 4
+HEAL_TRIALS = 3
+
+
+def _heal_trial(
+    state: "Dict[str, Any]", n_sources: int,
+    local: "Optional[Dict[str, Any]]" = None,
+) -> "Tuple[float, Dict[str, Any]]":
+    """One striped heal against ``n_sources`` freshly stream-staging
+    transports (staging runs CONCURRENTLY with the healer's fetch — the
+    cut-through overlap the design claims); returns ``(wall_s, info)``."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    srcs = [HTTPTransport(timeout=60.0) for _ in range(n_sources)]
+    healer = HTTPTransport(timeout=60.0)
+    threads = [
+        threading.Thread(
+            target=t.send_checkpoint_streamed,
+            args=([1], 5, state, 60.0, HEAL_FRAGMENTS),
+            daemon=True,
+        )
+        for t in srcs
+    ]
+    try:
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        _got, info = healer.recv_checkpoint_striped(
+            [t.metadata() for t in srcs], 5, timeout=120.0,
+            local_state_fn=(lambda: local) if local is not None else None,
+            delta=local is not None,
+        )
+        wall = time.perf_counter() - t0
+    finally:
+        for t in threads:
+            t.join(timeout=10)
+        healer.shutdown()
+        for t in srcs:
+            t.shutdown()
+    return wall, info
+
+
+def bench_heal() -> "Dict[str, Any]":
+    """Striped multi-source delta heal (ISSUE 15): recovery over the
+    fragment plane, measured on shaped links.  One healer pulls an
+    8 MB heal state striped across {1, 2, 4} sources at WAN RTT
+    {0, 10, 50} ms, each source's uplink its own HEAL_GBPS token bucket
+    (Prime CCL's premise: striping aggregates source uplinks).  The
+    acceptance row is the 4-source wire-time speedup over single-source
+    (>= 1.5x on bandwidth-bound links).  The ``delta`` row rejoins with
+    a state differing in ONE leaf: wire bytes must scale with the
+    changed-fragment count, not the model."""
+    import os as _os
+
+    rng = np.random.RandomState(23)
+    state = {
+        "user": {
+            f"w{i}": rng.randn(HEAL_LEAF_ELEMS).astype(np.float32)
+            for i in range(HEAL_STATE_LEAVES)
+        },
+        "torchft": {"step": 5, "batches_committed": 10},
+    }
+    payload_bytes = sum(a.nbytes for a in state["user"].values())
+    prior = {
+        k: _os.environ.get(k)
+        for k in ("TORCHFT_WIRE_RTT_MS", "TORCHFT_WIRE_GBPS",
+                  "TORCHFT_WIRE_BURST_MB", "TORCHFT_TOPOLOGY",
+                  "TORCHFT_HEAL_PARALLEL")
+    }
+    _os.environ.pop("TORCHFT_TOPOLOGY", None)  # flat: every fetch is WAN
+    _os.environ["TORCHFT_WIRE_GBPS"] = str(HEAL_GBPS)
+    _os.environ["TORCHFT_WIRE_BURST_MB"] = str(HEAL_BURST_MB)
+    _os.environ["TORCHFT_HEAL_PARALLEL"] = str(HEAL_PARALLEL)
+
+    out: "Dict[str, Any]" = {
+        "state_mb": round(payload_bytes / 2**20, 2),
+        "fragments": HEAL_FRAGMENTS,
+        "gbps_per_uplink": HEAL_GBPS,
+        "trials": HEAL_TRIALS,
+    }
+    try:
+        for rtt in HEAL_RTTS_MS:
+            _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
+            leg: "Dict[str, Any]" = {}
+            for n in HEAL_SOURCES:
+                walls: "List[float]" = []
+                wires: "List[float]" = []
+                for _t in range(HEAL_TRIALS):
+                    wall, info = _heal_trial(state, n)
+                    walls.append(wall)
+                    wires.append(info["phases"]["heal_wire"])
+                walls.sort()
+                wires.sort()
+                leg[f"s{n}"] = {
+                    "wall_p50_s": round(walls[len(walls) // 2], 3),
+                    "wire_p50_s": round(wires[len(wires) // 2], 3),
+                }
+            for n in HEAL_SOURCES[1:]:
+                leg[f"s{n}"]["wire_speedup_x"] = round(
+                    leg["s1"]["wire_p50_s"]
+                    / max(leg[f"s{n}"]["wire_p50_s"], 1e-9),
+                    2,
+                )
+            out[f"rtt_{int(rtt)}ms"] = leg
+            log(
+                f"heal rtt={rtt}ms: wire p50 "
+                + " ".join(
+                    f"s{n}={leg[f's{n}']['wire_p50_s']}s" for n in HEAL_SOURCES
+                )
+                + f" (s4 speedup {leg['s4'].get('wire_speedup_x')}x)"
+            )
+        # delta-rejoin row (unshaped RTT, max sources): one changed leaf
+        _os.environ["TORCHFT_WIRE_RTT_MS"] = "0"
+        local = {
+            "user": {k: v.copy() for k, v in state["user"].items()},
+            "torchft": {"step": 3, "batches_committed": 6},
+        }
+        local["user"]["w7"] = local["user"]["w7"] + np.float32(1.0)
+        wall, info = _heal_trial(state, max(HEAL_SOURCES), local=local)
+        out["delta"] = {
+            "wall_s": round(wall, 3),
+            "changed_fragments": info["changed"],
+            "total_fragments": info["fragments"],
+            "wire_bytes": info["wire_bytes"],
+            "full_bytes": payload_bytes,
+            "bytes_ratio": round(info["wire_bytes"] / payload_bytes, 4),
+        }
+        log(
+            f"heal delta rejoin: {info['changed']}/{info['fragments']} "
+            f"fragments, {info['wire_bytes']} B "
+            f"({out['delta']['bytes_ratio']:.1%} of full)"
+        )
+        s4_0 = out.get("rtt_0ms", {}).get("s4", {})
+        s4_50 = out.get("rtt_50ms", {}).get("s4", {})
+        out["s4_rtt0_speedup_x"] = s4_0.get("wire_speedup_x")
+        out["s4_rtt50_speedup_x"] = s4_50.get("wire_speedup_x")
+        out["winner"] = (
+            "striped" if (s4_0.get("wire_speedup_x") or 0) > 1.0 else "single"
+        )
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    return out
+
+
 COMPACT_SUMMARY_MAX_BYTES = 1500
 
 
@@ -2339,6 +2499,18 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     }
     if ha_compact is not None and ha_wan:
         ha_compact["wan_p50_s"] = ha_wan
+    heal = result.get("heal") or {}
+    heal_compact = {
+        k: heal.get(k)
+        for k in ("s4_rtt0_speedup_x", "s4_rtt50_speedup_x", "winner")
+        if heal.get(k) is not None
+    }
+    if isinstance(heal.get("delta"), dict):
+        heal_compact["delta_changed"] = heal["delta"].get(
+            "changed_fragments"
+        )
+        heal_compact["delta_bytes_ratio"] = heal["delta"].get("bytes_ratio")
+    heal_compact = heal_compact or None
     sdepth = result.get("serving_depth") or {}
     serving_depth_compact = {
         k: sdepth.get(k)
@@ -2401,6 +2573,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # coordination-plane HA headline (ISSUE 13): leader-kill -> next
         # formed quorum latency + the monotonicity verdicts
         "ha": ha_compact,
+        # striped-heal headline (ISSUE 15): 4-source wire-time speedup
+        # over single-source on shaped links + the delta-rejoin row
+        "heal": heal_compact,
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
         # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
@@ -2428,7 +2603,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
-        "ha", "serving", "serving_depth",
+        "ha", "serving", "serving_depth", "heal",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2483,6 +2658,16 @@ def main() -> None:
             "metric": "serving_publish_to_leaf_latency",
             "serving_depth": sdepth,
         }
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
+    if "--heal" in sys.argv:
+        # `make bench-heal`: the striped multi-source heal leg alone
+        # (stripe sources x RTT on shaped per-source uplinks + the
+        # delta-rejoin row), with the compact tail (same last-line
+        # contract as the full run)
+        heal = bench_heal()
+        result = {"metric": "striped_heal_wire_time", "heal": heal}
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
         return
@@ -2593,6 +2778,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"ha bench failed: {e!r}")
         ha = {"error": repr(e)}
+    try:
+        # striped multi-source heal (ISSUE 15): recovery over the
+        # fragment plane — stripe sources x RTT + the delta-rejoin row
+        heal = bench_heal()
+    except Exception as e:  # noqa: BLE001
+        log(f"heal bench failed: {e!r}")
+        heal = {"error": repr(e)}
     result = {
         "metric": "recovery_to_healthy_step_latency",
         "unit": "s",
@@ -2607,6 +2799,7 @@ def main() -> None:
         "serving": serving,
         "serving_depth": serving_depth,
         "ha": ha,
+        "heal": heal,
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

@@ -433,7 +433,26 @@ Json LighthouseServer::rpc_lease(const Json& params) {
   out["granted"] = granted;
   out["term"] = promised_term_;
   out["holder"] = promised_to_;
+  // Observability federation (ISSUE 15): ride the existing lease
+  // channel so the leader can serve per-peer coordination-plane health
+  // (/status.json "ha.ha_peers") without a new RPC or a per-peer scrape.
+  out["takeovers"] = takeovers_total_;
+  out["promise_remaining_ms"] =
+      promise_expires_ms_ > now ? promise_expires_ms_ - now : 0;
   return out;
+}
+
+void LighthouseServer::record_peer_lease_locked(const std::string& peer,
+                                                const Json& reply,
+                                                int64_t now) {
+  HaPeerState& st = ha_peers_state_[peer];
+  st.last_ack_ms = now;
+  st.granted = reply.get("granted").as_bool();
+  st.term = reply.get("term").as_int();
+  if (reply.has("takeovers")) st.takeovers = reply.get("takeovers").as_int();
+  if (reply.has("promise_remaining_ms"))
+    st.promise_remaining_ms = reply.get("promise_remaining_ms").as_int();
+  st.holder = reply.get("holder").as_string();
 }
 
 void LighthouseServer::become_leader_locked(int64_t term, int64_t now) {
@@ -571,15 +590,17 @@ void LighthouseServer::election_loop() {
         if (stopping_.load()) return;
         Json r;
         if (lease_rpc(peer, lp, rpc_budget, &r)) {
+          std::lock_guard<std::mutex> g(mu_);
+          record_peer_lease_locked(peer, r, now_ms());
           if (r.get("granted").as_bool()) {
             grants += 1;
           } else {
-            std::lock_guard<std::mutex> g(mu_);
             max_seen_term_ =
                 std::max(max_seen_term_, r.get("term").as_int());
           }
         }
-        // unreachable peer: counts as a missing grant
+        // unreachable peer: counts as a missing grant (and its
+        // ha_peers last-ack age keeps growing — the federation signal)
       }
       std::lock_guard<std::mutex> g(mu_);
       int64_t now = now_ms();
@@ -664,10 +685,11 @@ void LighthouseServer::election_loop() {
           if (stopping_.load()) return;
           Json r;
           if (lease_rpc(peer, lp, rpc_budget, &r)) {
+            std::lock_guard<std::mutex> g(mu_);
+            record_peer_lease_locked(peer, r, now_ms());
             if (r.get("granted").as_bool()) {
               grants += 1;
             } else {
-              std::lock_guard<std::mutex> g(mu_);
               max_seen_term_ =
                   std::max(max_seen_term_, r.get("term").as_int());
             }
@@ -1426,6 +1448,32 @@ std::string LighthouseServer::render_metrics() {
        << "# TYPE torchft_lighthouse_lease_requests_total counter\n"
        << "torchft_lighthouse_lease_requests_total " << lease_requests_total_
        << "\n";
+    // Peer federation (ISSUE 15): the lease channel doubles as the
+    // coordination plane's health feed — per-peer series are bounded by
+    // the static endpoint list, so cardinality is a config constant.
+    if (!ha_peers_state_.empty()) {
+      os << "# HELP torchft_lighthouse_peer_term Peer's promised "
+            "leadership term at its last lease ack\n"
+         << "# TYPE torchft_lighthouse_peer_term gauge\n";
+      for (const auto& [addr, st] : ha_peers_state_)
+        os << "torchft_lighthouse_peer_term{peer=\"" << addr << "\"} "
+           << st.term << "\n";
+      os << "# HELP torchft_lighthouse_peer_lease_ack_age_ms Milliseconds "
+            "since the peer last answered a lease RPC (-1 = never)\n"
+         << "# TYPE torchft_lighthouse_peer_lease_ack_age_ms gauge\n";
+      for (const auto& [addr, st] : ha_peers_state_)
+        os << "torchft_lighthouse_peer_lease_ack_age_ms{peer=\"" << addr
+           << "\"} " << (st.last_ack_ms > 0 ? now - st.last_ack_ms : -1)
+           << "\n";
+      // no _total suffix: this is a GAUGE echo of the peer's own
+      // counter (last observed value, resets invisible here)
+      os << "# HELP torchft_lighthouse_peer_takeovers Leadership "
+            "takeovers the peer reported at its last lease ack\n"
+         << "# TYPE torchft_lighthouse_peer_takeovers gauge\n";
+      for (const auto& [addr, st] : ha_peers_state_)
+        os << "torchft_lighthouse_peer_takeovers{peer=\"" << addr
+           << "\"} " << st.takeovers << "\n";
+    }
     // Tick-cost telemetry: the incremental-quorum claim, measured.
     os << "# HELP torchft_lighthouse_tick_seconds Quorum tick wall time "
           "(includes the O(1) dirty-set skip path)\n"
@@ -1706,6 +1754,26 @@ Json LighthouseServer::status_json(int64_t page, int64_t per_page,
                        ? promised_to_
                        : "");
     ha["takeovers_total"] = takeovers_total_;
+    // Peer federation (ISSUE 15): per-peer lease-channel state, so one
+    // scrape of the leader answers "is every peer of the coordination
+    // plane alive, current, and acking leases" — no per-peer scrape.
+    // Rows exist once the election thread has exchanged leases; a peer
+    // that stopped answering keeps its last row with a growing
+    // last_ack_age_ms.
+    Json ha_peers = Json::array();
+    for (const auto& [addr, st] : ha_peers_state_) {
+      Json row = Json::object();
+      row["address"] = addr;
+      row["term"] = st.term;
+      row["granted"] = st.granted;
+      row["last_ack_age_ms"] =
+          st.last_ack_ms > 0 ? now - st.last_ack_ms : -1;
+      row["promise_remaining_ms"] = st.promise_remaining_ms;
+      row["takeovers_total"] = st.takeovers;
+      row["holder"] = st.holder;
+      ha_peers.push_back(row);
+    }
+    ha["ha_peers"] = ha_peers;
     out["ha"] = ha;
   }
 
@@ -1764,6 +1832,21 @@ std::string LighthouseServer::render_status_html(int64_t page) {
     os << "<p>HA: " << (is_leader_ ? "LEADER" : "follower") << " &middot; "
        << "term " << term_ << " &middot; " << peers_.size()
        << " peer(s) &middot; takeovers " << takeovers_total_ << "</p>";
+    if (!ha_peers_state_.empty()) {
+      os << "<table><tr><th>peer</th><th>term</th><th>granted</th>"
+         << "<th>last lease ack (ms)</th><th>promise left (ms)</th>"
+         << "<th>takeovers</th></tr>";
+      for (const auto& [addr, st] : ha_peers_state_) {
+        int64_t age = st.last_ack_ms > 0 ? now - st.last_ack_ms : -1;
+        bool stale = age < 0 || age > 2 * opt_.lease_timeout_ms;
+        os << "<tr class=\"" << (stale ? "recovering" : "healthy")
+           << "\"><td>" << addr << "</td><td>" << st.term << "</td><td>"
+           << (st.granted ? "yes" : "no") << "</td><td>" << age
+           << "</td><td>" << st.promise_remaining_ms << "</td><td>"
+           << st.takeovers << "</td></tr>";
+      }
+      os << "</table>";
+    }
   }
   os << "<p>next quorum status: " << live_reason << "</p>";
   size_t max_rows = std::max(

@@ -292,6 +292,23 @@ class LighthouseServer : public RpcServer {
   int64_t max_seen_term_ = 0;       // refusal replies teach us the ceiling
   int64_t takeovers_total_ = 0;
   int64_t lease_requests_total_ = 0;
+  // Lighthouse-peer observability federation (ISSUE 15): per-peer lease
+  // channel state recorded by the election thread's renewal/candidacy
+  // rounds, served in /status.json "ha.ha_peers" and /metrics so ONE
+  // leader scrape covers the whole coordination plane.  last_ack_ms is
+  // THIS peer's clock at the last successful lease reply (0 = never);
+  // term/takeovers/promise_remaining_ms/holder echo the reply.
+  struct HaPeerState {
+    int64_t term = 0;
+    bool granted = false;
+    int64_t last_ack_ms = 0;
+    int64_t takeovers = 0;
+    int64_t promise_remaining_ms = 0;
+    std::string holder;
+  };
+  std::map<std::string, HaPeerState> ha_peers_state_;
+  void record_peer_lease_locked(const std::string& peer, const Json& reply,
+                                int64_t now);
   // Low 32 bits of the term-prefixed ids; reset to 0 at takeover.
   int64_t quorum_seq_in_term_ = 0;
   int64_t serving_seq_in_term_ = 0;

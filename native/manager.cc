@@ -128,6 +128,9 @@ QuorumResult compute_quorum_results(const std::string& replica_id,
     Json entry = Json::object();
     entry["replica_id"] = p.replica_id;
     entry["address"] = p.address;
+    // step: lets a healing replica identify the max-step cohort and
+    // stripe its heal fetch across every up-to-date peer (ISSUE 15)
+    entry["step"] = p.step;
     entry["layout_epoch"] = p.layout_epoch;
     entry["data"] = p.data;
     out.participants.push_back(entry);

@@ -28,6 +28,15 @@ Two fixtures under tests/fixtures/:
   reduce -> inter exchange requant -> reduce -> requant -> gather ->
   broadcast -> dequant).
 
+- ``delta_heal.json`` (ISSUE 15): a TRANSIENT crash at a fixed step —
+  the replica's training loop dies and restarts but its parameter
+  memory survives, with one leaf torn (zeroed) by the crash.  The
+  rejoiner heals via the striped DELTA path: it hashes its own state
+  into the source's fragment layout and fetches ONLY the fragments
+  whose digest moved (the torn leaf + the torchft step counters).
+  Pinned bitwise: the per-step per-leaf parameter sums of both
+  replicas AND the changed-fragment count.
+
 Regenerate (after an *intentional* semantics change) with:
     TORCHFT_TPU_REGEN_FIXTURES=1 python -m pytest tests/test_golden_fixtures.py
 """
@@ -250,6 +259,153 @@ class TestQuantizedSyncInt8Golden:
             ],
         }
         _check_or_regen(FIXTURES / "quantized_sync_int8.json", produced)
+
+
+# ---------------------------------------------------------------------------
+# delta heal (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+DH_LEAVES = 6
+DH_TORN_LEAF = "w3"
+DH_KILL_STEP = 2
+DH_TOTAL_STEPS = 5
+
+
+def _delta_heal_replica(replica_id: int, lighthouse_addr: str):
+    """Deterministic SGD over DH_LEAVES separate weight leaves.  A
+    transient crash (train.step fault) kills the LOOP but not the
+    parameter memory; the restart tears one leaf and rejoins — the delta
+    heal must restore exactly the torn leaf + the torchft counters and
+    reuse everything else from the rejoiner's own state."""
+    rng = np.random.default_rng(99 + replica_id)  # unused: grads are f(step)
+    del rng
+    params = {
+        f"w{i}": np.zeros(16, dtype=np.float32) for i in range(DH_LEAVES)
+    }
+    history: "list" = []
+    for _attempt in range(3):
+
+        def load_state_dict(sd):
+            for k in params:
+                params[k] = np.array(sd["params"][k])
+
+        def state_dict():
+            return {"params": {k: v.copy() for k, v in params.items()}}
+
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=10.0),
+            min_replica_size=2,
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            lighthouse_addr=lighthouse_addr,
+            replica_id=f"golden_dh_{replica_id}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=20.0,
+        )
+        try:
+            while manager.current_step() < DH_TOTAL_STEPS:
+                step = manager.current_step()
+                faults.check(
+                    "train.step",
+                    replica=f"golden_dh_{replica_id}",
+                    step=step,
+                )
+                manager.start_quorum()
+                grads = {
+                    k: np.full(16, float(step + 1) * (i + 1),
+                               dtype=np.float32)
+                    for i, k in enumerate(params)
+                }
+                avg = manager.allreduce(grads).wait(timeout=30)
+                if manager.should_commit():
+                    for k in params:
+                        params[k] = params[k] - np.float32(0.1) * avg[k]
+                    history.append(
+                        {
+                            "step": manager.current_step(),
+                            "sums": {
+                                k: float(np.float64(
+                                    params[k].sum(dtype=np.float64)
+                                ))
+                                for k in params
+                            },
+                        }
+                    )
+            return history
+        except InjectedFault:
+            # TRANSIENT crash: the loop dies, the parameter memory
+            # survives — except one leaf torn by the crash.  The rejoin
+            # must repair exactly that leaf (plus the step counters)
+            # over the wire; the rest reuses the local state.
+            params[DH_TORN_LEAF] = np.zeros(16, dtype=np.float32)
+            continue
+        finally:
+            manager.shutdown()
+    raise RuntimeError(f"replica {replica_id} exhausted attempts")
+
+
+class TestDeltaHealGolden:
+    def test_transient_crash_delta_heal_matches_fixture(self):
+        from torchft_tpu.utils import metrics as _metrics
+
+        faults.FAULTS.configure(
+            [
+                FaultRule(
+                    site="train.step",
+                    replica="golden_dh_1",
+                    step=DH_KILL_STEP,
+                )
+            ]
+        )
+        delta_bytes_before = _metrics.HEAL_WIRE_BYTES.labels(
+            mode="delta"
+        ).get()
+        server = LighthouseServer(
+            min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+        )
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futures = [
+                    ex.submit(_delta_heal_replica, i, server.address())
+                    for i in range(2)
+                ]
+                histories = [f.result(timeout=120) for f in futures]
+        finally:
+            server.shutdown()
+        assert faults.FAULTS.injected() == 1
+
+        changed = int(_metrics.HEAL_CHANGED_FRAGMENTS.get())
+        delta_bytes = (
+            _metrics.HEAL_WIRE_BYTES.labels(mode="delta").get()
+            - delta_bytes_before
+        )
+        # structural invariants first: the rejoin actually took the
+        # delta path and its wire scaled with the changed set, not the
+        # model — the torn leaf + torchft counters, nowhere near all
+        # DH_LEAVES + 2 fragments
+        assert 0 < changed <= 3
+        full_payload = DH_LEAVES * 16 * 4
+        assert 0 < delta_bytes < full_payload + 2048
+        for h in histories:
+            assert [e["step"] for e in h] == list(
+                range(1, DH_TOTAL_STEPS + 1)
+            )
+        assert histories[0][-1]["sums"] == histories[1][-1]["sums"]
+
+        produced = {
+            "kill_step": DH_KILL_STEP,
+            "torn_leaf": DH_TORN_LEAF,
+            "total_steps": DH_TOTAL_STEPS,
+            "leaves": DH_LEAVES,
+            "changed_fragments": changed,
+            "history": {
+                f"replica_{i}": h for i, h in enumerate(histories)
+            },
+        }
+        _check_or_regen(FIXTURES / "delta_heal.json", produced)
 
 
 HIER_WORLD = 4

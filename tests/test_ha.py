@@ -222,6 +222,81 @@ class TestLeasedLeadership:
             cli.close()
 
 
+class TestHaPeersFederation:
+    """Lighthouse-peer observability federation (ISSUE 15): one leader
+    scrape covers the whole coordination plane via per-peer
+    lease-channel state."""
+
+    def _wait_ha_peers(self, fleet, cli, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = cli.status(timeout=10.0)
+            rows = status["ha"].get("ha_peers") or []
+            if len(rows) == len(fleet.endpoints()) - 1:
+                return status, rows
+            time.sleep(LEASE_MS / 1000 / 4)
+        raise AssertionError(
+            f"leader never recorded all peers: {status['ha']}"
+        )
+
+    def test_status_ha_peers_schema_roundtrip(self, fleet):
+        cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+        try:
+            status, rows = self._wait_ha_peers(fleet, cli)
+            addrs = {r["address"] for r in rows}
+            # the leader's rows name exactly its two peers (never itself)
+            leader = status["ha"]["leader"]
+            assert leader not in addrs
+            assert addrs < set(fleet.endpoints())
+            for r in rows:
+                # schema round-trip: every documented field is present
+                # and typed (the one-scrape-covers-the-plane contract)
+                assert isinstance(r["term"], int) and r["term"] >= 1
+                assert isinstance(r["granted"], bool)
+                assert r["granted"] is True  # live fleet: grants flow
+                assert 0 <= r["last_ack_age_ms"] < 10_000
+                assert 0 <= r["promise_remaining_ms"] <= LEASE_MS
+                assert isinstance(r["takeovers_total"], int)
+                assert r["holder"] == leader
+        finally:
+            cli.close()
+
+    def test_dead_peer_ack_age_grows(self, fleet):
+        import urllib.request
+
+        cli = LighthouseClient(fleet.addresses(), connect_timeout=5.0)
+        try:
+            self._wait_ha_peers(fleet, cli)
+            leader = fleet.wait_for_leader(10)
+            victim = next(i for i in fleet.alive() if i != leader)
+            victim_addr = fleet.endpoints()[victim]
+            fleet.kill(victim)
+            time.sleep(LEASE_MS / 1000 * 2)
+            status = cli.status(timeout=10.0)
+            row = next(
+                r for r in status["ha"]["ha_peers"]
+                if r["address"] == victim_addr
+            )
+            # the corpse's row survives with a growing ack age — the
+            # federation signal a dashboard alerts on
+            assert row["last_ack_age_ms"] >= LEASE_MS
+            # /metrics on the leader carries the per-peer series
+            scraped = (
+                urllib.request.urlopen(
+                    f"http://{fleet.leader_address()}/metrics", timeout=5
+                )
+                .read()
+                .decode()
+            )
+            assert "torchft_lighthouse_peer_term{peer=" in scraped
+            assert (
+                "torchft_lighthouse_peer_lease_ack_age_ms{peer=" in scraped
+            )
+            assert "torchft_lighthouse_peer_takeovers{peer=" in scraped
+        finally:
+            cli.close()
+
+
 class TestLeaseRpc:
     def test_grant_refuse_renew_semantics(self, fleet):
         leader = fleet.wait_for_leader(10)

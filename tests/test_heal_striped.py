@@ -1,0 +1,473 @@
+"""Striped multi-source delta heal (ISSUE 15).
+
+Unit layer: the shared fragment plane's heal encode
+(``stage_heal_checkpoint`` — header first, fragments as they encode,
+digest manifest last) and the striped receive
+(``HTTPTransport.recv_checkpoint_striped`` — disjoint fragment ranges
+across every source, per-fragment failover, delta diffs, ``into=``
+buffer reuse).
+
+Chaos layer: a stripe source killed MID-heal and a poisoned (bitwise-
+corrupted) fragment both fail over per-fragment to surviving sources and
+the heal completes bitwise — the acceptance property of the striped
+rebuild.  The ``transport.heal.frag`` fault site drives the scheduled
+variants.
+
+Integration layer: a 3-replica fleet with a mid-run kill heals over the
+striped path (multiple stripe sources) and converges bitwise, exactly
+like the legacy path it replaced.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import fragments as frags
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.utils import faults
+from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.faults import FaultRule
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
+
+
+def make_state(leaves: int = 12, seed: int = 7) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "user": {
+            f"w{i}": rng.standard_normal(257).astype(np.float32)
+            for i in range(leaves)
+        },
+        "torchft": {"step": 5, "batches_committed": 10},
+    }
+
+
+def clone_state(state: dict) -> dict:
+    return {
+        "user": {k: v.copy() for k, v in state["user"].items()},
+        "torchft": dict(state["torchft"]),
+    }
+
+
+def assert_state_equal(a: dict, b: dict) -> None:
+    assert a["torchft"] == b["torchft"]
+    assert set(a["user"]) == set(b["user"])
+    for k in a["user"]:
+        np.testing.assert_array_equal(a["user"][k], b["user"][k])
+
+
+@pytest.fixture
+def sources():
+    """Three transports, each stream-staging the SAME state at step 5 —
+    bitwise-replicated heal sources."""
+    state = make_state()
+    transports = [HTTPTransport(timeout=10.0) for _ in range(3)]
+    threads = [
+        threading.Thread(
+            target=t.send_checkpoint_streamed,
+            args=([1], 5, state, 10.0, 6),
+        )
+        for t in transports
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    yield state, transports
+    for t in transports:
+        t.shutdown()
+
+
+class TestStripedHeal:
+    def test_full_heal_striped_bitwise_and_into_reuse(self, sources):
+        state, transports = sources
+        local = clone_state(state)
+        for v in local["user"].values():
+            v[:] = 0.0
+        local["torchft"] = {"step": 0, "batches_committed": 0}
+        retained = {k: v for k, v in local["user"].items()}
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=20.0,
+                local_state_fn=lambda: local, delta=False,
+            )
+        finally:
+            healer.shutdown()
+        assert_state_equal(got, state)
+        assert info["mode"] == "full"
+        assert info["sources"] == 3
+        assert info["changed"] == info["fragments"] == 6
+        # decode landed IN the retained buffers (zero-alloc heal path)
+        for k, buf in retained.items():
+            assert got["user"][k] is buf
+        # the phase split is the ledger's heal vocabulary
+        assert set(info["phases"]) == {
+            "heal_manifest", "heal_diff", "heal_wire", "heal_decode"
+        }
+
+    def test_delta_heal_wire_scales_with_changed_fragments(self, sources):
+        state, transports = sources
+        # rejoiner differs in exactly ONE leaf -> one changed fragment
+        local = clone_state(state)
+        local["user"]["w3"][:] = -1.0
+        before = _metrics.HEAL_WIRE_BYTES.labels(mode="delta").get()
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=20.0,
+                local_state_fn=lambda: local, delta=True,
+            )
+        finally:
+            healer.shutdown()
+        assert_state_equal(got, state)
+        assert info["mode"] == "delta"
+        # w3's fragment + the torchft scalars' fragment(s) at most; far
+        # fewer than all 6 — and the wire carried only those bytes
+        assert 1 <= info["changed"] < info["fragments"]
+        delta_bytes = (
+            _metrics.HEAL_WIRE_BYTES.labels(mode="delta").get() - before
+        )
+        assert delta_bytes == info["wire_bytes"]
+        full_payload = sum(
+            v.nbytes for v in state["user"].values()
+        )
+        assert delta_bytes < full_payload / 2
+
+    def test_delta_identical_state_fetches_nothing(self, sources):
+        state, transports = sources
+        local = clone_state(state)
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=20.0,
+                local_state_fn=lambda: local, delta=True,
+            )
+        finally:
+            healer.shutdown()
+        assert_state_equal(got, state)
+        assert info["changed"] == 0
+        assert info["wire_bytes"] == 0
+
+    def test_kill_stripe_source_mid_heal(self, sources):
+        state, transports = sources
+        # Stretch every fragment fetch well past the kill delay: the
+        # victim's in-flight fragments are guaranteed to still be in
+        # flight when it dies, so the per-fragment failover MUST fire.
+        faults.FAULTS.configure(
+            [FaultRule(site="transport.heal.frag", action="delay",
+                       delay=0.15, times=100)],
+            seed=0,
+        )
+        local = clone_state(state)
+        for v in local["user"].values():
+            v[:] = 0.0
+        killer = threading.Timer(0.05, transports[2].shutdown)
+        killer.start()
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=30.0,
+                local_state_fn=lambda: local, delta=False,
+            )
+        finally:
+            killer.cancel()
+            healer.shutdown()
+        assert_state_equal(got, state)
+        # the dead source's fragments moved to the survivors
+        assert info["failovers"] >= 1
+        # the delay pacing guarantees every worker held work before any
+        # fetch completed, so BOTH survivors delivered fragments
+        assert info["sources_used"] >= 2
+        assert _metrics.HEAL_STRIPE_SOURCES.get() >= 2
+        assert faults.FAULTS.injected("transport.heal.frag") > 0
+
+    def test_dead_source_from_start_fails_over(self, sources):
+        state, transports = sources
+        dead = HTTPTransport(timeout=5.0)
+        dead_addr = dead.metadata()
+        dead.shutdown()
+        local = clone_state(state)
+        for v in local["user"].values():
+            v[:] = 0.0
+        before = _metrics.HEAL_FRAG_FAILOVERS.get()
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [transports[0].metadata(), dead_addr,
+                 transports[1].metadata()],
+                5, timeout=30.0,
+                local_state_fn=lambda: local, delta=False,
+            )
+        finally:
+            healer.shutdown()
+        assert_state_equal(got, state)
+        assert info["failovers"] >= 1
+        assert _metrics.HEAL_FRAG_FAILOVERS.get() > before
+
+    @pytest.mark.parametrize("delta", [True, False])
+    def test_poisoned_fragment_fails_over_and_never_lands(
+        self, sources, delta
+    ):
+        state, transports = sources
+        # bitwise-corrupt one fragment's staged bytes on a NON-primary
+        # source: its sha256 no longer matches the primary's manifest
+        victim = transports[1]
+        with victim._staged_lock.w_lock():
+            raw = bytearray(victim._staged[5].sd["frag:2"])
+            raw[len(raw) // 2] ^= 0xFF
+            victim._staged[5].sd["frag:2"] = bytes(raw)
+        local = clone_state(state)
+        for v in local["user"].values():
+            v[:] = 0.0
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=30.0,
+                local_state_fn=lambda: local, delta=delta,
+            )
+        finally:
+            healer.shutdown()
+        # the healed state is bitwise the fleet's, never the poison
+        assert_state_equal(got, state)
+
+    def test_forged_slot_fragment_cannot_contaminate_other_slots(
+        self, sources
+    ):
+        """A corrupt fragment whose bytes DECODE but claim FOREIGN leaf
+        slots must not overwrite other fragments' leaves (full mode
+        decodes before the deferred verify): the slot-layout check
+        rejects it and the repair pass restores it from the primary."""
+        from torchft_tpu.checkpointing import serialization as ser
+
+        state, transports = sources
+        victim = transports[1]
+        # forge EVERY fragment on the victim as a VALID serialized
+        # stream claiming slot 0 (fragment 0's territory) with a
+        # poisoned value — whatever the dynamic stripe routes to the
+        # victim decodes fine but fails the slot-layout check
+        forged = ser.serialize({"0": np.full(3, -777.0, dtype=np.float32)})
+        with victim._staged_lock.w_lock():
+            for i in range(6):
+                victim._staged[5].sd[f"frag:{i}"] = forged
+        # pace fetches so every worker pops before any completes: the
+        # victim's workers are guaranteed to hold (forged) fragments
+        faults.FAULTS.configure(
+            [FaultRule(site="transport.heal.frag", action="delay",
+                       delay=0.02, times=100)],
+            seed=0,
+        )
+        local = clone_state(state)
+        for v in local["user"].values():
+            v[:] = 0.0
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=30.0,
+                local_state_fn=lambda: local, delta=False,
+            )
+        finally:
+            healer.shutdown()
+        # every leaf bitwise — the forged slot-0 writes never survive
+        # (rejected fragments repaired digest-verified from the primary)
+        assert_state_equal(got, state)
+        assert info["failovers"] >= 1
+
+    def test_poisoned_primary_fragment_heals_from_peers(self, sources):
+        state, transports = sources
+        primary = transports[0]
+        with primary._staged_lock.w_lock():
+            raw = bytearray(primary._staged[5].sd["frag:1"])
+            raw[0] ^= 0xFF
+            primary._staged[5].sd["frag:1"] = bytes(raw)
+        local = clone_state(state)
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=30.0,
+                local_state_fn=lambda: local, delta=True,
+            )
+        finally:
+            healer.shutdown()
+        # delta mode verifies on receipt: the primary's corrupt bytes are
+        # rejected against its OWN manifest and the fragment heals from a
+        # bitwise-replicated peer
+        assert_state_equal(got, state)
+
+    def test_injected_fragment_drop_absorbed_by_retry(self, sources):
+        state, transports = sources
+        faults.FAULTS.configure(
+            [FaultRule(site="transport.heal.frag", action="drop", times=2)],
+            seed=0,
+        )
+        local = clone_state(state)
+        healer = HTTPTransport(timeout=10.0)
+        try:
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata() for t in transports], 5, timeout=30.0,
+                local_state_fn=lambda: local, delta=False,
+            )
+        finally:
+            healer.shutdown()
+        assert_state_equal(got, state)
+        assert faults.FAULTS.injected("transport.heal.frag") == 2
+
+
+class TestHealStagingLifecycle:
+    def test_streamed_slot_survives_one_commit_round(self):
+        """Streamed heal slots hold immutable bytes, so they get ONE
+        round of disallow_checkpoint grace — a striped healer's
+        multi-request window stays open across the sources' commit —
+        and retire on the second round (nothing lingers unbounded).
+        Legacy slots still retire immediately."""
+        state = make_state(leaves=2)
+        t = HTTPTransport(timeout=5.0)
+        try:
+            t.send_checkpoint_streamed([1], 7, state, timeout=5.0)
+            t.send_checkpoint([1], 8, state, timeout=5.0)
+            assert set(t.staged_steps()) == {7, 8}
+            t.disallow_checkpoint()
+            assert t.staged_steps() == [7]  # legacy slot retired at once
+            t.disallow_checkpoint()
+            assert t.staged_steps() == []
+        finally:
+            t.shutdown()
+
+    def test_header_serves_before_encode_finishes(self):
+        """Cut-through contract: the digest-less header (and every
+        already-staged fragment) serves while the source is still
+        encoding; whole-document reads 503 until the manifest lands."""
+        import urllib.error
+
+        state = make_state(leaves=4)
+        t = HTTPTransport(timeout=5.0)
+        try:
+            header, frag_iter = frags.iter_heal_fragments(state, 4)
+            t.begin_streamed_checkpoint(
+                9, {"frag:header": dict(header, version=9)}
+            )
+            name, raw, digest = next(frag_iter)
+            t.stage_streamed_part(9, f"frag:{name}", raw)
+
+            hbuf = frags.fetch_raw(t.metadata(), 9, "frag_header", 2.0,
+                                   role="heal")
+            got_header = frags.decode_manifest(hbuf)
+            assert got_header["fragments"] == ["0", "1", "2", "3"]
+            assert "digests" not in got_header
+            fbuf = frags.fetch_raw(t.metadata(), 9, "frag_0", 2.0,
+                                   role="heal")
+            assert bytes(memoryview(fbuf)) == raw
+            with pytest.raises((urllib.error.HTTPError, TimeoutError)):
+                frags.fetch_raw(t.metadata(), 9, "full", 0.3, role="heal")
+        finally:
+            t.shutdown()
+
+    def test_legacy_source_falls_back_to_whole_document(self):
+        """A source that staged the legacy whole-document snapshot
+        serves a striped healer via the classic full fetch (mixed-config
+        fleet): frag_header 404s and the striped receive falls back."""
+        state = make_state(leaves=3)
+        t = HTTPTransport(timeout=5.0)
+        healer = HTTPTransport(timeout=5.0)
+        try:
+            t.send_checkpoint([1], 4, state, timeout=5.0)
+            got, info = healer.recv_checkpoint_striped(
+                [t.metadata()], 4, timeout=10.0,
+                local_state_fn=None, delta=False,
+            )
+            assert info["mode"] == "legacy"
+            assert_state_equal(got, state)
+        finally:
+            healer.shutdown()
+            t.shutdown()
+
+    def test_into_fallback_is_counted_not_silent(self):
+        """Satellite: a failing state_dict_fn no longer silently
+        disables the warm-buffer receive — it logs and counts
+        torchft_heal_into_fallbacks_total."""
+        state = make_state(leaves=2)
+        src = HTTPTransport(timeout=5.0)
+        before = _metrics.HEAL_INTO_FALLBACKS.get()
+
+        def broken_state():
+            raise RuntimeError("user state fn exploded")
+
+        healer = HTTPTransport(timeout=5.0, state_dict_fn=broken_state)
+        try:
+            src.send_checkpoint_streamed([1], 3, state, timeout=5.0)
+            got, info = healer.recv_checkpoint_striped(
+                [src.metadata()], 3, timeout=10.0, delta=False,
+            )
+            assert_state_equal(got, state)
+            assert _metrics.HEAL_INTO_FALLBACKS.get() == before + 1
+        finally:
+            healer.shutdown()
+            src.shutdown()
+
+    def test_local_digest_layout_matches_staged(self):
+        """local_fragment_digests must produce EXACTLY the digests a
+        source stages for the same state — the delta diff's soundness."""
+        state = make_state(leaves=5)
+        t = HTTPTransport(timeout=5.0)
+        try:
+            manifest = t.send_checkpoint_streamed([1], 2, state,
+                                                  timeout=5.0, fragments=4)
+            _n, mine = frags.local_fragment_digests(state, 4)
+            assert mine == manifest["digests"]
+        finally:
+            t.shutdown()
+
+
+class TestStripedHealInteg:
+    """Fleet-level: a killed replica heals over the striped path and
+    the fleet converges bitwise (Runner/lighthouse idiom of
+    test_manager_integ)."""
+
+    def test_striped_recovery_bitwise(self):
+        from test_manager_integ import (
+            Runner,
+            assert_bitwise_equal,
+            fail_at,
+            run_replicas,
+        )
+
+        from torchft_tpu.coordination import LighthouseServer
+
+        lighthouse = LighthouseServer(
+            min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+        )
+        wire_before = (
+            _metrics.HEAL_WIRE_BYTES.labels(mode="full").get()
+            + _metrics.HEAL_WIRE_BYTES.labels(mode="delta").get()
+        )
+        try:
+            faults.FAULTS.configure([fail_at(replica=1, step=2)])
+            runners = [
+                Runner(i, lighthouse.address(), total_steps=5,
+                       min_replica_size=1)
+                for i in range(3)
+            ]
+            results = run_replicas(runners)
+        finally:
+            lighthouse.shutdown()
+        assert all(r["manager_state"]["step"] == 5 for r in results)
+        assert_bitwise_equal(results)
+        # the heal actually rode the striped fragment plane
+        wire_after = (
+            _metrics.HEAL_WIRE_BYTES.labels(mode="full").get()
+            + _metrics.HEAL_WIRE_BYTES.labels(mode="delta").get()
+        )
+        assert wire_after > wire_before
+        # the heal fetched over the fragment plane (the gauge reports
+        # sources that DELIVERED; with a tiny 4-fragment state on
+        # loopback one source can win every pop race, so >= 1 — the
+        # deterministic >= 2 assertion lives in the delay-paced
+        # TestStripedHeal.test_kill_stripe_source_mid_heal)
+        assert _metrics.HEAL_STRIPE_SOURCES.get() >= 1

@@ -53,9 +53,13 @@ _FLIGHT_ANCHORS: "Tuple[Tuple[str, str], ...]" = (
     ("checkpointing/http_transport.py", "recv_checkpoint"),
     ("checkpointing/pg_transport.py", "send_checkpoint"),
     ("checkpointing/pg_transport.py", "recv_checkpoint"),
-    # the serving tier's streaming data path (ISSUE 14): every raw
-    # fragment fetch and every relay pull must stay post-mortem-visible
-    ("serving/fetcher.py", "fetch_raw"),
+    # the shared fragment plane (ISSUE 15 promoted it out of serving/):
+    # every raw fragment fetch — serving relay pulls AND striped-heal
+    # stripes — plus the striped heal receive must stay
+    # post-mortem-visible
+    ("checkpointing/fragments.py", "fetch_raw"),
+    ("checkpointing/fragments.py", "fetch_serialized"),
+    ("checkpointing/http_transport.py", "recv_checkpoint_striped"),
     ("serving/replica.py", "_pull"),
 )
 

@@ -8,6 +8,14 @@ receivers fetch the full stream or parallel-fetch round-robin chunks with a
 thread pool.  The RWLock guarantees the staged snapshot cannot be replaced
 mid-serve; ``disallow_checkpoint`` retires it before the optimizer mutates
 parameters.
+
+Striped heal (ISSUE 15, docs/architecture.md "Striped heal"): heal
+snapshots can instead stage as a cut-through fragment stream
+(``send_checkpoint_streamed`` — header first, digest manifest last) and
+a healer stripes disjoint fragment ranges across every max-step quorum
+peer (``recv_checkpoint_striped`` — per-fragment failover, delta diffs,
+decode overlapping wire into retained ``into=`` buffers), all over the
+shared fragment plane (``checkpointing/fragments.py``).
 """
 
 from __future__ import annotations
@@ -67,15 +75,30 @@ class _Staged:
     resources (``full``/``metadata``/``chunk_*``) 503 too: a torn
     version must never serve.  ``pooled`` tracks bufpool-backed buffers
     this slot owns; they return to the pool when the slot is retired.
+
+    ``grace``: streamed HEAL slots hold serialized BYTES — immutable
+    copies, unlike the legacy host-array snapshot that aliases the live
+    optimizer state — so they may legally outlive the step commit.  A
+    positive grace survives that many ``disallow_checkpoint`` rounds
+    before retiring, which keeps a striped healer's multi-request fetch
+    window open across the sources' commit instead of tearing it at the
+    first fast peer's ``should_commit``.
     """
 
-    __slots__ = ("sd", "num_chunks", "complete", "pooled")
+    __slots__ = ("sd", "num_chunks", "complete", "pooled", "grace")
 
-    def __init__(self, sd: Any, num_chunks: int = 1, complete: bool = True):
+    def __init__(
+        self,
+        sd: Any,
+        num_chunks: int = 1,
+        complete: bool = True,
+        grace: int = 0,
+    ):
         self.sd = sd
         self.num_chunks = num_chunks
         self.complete = complete
         self.pooled: "List[Any]" = []
+        self.grace = grace
 
     def release(self) -> None:
         from torchft_tpu.utils.bufpool import POOL
@@ -322,6 +345,13 @@ class HTTPTransport(CheckpointTransport[Any]):
     #: fetches); parallel/layout.py gates data-moving switches on it.
     supports_reshard = True
 
+    #: This transport can stage/receive the striped fragment heal
+    #: protocol (ISSUE 15: ``send_checkpoint_streamed`` +
+    #: ``recv_checkpoint_striped``); the Manager gates the streamed heal
+    #: path on this being literally ``True`` so duck-typed test doubles
+    #: keep the legacy whole-document path.
+    supports_striped_heal = True
+
     def __init__(
         self,
         timeout: float = 60.0,
@@ -430,12 +460,22 @@ class HTTPTransport(CheckpointTransport[Any]):
                 self._stream_cond.wait(min(remaining, 0.05))
 
     def begin_streamed_checkpoint(
-        self, step: int, state_dict: Any, timeout: "Optional[float]" = None
+        self,
+        step: int,
+        state_dict: Any,
+        timeout: "Optional[float]" = None,
+        grace: int = 1,
     ) -> None:
         """Stage an INCOMPLETE document (normally just the manifest);
-        fragments arrive via :meth:`stage_streamed_part`."""
+        fragments arrive via :meth:`stage_streamed_part`.  ``grace``:
+        ``disallow_checkpoint`` rounds the finished slot survives (see
+        ``_Staged`` — streamed slots hold immutable bytes, so one round
+        of grace keeps a striped healer's window open across the
+        sources' commit)."""
         with self._staged_lock.w_lock(timeout=timeout or self._lock_timeout):
-            self._put_locked(step, _Staged(dict(state_dict), 1, complete=False))
+            self._put_locked(
+                step, _Staged(dict(state_dict), 1, complete=False, grace=grace)
+            )
         self._wake_stream_waiters()
 
     def stage_streamed_part(
@@ -509,6 +549,296 @@ class HTTPTransport(CheckpointTransport[Any]):
             buf[:] = np.frombuffer(raw, dtype=np.uint8)
             return buf
 
+    def send_checkpoint_streamed(
+        self,
+        dst_ranks: "List[int]",
+        step: int,
+        state_dict: Any,
+        timeout: float,
+        fragments: "Optional[int]" = None,
+    ) -> "dict":
+        """Stage a heal snapshot as a CUT-THROUGH fragment stream
+        (docs/architecture.md "Striped heal"): the digest-less header
+        serves immediately, each fragment serves the moment it encodes
+        (a healer's striped fetch overlaps this host's snapshot/encode),
+        and the digest manifest lands last.  Returns the manifest.
+
+        The step protocol calls this instead of :meth:`send_checkpoint`
+        when streamed heal is enabled (``TORCHFT_HEAL_STREAM``); the
+        staged document serves the same ``frag_*`` resources the serving
+        tier uses, so the whole fragment fetch plane applies."""
+        from torchft_tpu.checkpointing import fragments as frags
+
+        _faults.check("transport.send", step=step)
+        t0_ns = time.time_ns()
+        manifest = frags.stage_heal_checkpoint(
+            self, step, state_dict, fragments=fragments, timeout=timeout
+        )
+        _flightrec.record(
+            "checkpoint.http.stage", start_ns=t0_ns, step=step,
+            dst_ranks=list(dst_ranks),
+            fragments=len(manifest.get("fragments", ())),
+        )
+        return manifest
+
+    def recv_checkpoint_striped(
+        self,
+        sources: "List[str]",
+        step: int,
+        timeout: float,
+        local_state_fn: "Optional[Callable[[], Any]]" = None,
+        delta: "Optional[bool]" = None,
+    ) -> "tuple[Any, dict]":
+        """Striped multi-source heal receive (ISSUE 15).
+
+        ``sources`` are transport base addresses in trust order —
+        ``sources[0]`` is the quorum-assigned PRIMARY whose manifest
+        defines truth; the rest are max-step peers whose bitwise-
+        replicated state lets the healer stripe disjoint fragment
+        ranges across every uplink at once.  Per-fragment failover: a
+        dead/slow/poisoned stripe source's fragments move to the
+        survivors (ultimately the primary).
+
+        Two modes:
+
+        - **delta** (``TORCHFT_HEAL_DELTA``, on, and a local state
+          snapshot is available): fetch the primary's digest manifest,
+          hash the local state into the same fragment layout, and fetch
+          ONLY the fragments whose digest moved — rejoin wire scales
+          with the update delta, not model size.  Every fetched
+          fragment verifies against the primary digest on receipt.
+        - **full**: fetch the digest-less header first (served before
+          the source has encoded anything), stripe ALL fragments while
+          the source is still encoding, then verify the recorded
+          hashes against the primary's manifest (staged last) and
+          refetch any mismatch from the primary alone.
+
+        Decode of fragment *i* (straight into the retained ``into=``
+        leaf buffers) overlaps the wire of every in-flight stripe.
+
+        Returns ``(state_dict, info)`` where ``info`` carries the phase
+        split (``heal_manifest``/``heal_diff``/``heal_wire``/
+        ``heal_decode``), mode, fragment counts and wire bytes.  Falls
+        back to the legacy single-source whole-document fetch when the
+        primary's staged document has no fragments (mixed-config
+        fleet)."""
+        import urllib.error as _uerr
+
+        from torchft_tpu.checkpointing import fragments as frags
+        from torchft_tpu.ops.codec_pool import merged_seconds
+        from torchft_tpu.utils.bufpool import POOL
+        from torchft_tpu.utils.env import env_bool, env_float
+
+        _faults.check("transport.recv", step=step)
+        if not sources:
+            raise ValueError("striped heal: no sources")
+        primary = sources[0]
+        deadline = time.monotonic() + timeout
+        phases: "dict[str, float]" = {}
+        info: "dict[str, Any]" = {"sources": len(sources)}
+        with _flightrec.track(
+            "checkpoint.http.recv", step=step, src_rank=0,
+            sources=len(sources),
+        ) as op:
+            local_state, into = self._build_into_map(local_state_fn)
+            use_delta = (
+                delta
+                if delta is not None
+                else env_bool("TORCHFT_HEAL_DELTA", True)
+            ) and local_state is not None
+
+            # -- manifest phase: the primary defines truth.  Delta needs
+            # the digests (staged last — waits out the source's encode);
+            # full mode starts from the digest-less header (staged
+            # first) so the stripe overlaps the source's encode.
+            t0 = time.perf_counter()
+            want = frags.MANIFEST_FRAG if use_delta else frags.HEADER_FRAG
+            try:
+                mbuf = frags.fetch_raw(
+                    primary, step, f"frag_{want}",
+                    timeout=max(deadline - time.monotonic(), 0.001),
+                    role="heal",
+                )
+            except _uerr.HTTPError as e:
+                if e.code != 404:
+                    raise
+                # Source staged a legacy whole-document snapshot (mixed
+                # config): take the classic path against the primary.
+                result = self._recv_checkpoint(
+                    0, primary, step,
+                    max(deadline - time.monotonic(), 0.001),
+                )
+                op.update(mode="legacy")
+                info.update(mode="legacy", phases=phases)
+                return frags.maybe_decode_heal_doc(result), info
+            try:
+                manifest = frags.decode_manifest(mbuf)
+            finally:
+                POOL.give(mbuf)
+            phases["heal_manifest"] = time.perf_counter() - t0
+
+            names = [str(n) for n in manifest["fragments"]]
+            num_leaves = int(manifest["num_leaves"])
+
+            # -- diff phase: hash the local state into the source's
+            # fragment layout; identical digests need no wire at all.
+            t0 = time.perf_counter()
+            changed = list(names)
+            leaves: "dict[int, Any]" = {}
+            if use_delta:
+                import jax
+
+                local_leaves = jax.tree_util.tree_flatten(local_state)[0]
+                if len(local_leaves) == num_leaves:
+                    _n, mine = frags.local_fragment_digests(
+                        local_state, len(names)
+                    )
+                    src_digests = manifest.get("digests") or {}
+                    changed = [
+                        n for n in names
+                        if src_digests.get(n) != mine.get(n)
+                    ]
+                    for name in names:
+                        if name not in changed:
+                            for slot in frags.fragment_slots(
+                                name, num_leaves, len(names)
+                            ):
+                                leaves[slot] = local_leaves[slot]
+            phases["heal_diff"] = time.perf_counter() - t0
+            mode = "delta" if use_delta else "full"
+
+            # -- wire + decode: striped fetch across every source,
+            # decode of fragment i overlapping the wire of the rest.
+            decode_busy = [0.0]
+            decode_failed: "List[str]" = []
+
+            def _decode(name: str, buf: Any, _sha: str) -> None:
+                t_d = time.perf_counter()
+                try:
+                    sub_into = (
+                        frags.fragment_into_map(
+                            name, num_leaves, len(names), into
+                        )
+                        if into
+                        else None
+                    )
+                    decoded = frags.decode_fragment(buf, into=sub_into)
+                    # Trust boundary: the slot keys come from the (in
+                    # full mode, not-yet-verified) fragment bytes — a
+                    # corrupt fragment claiming FOREIGN slots could
+                    # otherwise overwrite other fragments' leaves with
+                    # garbage the per-fragment repair pass would never
+                    # restore.  Anything but exactly this fragment's
+                    # round-robin slot set is a decode failure.
+                    expected = set(
+                        frags.fragment_slots(name, num_leaves, len(names))
+                    )
+                    if set(decoded) != expected:
+                        raise ValueError(
+                            f"fragment {name}: slots {sorted(decoded)[:4]}"
+                            f"... do not match its layout"
+                        )
+                    leaves.update(decoded)
+                except Exception:  # noqa: BLE001 - repaired below
+                    # Garbage that happened to land before verification
+                    # (full mode verifies AFTER the stripe): remember
+                    # the fragment for the digest-verified repair pass.
+                    decode_failed.append(name)
+                finally:
+                    POOL.give(buf)
+                decode_busy[0] += time.perf_counter() - t_d
+
+            t0 = time.perf_counter()
+            failover_s = env_float(
+                "TORCHFT_HEAL_FAILOVER_S", 2.0, minimum=0.05
+            )
+            stats = frags.striped_fetch(
+                sources, step, changed, deadline,
+                digests=manifest.get("digests") if use_delta else None,
+                source_budget=failover_s,
+                on_buf=_decode,
+            )
+            wire_bytes = stats["wire_bytes"]
+            failovers = stats["failovers"]
+            sources_used = set(stats["sources_used"])
+
+            if not use_delta and changed:
+                # Deferred verify: the digest manifest (staged last —
+                # the source has finished encoding by the time the
+                # stripe drains) checks every recorded hash.
+                mfull = frags.fetch_raw(
+                    primary, step, f"frag_{frags.MANIFEST_FRAG}",
+                    timeout=max(deadline - time.monotonic(), 0.001),
+                    role="heal",
+                )
+                try:
+                    manifest = frags.decode_manifest(mfull)
+                finally:
+                    POOL.give(mfull)
+            digests = manifest.get("digests") or {}
+            bad = sorted(
+                set(decode_failed)
+                | {
+                    n for n in changed
+                    if n in stats["hashes"]
+                    and digests.get(n, stats["hashes"][n])
+                    != stats["hashes"][n]
+                }
+            )
+            if bad:
+                # Repair pass: mismatched/undecodable fragments refetch
+                # from the PRIMARY alone, digest-verified on receipt; a
+                # decode failure here is terminal (the primary's own
+                # bytes are truth — there is nothing left to fail over
+                # to).
+                _metrics.HEAL_FRAG_FAILOVERS.inc(len(bad))
+                failovers += len(bad)
+                decode_failed.clear()
+                restats = frags.striped_fetch(
+                    [primary], step, bad, deadline,
+                    digests=digests, on_buf=_decode,
+                )
+                wire_bytes += restats["wire_bytes"]
+                sources_used |= set(restats["sources_used"])
+                if decode_failed:
+                    raise ValueError(
+                        f"striped heal: fragments {decode_failed} from "
+                        f"the primary verified but failed to decode"
+                    )
+            loop_wall = time.perf_counter() - t0
+            wire_busy = merged_seconds(stats["spans"])
+            phases["heal_decode"] = decode_busy[0]
+            phases["heal_wire"] = max(
+                wire_busy, loop_wall - decode_busy[0], 0.0
+            )
+
+            _metrics.HEAL_WIRE_BYTES.labels(mode=mode).inc(wire_bytes)
+            # the gauge reports sources that DELIVERED fragments, not
+            # the configured list — a degraded stripe (dead peers, all
+            # bytes from the primary) must read as 1, not len(sources);
+            # a delta heal that fetched nothing still talked to the
+            # primary for the manifest, hence the floor of 1
+            _metrics.HEAL_STRIPE_SOURCES.set(max(len(sources_used), 1))
+            _metrics.HEAL_CHANGED_FRAGMENTS.set(len(changed))
+            _metrics.CHECKPOINT_DURATION.labels(
+                transport="http", direction="recv"
+            ).observe(sum(phases.values()))
+            state = frags.assemble(manifest, leaves)
+            info.update(
+                mode=mode,
+                fragments=len(names),
+                changed=len(changed),
+                wire_bytes=wire_bytes,
+                failovers=failovers,
+                sources_used=len(sources_used),
+                phases=phases,
+            )
+            op.update(
+                mode=mode, fragments=len(names), changed=len(changed),
+                bytes=wire_bytes, failovers=failovers,
+            )
+        return state, info
+
     def recv_checkpoint(
         self,
         src_rank: int,
@@ -531,6 +861,44 @@ class HTTPTransport(CheckpointTransport[Any]):
                 src_rank, metadata, step, timeout, resource
             )
 
+    def _build_into_map(
+        self, state_fn: "Optional[Callable[[], Any]]" = None
+    ) -> "tuple[Optional[Any], Optional[dict]]":
+        """Snapshot the local state and build the ``{global leaf slot:
+        ndarray}`` in-place receive map for ``serialization.deserialize_from``
+        (the warm-buffer fast path — cold allocations page-fault during
+        the socket reads and roughly halve effective recv bandwidth).
+
+        Only the user-supplied state callable may fail (it is arbitrary
+        training code); that fallback is LOUD — logged and counted in
+        ``torchft_heal_into_fallbacks_total`` — because silently decoding
+        into fresh arrays every heal is a decode-path perf regression,
+        not a benign default.  Returns ``(state, into)``, both ``None``
+        when no state callable is available."""
+        import jax
+        import numpy as np
+
+        fn = state_fn if state_fn is not None else self._state_dict_fn
+        if fn is None:
+            return None, None
+        try:
+            state = fn()
+        except Exception as e:  # noqa: BLE001 - user state fn, but LOUD
+            logger.warning(
+                "heal recv: state_dict_fn failed (%s: %s); decoding into "
+                "freshly allocated arrays this heal",
+                type(e).__name__, e,
+            )
+            _metrics.HEAL_INTO_FALLBACKS.inc()
+            return None, None
+        existing = jax.tree_util.tree_flatten(state)[0]
+        into = {
+            i: leaf
+            for i, leaf in enumerate(existing)
+            if isinstance(leaf, np.ndarray)
+        }
+        return state, into
+
     def _recv_checkpoint(
         self,
         src_rank: int,
@@ -543,20 +911,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         deadline = time.monotonic() + timeout
         t_recv = time.perf_counter()
 
-        into = None
-        if self._state_dict_fn is not None:
-            try:
-                import jax
-                import numpy as np
-
-                existing = jax.tree_util.tree_flatten(self._state_dict_fn())[0]
-                into = {
-                    i: leaf
-                    for i, leaf in enumerate(existing)
-                    if isinstance(leaf, np.ndarray)
-                }
-            except Exception:  # noqa: BLE001 - fall back to fresh alloc
-                into = None
+        _state, into = self._build_into_map()
 
         # Trace propagation: the destination's round context rides a
         # ``traceparent`` header so the SOURCE's serve spans join this
@@ -618,9 +973,16 @@ class HTTPTransport(CheckpointTransport[Any]):
         """Retire heal snapshots (real, >= 0 step keys) before the
         optimizer mutates parameters.  Reshard staging (negative keys)
         stays until its switch commits/rolls back — peers may still be
-        mid-fetch when this group's step commits."""
+        mid-fetch when this group's step commits.  Streamed heal slots
+        with remaining ``grace`` survive (they hold immutable serialized
+        bytes, not aliases of the live state — see ``_Staged``); each
+        call burns one grace round so nothing lingers unbounded."""
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
             for k in [k for k in self._staged if k >= 0]:
+                staged = self._staged[k]
+                if staged.grace > 0:
+                    staged.grace -= 1
+                    continue
                 self._staged.pop(k).release()
         self._wake_stream_waiters()
 

@@ -94,6 +94,13 @@ PHASE_CATEGORY = {
     "ring": "wire",
     "heal_send": "wire",
     "heal_recv": "wire",
+    # striped-heal receive split (ISSUE 15): the manifest fetch is a
+    # protocol round trip, the digest diff and fragment decode are codec
+    # work, the striped fragment fetches are wire
+    "heal_manifest": "protocol",
+    "heal_diff": "codec",
+    "heal_wire": "wire",
+    "heal_decode": "codec",
     # online parallelism switching (parallel/layout.py): the reshard
     # slice-diff transfers are wire cost; the commit round is protocol
     "reshard": "wire",

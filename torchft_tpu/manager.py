@@ -55,7 +55,7 @@ from torchft_tpu.utils import faults as faults
 from torchft_tpu.utils import flightrecorder as flightrec
 from torchft_tpu.utils import metrics as metrics
 from torchft_tpu.utils import tracing as tracing
-from torchft_tpu.utils.env import env_float, env_int, env_str
+from torchft_tpu.utils.env import env_bool, env_float, env_int, env_str
 from torchft_tpu.utils.logging import ReplicaLogger, log_event
 from torchft_tpu.utils.retry import RetryPolicy
 from torchft_tpu.utils.rwlock import RWLock
@@ -79,6 +79,13 @@ PROTOCOL_PHASES = (
     "pg_configure",
     "heal_send",
     "heal_recv",
+    # striped-heal receive split (ISSUE 15): manifest fetch from the
+    # primary / local digest diff / striped fragment wire / decode into
+    # retained buffers — heal_recv stays the umbrella total.
+    "heal_manifest",
+    "heal_diff",
+    "heal_wire",
+    "heal_decode",
     "reshard",
     "layout_commit",
     "host_sync",
@@ -738,6 +745,63 @@ class Manager:
         if not allow_heal:
             return
 
+        # Striped heal (ISSUE 15): stream-stage fragments + stripe the
+        # receive across every max-step peer when the transport carries
+        # the fragment protocol (the flag must be literally True so
+        # duck-typed test doubles keep the legacy path).
+        streamed_heal = (
+            env_bool("TORCHFT_HEAL_STREAM", True)
+            and getattr(
+                self._checkpoint_transport, "supports_striped_heal", False
+            )
+            is True
+        )
+
+        # Proactive stripe-source staging: a max-step participant can
+        # tell healers exist this round (the max-step cohort is smaller
+        # than the quorum) and stages its own fragment stream so healers
+        # aggregate up-to-date uplinks beyond the assigned primary's.
+        # Bounded by the SAME pure quorum math the healer's source
+        # resolution applies: every healer stripes over the first
+        # TORCHFT_HEAL_SOURCES max-step roster entries (minus its
+        # primary), so only those participants stage — a 64-replica
+        # fleet must not burn 60 full encodes for slots nobody fetches.
+        # Degrade-only: a failed proactive stage merely shrinks the
+        # healer's stripe back toward the primary.
+        if (
+            streamed_heal
+            and not quorum.recover_dst_replica_ranks
+            and not quorum.heal
+            and quorum.max_replica_rank is not None
+            and quorum.max_world_size < quorum.replica_world_size
+            and self._in_stripe_source_set(quorum)
+        ):
+            t_send = time.perf_counter()
+            try:
+                self._checkpoint_transport.send_checkpoint_streamed(
+                    dst_ranks=[],
+                    step=quorum.max_step,
+                    state_dict=self._manager_state_dict(),
+                    timeout=self._timeout,
+                )
+                self._record_phase("heal_send", time.perf_counter() - t_send)
+                log_event(
+                    "heal",
+                    "staged stripe-source checkpoint for healing peers",
+                    job_id=env_str("JOB_ID", "unknown"),
+                    replica_id=self._replica_id,
+                    rank=self._group_rank,
+                    quorum_id=quorum.quorum_id,
+                    step=quorum.max_step,
+                    direction="send",
+                    proactive=True,
+                )
+            except Exception as e:  # noqa: BLE001 - degrade, never wedge
+                self._logger.warning(
+                    f"proactive stripe-source staging failed "
+                    f"(healers fall back to fewer sources): {e}"
+                )
+
         try:
             if quorum.recover_dst_replica_ranks:
                 faults.check(
@@ -750,12 +814,20 @@ class Manager:
                 with jax.profiler.TraceAnnotation(
                     "torchft::manager::_checkpoint_transport::send_checkpoint"
                 ):
-                    self._checkpoint_transport.send_checkpoint(
-                        dst_ranks=quorum.recover_dst_replica_ranks,
-                        step=quorum.max_step,
-                        state_dict=self._manager_state_dict(),
-                        timeout=self._timeout,
-                    )
+                    if streamed_heal:
+                        self._checkpoint_transport.send_checkpoint_streamed(
+                            dst_ranks=quorum.recover_dst_replica_ranks,
+                            step=quorum.max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
+                    else:
+                        self._checkpoint_transport.send_checkpoint(
+                            dst_ranks=quorum.recover_dst_replica_ranks,
+                            step=quorum.max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
                 self._record_phase("heal_send", time.perf_counter() - t_send)
                 metrics.HEALS.labels(
                     replica_id=self._metric_replica_id, direction="send"
@@ -796,17 +868,66 @@ class Manager:
                 with jax.profiler.TraceAnnotation(
                     "torchft::manager::_checkpoint_transport::recv_checkpoint"
                 ):
-                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=quorum.recover_src_replica_rank,
-                        metadata=checkpoint_metadata,
-                        step=quorum.max_step,
-                        timeout=self._timeout,
-                    )
+                    heal_info: "Dict[str, Any]" = {}
+                    if streamed_heal:
+                        sources = [checkpoint_metadata]
+                        # Stripe only when genuinely BEHIND the cohort:
+                        # an init-sync force-recover round has every
+                        # replica at max_step with unsynchronized state —
+                        # only the primary's copy is truth there.
+                        if quorum.max_replica_rank is None:
+                            sources += self._resolve_stripe_sources(
+                                quorum, checkpoint_metadata
+                            )
+                        (
+                            self._pending_state_dict,
+                            heal_info,
+                        ) = self._checkpoint_transport.recv_checkpoint_striped(
+                            sources,
+                            step=quorum.max_step,
+                            timeout=self._timeout,
+                            local_state_fn=self._manager_state_dict,
+                        )
+                    else:
+                        self._pending_state_dict = (
+                            self._checkpoint_transport.recv_checkpoint(
+                                src_rank=quorum.recover_src_replica_rank,
+                                metadata=checkpoint_metadata,
+                                step=quorum.max_step,
+                                timeout=self._timeout,
+                            )
+                        )
                 self.load_state_dict(self._pending_state_dict["torchft"])
                 # loading the torchft dict restores the step; set it anyway
                 # to make reasoning (and tests) simpler
                 self._step = quorum.max_step
-                self._record_phase("heal_recv", time.perf_counter() - t_recv)
+                # Phase split (ISSUE 15): the striped path records its
+                # four sub-phases plus the residue (metadata RPC, source
+                # resolution, reassembly) under the legacy heal_recv
+                # name, so ledger sums stay exact and never double-count
+                # a split phase against its umbrella.
+                heal_phases = heal_info.get("phases") or {}
+                if "heal_manifest" in heal_phases:
+                    self._record_phase(
+                        "heal_manifest", heal_phases["heal_manifest"]
+                    )
+                if "heal_diff" in heal_phases:
+                    self._record_phase("heal_diff", heal_phases["heal_diff"])
+                if "heal_wire" in heal_phases:
+                    self._record_phase("heal_wire", heal_phases["heal_wire"])
+                if "heal_decode" in heal_phases:
+                    self._record_phase(
+                        "heal_decode", heal_phases["heal_decode"]
+                    )
+                self._record_phase(
+                    "heal_recv",
+                    max(
+                        time.perf_counter()
+                        - t_recv
+                        - sum(heal_phases.values()),
+                        0.0,
+                    ),
+                )
                 metrics.HEALS.labels(
                     replica_id=self._metric_replica_id, direction="recv"
                 ).inc()
@@ -820,10 +941,87 @@ class Manager:
                     step=quorum.max_step,
                     direction="recv",
                     src_rank=quorum.recover_src_replica_rank,
+                    mode=heal_info.get("mode", "legacy"),
+                    stripe_sources=heal_info.get("sources", 1),
+                    changed_fragments=heal_info.get("changed"),
                 )
         except Exception as e:  # noqa: BLE001 - captured into the protocol
             self._logger.exception(f"got exception in recovery: {e}")
             self.report_error(e)
+
+    def _in_stripe_source_set(self, quorum: Any) -> bool:
+        """True when this replica is among the first
+        ``TORCHFT_HEAL_SOURCES`` max-step participants in roster order —
+        the superset every healer's ``_resolve_stripe_sources`` pick
+        (first ``max_sources - 1`` entries after excluding its primary)
+        can reach, computed from the same roster on every peer."""
+        max_sources = env_int("TORCHFT_HEAL_SOURCES", 4, minimum=1)
+        pos = 0
+        for p in quorum.participants:
+            if not isinstance(p, dict) or p.get("step") != quorum.max_step:
+                continue
+            if p.get("replica_id") == self._replica_id:
+                return pos < max_sources
+            pos += 1
+        return False
+
+    def _resolve_stripe_sources(
+        self, quorum: Any, primary_metadata: str
+    ) -> "List[str]":
+        """Transport addresses of the max-step quorum peers beyond the
+        assigned primary — the striped heal's extra sources.
+
+        The participants roster (replica-rank order) carries each peer's
+        manager address and step; every peer at ``max_step`` holds
+        bitwise-replicated state, so its fragments must hash to the
+        primary's manifest digests.  Each candidate's checkpoint
+        transport address resolves through its manager's
+        ``checkpoint_metadata`` RPC (the same discovery heal and reshard
+        use), in parallel and best-effort: an unreachable peer just
+        shrinks the stripe.  Bounded by ``TORCHFT_HEAL_SOURCES``
+        (total sources including the primary)."""
+        max_sources = env_int("TORCHFT_HEAL_SOURCES", 4, minimum=1)
+        candidates: "List[str]" = []
+        for i, p in enumerate(quorum.participants):
+            if not isinstance(p, dict):
+                continue
+            if i == quorum.recover_src_replica_rank:
+                continue
+            if p.get("step", -1) != quorum.max_step:
+                continue
+            addr = p.get("address") or ""
+            if addr:
+                candidates.append(addr)
+            if len(candidates) >= max_sources - 1:
+                break
+        if not candidates:
+            return []
+
+        def _resolve(addr: str) -> "Optional[str]":
+            client = ManagerClient(
+                addr, connect_timeout=self._connect_timeout
+            )
+            try:
+                return client._checkpoint_metadata(
+                    self._group_rank, timeout=self._connect_timeout
+                )
+            except Exception as e:  # noqa: BLE001 - best-effort stripe
+                self._logger.info(
+                    f"stripe source {addr} unresolvable ({e}); striping "
+                    f"without it"
+                )
+                return None
+            finally:
+                client.close()
+
+        with ThreadPoolExecutor(
+            max_workers=min(len(candidates), 4),
+            thread_name_prefix="tft_stripe_resolve",
+        ) as pool:
+            resolved = list(pool.map(_resolve, candidates))
+        return [
+            m for m in resolved if m and m != primary_metadata
+        ]
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"

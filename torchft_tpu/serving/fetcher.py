@@ -1,54 +1,21 @@
-"""Shared fragment-fetch plane for the serving tier (ISSUE 14).
+"""Shared fragment-fetch plane — thin alias.
 
-One raw-HTTP fetch path used by BOTH sides of the streaming data path:
-the relay pull (``ServingReplica``: cut-through restaging of opaque
-verified bytes) and the client delta fetch (``ServingClient``: decode of
-fragment *i* overlapped with the wire of fragment *i+1*).
-
-Two things distinguish it from the ``urllib.urlopen``-per-fragment path
-it replaces:
-
-- **Persistent connections.**  HTTP/1.1 keep-alive connections are
-  cached per ``(thread, base address)``, so a delta fetch of K changed
-  fragments pays one TCP connect — and, under the WAN wire model, the
-  per-message RTT charges overlap across the bounded-parallel in-flight
-  window instead of serializing.  (Error responses close the connection
-  per ``http.server`` semantics; the steady-state 200 stream reuses it.)
-- **Bufpool-backed receive.**  Fragment bodies land straight in
-  process-pool ``uint8`` buffers via ``readinto`` — no intermediate
-  bytes assembly, zero steady-state allocation on the relay hot path.
-  Ownership of the returned buffer transfers to the caller: stage it
-  (the HTTP transport's streamed staging returns it to the pool on
-  retirement) or ``POOL.give`` it back after decoding.
-
-Every fetch is one ``serving.frag`` flight record (+ span when the step
-is sampled) and consults the ``serving.frag`` chaos site with ``step`` =
-the fragment's index in its stream (``pg.allreduce.chunk`` idiom:
-deterministic mid-stream targeting), falling back to the version for
-single fetches.
+The pipelined fetch plane (persistent per-``(thread, netloc)``
+connections, bufpool ``readinto`` receive, 503-poll retry, WAN
+wire-model charge, per-fragment flight/span/fault telemetry) was
+promoted to ``torchft_tpu/checkpointing/fragments.py`` (ISSUE 15) so
+live healing stripes over the same plane the serving tier relays on;
+this module keeps the serving tier's import surface stable.
 """
 
 from __future__ import annotations
 
-import http.client
-import threading
-import time
-import urllib.error
-from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
-from urllib.parse import urlparse
-
-import numpy as np
-
-from torchft_tpu.serving import wire as _wire
-from torchft_tpu.utils import faults as _faults
-from torchft_tpu.utils import flightrecorder as _flightrec
-from torchft_tpu.utils import metrics as _metrics
-from torchft_tpu.utils import tracing as _tracing
-from torchft_tpu.utils.bufpool import POOL
-from torchft_tpu.utils.env import env_int
-from torchft_tpu.utils.retry import RetryPolicy
+from torchft_tpu.checkpointing.fragments import (  # noqa: F401
+    FragmentFetcher,
+    close_connections,
+    fetch_raw,
+    fetch_serialized,
+)
 
 __all__ = [
     "FragmentFetcher",
@@ -56,347 +23,3 @@ __all__ = [
     "fetch_serialized",
     "close_connections",
 ]
-
-# Fragment fetch retry: 503 = the version/fragment exists fleet-wide but
-# this node has not staged it yet (publisher encoding, parent relay
-# still streaming it — the cut-through poll) — poll within the source's
-# budget.  Connection errors (server killed mid-fetch, stale keep-alive
-# connection) retry here too; budget expiry surfaces so the caller fails
-# over to the next source.  The backoff ceiling is deliberately LOW:
-# cut-through fragments land every few ms–tens of ms, so a 0.5 s ceiling
-# would add more cascade latency per hop than the fragment wire itself
-# (the polls ride a kept-alive connection, so each one is cheap).
-_FRAG_POLICY = RetryPolicy(
-    name="serving.frag",
-    base_delay=0.01,
-    multiplier=1.6,
-    max_delay=0.1,
-    retry_if=lambda e: (
-        e.code == 503
-        if isinstance(e, urllib.error.HTTPError)
-        else isinstance(e, (urllib.error.URLError, ConnectionError, OSError))
-    ),
-)
-
-_conns = threading.local()
-
-
-def _conn_cache() -> "Dict[str, http.client.HTTPConnection]":
-    cache = getattr(_conns, "cache", None)
-    if cache is None:
-        cache = _conns.cache = {}
-    return cache
-
-
-def _conn_for(base: str, timeout: float) -> http.client.HTTPConnection:
-    cache = _conn_cache()
-    conn = cache.get(base)
-    if conn is None:
-        p = urlparse(base)
-        conn = http.client.HTTPConnection(
-            p.hostname or "127.0.0.1", p.port, timeout=timeout
-        )
-        cache[base] = conn
-    conn.timeout = timeout
-    if conn.sock is not None:
-        conn.sock.settimeout(timeout)
-    return conn
-
-
-def _drop_conn(base: str) -> None:
-    conn = _conn_cache().pop(base, None)
-    if conn is not None:
-        try:
-            conn.close()
-        except Exception:  # noqa: BLE001 - teardown best-effort
-            pass
-
-
-def close_connections() -> None:
-    """Close THIS thread's cached keep-alive connections (tests; worker
-    threads drop theirs when their executor shuts down)."""
-    for base in list(_conn_cache()):
-        _drop_conn(base)
-
-
-def _request_once(
-    base: str, path: str, timeout: float
-) -> http.client.HTTPResponse:
-    """One GET over the cached keep-alive connection; returns the live
-    200 response (the caller consumes the body).  Raises
-    ``urllib.error.HTTPError`` on non-200 (503 = retryable
-    not-yet-staged, drained so the connection stays reusable) and
-    ``ConnectionError`` / ``OSError`` on transport failure."""
-    conn = _conn_for(base, timeout)
-    headers = {}
-    traceparent = _tracing.current_traceparent()
-    if traceparent:
-        headers["traceparent"] = traceparent
-    try:
-        conn.request("GET", path, headers=headers)
-        resp = conn.getresponse()
-        if resp.status != 200:
-            body = resp.read()  # drain so the connection could be reused
-            if resp.will_close:
-                _drop_conn(base)
-            raise urllib.error.HTTPError(
-                f"{base}{path}",
-                resp.status,
-                body[:200].decode("utf-8", "replace") or resp.reason,
-                resp.headers,
-                None,
-            )
-        return resp
-    except (OSError, http.client.HTTPException) as e:
-        if isinstance(e, urllib.error.HTTPError):
-            raise
-        _drop_conn(base)
-        if isinstance(e, OSError):
-            raise
-        raise ConnectionError(f"http fetch {base}{path}: {e}") from e
-
-
-def _get_raw_once(base: str, path: str, timeout: float) -> np.ndarray:
-    """One GET returning a POOLED uint8 buffer the caller owns."""
-    resp = _request_once(base, path, timeout)
-    try:
-        n = int(resp.headers.get("Content-Length") or 0)
-        buf = POOL.take(n, np.uint8)
-        try:
-            view = memoryview(buf)
-            off = 0
-            while off < n:
-                got = resp.readinto(view[off:])
-                if not got:
-                    raise ConnectionError(
-                        f"http fetch {base}{path}: body ended {n - off} "
-                        f"bytes short"
-                    )
-                off += got
-        except BaseException:
-            POOL.give(buf)
-            raise
-        if resp.will_close:
-            _drop_conn(base)
-        return buf
-    except (OSError, http.client.HTTPException) as e:
-        _drop_conn(base)
-        if isinstance(e, OSError):
-            raise
-        raise ConnectionError(f"http fetch {base}{path}: {e}") from e
-
-
-def fetch_raw(
-    base: str,
-    version: int,
-    resource: str,
-    timeout: float,
-    role: str = "client",
-    frag_index: "Optional[int]" = None,
-) -> np.ndarray:
-    """Fetch one staged resource as raw wire bytes (POOLED uint8 buffer —
-    the caller owns giving it back or staging it), with the 503-poll
-    retry, the WAN wire-model charge, and per-fragment telemetry."""
-    path = f"/checkpoint/{version}/{resource}"
-    t0 = time.perf_counter()
-    t0_ns = time.time_ns()
-
-    def attempt(budget: "Optional[float]") -> np.ndarray:
-        # Chaos INSIDE the attempt: an injected drop takes exactly the
-        # broken-connection path a real one would — absorbed by this
-        # policy's in-budget retries (docs/robustness.md serving.frag),
-        # while raise surfaces to the caller's source-failover walk.
-        _faults.check(
-            "serving.frag",
-            step=frag_index if frag_index is not None else version,
-        )
-        t = max(budget if budget is not None else 0.001, 0.001)
-        return _get_raw_once(base, path, t)
-
-    buf = _FRAG_POLICY.run(
-        attempt, timeout=max(timeout, 0.001), op="serving.frag"
-    )
-    # WAN wire model (serving/wire.py): one RTT + bytes/rate of source-
-    # uplink bucket debt per fetch message crossing the topology boundary
-    _wire.get_shaper().charge(base, buf.nbytes)
-    _metrics.SERVING_FETCH_BYTES.labels(role=role).inc(buf.nbytes)
-    _flightrec.record(
-        "serving.frag", start_ns=t0_ns, step=version, resource=resource,
-        bytes=buf.nbytes, source=base, role=role,
-    )
-    tracer = _tracing.get_tracer()
-    ctx = _tracing.get_current()
-    if tracer is not None and ctx is not None and ctx.sampled:
-        tracer.export_span(
-            name="serving.frag",
-            trace_id=ctx.trace_id,
-            parent_span_id=ctx.span_id,
-            start_ns=t0_ns,
-            end_ns=time.time_ns(),
-            attributes={
-                "version": version, "resource": resource,
-                "bytes": buf.nbytes, "role": role,
-            },
-        )
-    return buf
-
-
-def fetch_serialized(
-    base: str,
-    version: int,
-    resource: str,
-    timeout: float,
-    role: str = "client",
-) -> "Tuple[Any, Dict[int, Any], int]":
-    """Fetch one resource and deserialize it STRAIGHT OFF the socket —
-    the whole-payload (``full``) path: a multi-GB document lands
-    directly in its final leaf buffers (serialization.py's streaming
-    contract) instead of being buffered raw and copied again.  Returns
-    ``(skeleton, leaves, num_leaves)``; same retry/wire/telemetry
-    envelope as :func:`fetch_raw`."""
-    from torchft_tpu.checkpointing import serialization as ser
-
-    path = f"/checkpoint/{version}/{resource}"
-    t0_ns = time.time_ns()
-
-    def attempt(budget: "Optional[float]") -> "Tuple[Any, Dict[int, Any], int, int]":
-        _faults.check("serving.frag", step=version)
-        t = max(budget if budget is not None else 0.001, 0.001)
-        resp = _request_once(base, path, t)
-        nbytes = int(resp.headers.get("Content-Length") or 0)
-        try:
-            out = ser.deserialize_from(resp)
-            resp.read()  # drain any trailer so the connection is reusable
-        except BaseException as e:
-            # mid-body failure: unknown remainder, the conn can't be kept
-            _drop_conn(base)
-            if isinstance(e, EOFError):
-                # truncated stream = broken connection: retryable
-                raise ConnectionError(
-                    f"http fetch {base}{path}: truncated stream: {e}"
-                ) from e
-            raise
-        if resp.will_close:
-            _drop_conn(base)
-        return out + (nbytes,)
-
-    skeleton, leaves, n, nbytes = _FRAG_POLICY.run(
-        attempt, timeout=max(timeout, 0.001), op="serving.frag"
-    )
-    _wire.get_shaper().charge(base, nbytes)
-    _metrics.SERVING_FETCH_BYTES.labels(role=role).inc(nbytes)
-    _flightrec.record(
-        "serving.frag", start_ns=t0_ns, step=version, resource=resource,
-        bytes=nbytes, source=base, role=role,
-    )
-    return skeleton, leaves, n
-
-
-class FragmentFetcher:
-    """Bounded-parallel pipelined fragment fetcher.
-
-    ``parallel`` (default ``TORCHFT_SERVING_PARALLEL``) raw fetches ride
-    persistent per-thread connections concurrently; results come back in
-    SUBMISSION order so the consumer's verify/decode/stage of fragment
-    *i* overlaps the wire of fragments *i+1..i+K*.
-    """
-
-    def __init__(
-        self, parallel: "Optional[int]" = None, role: str = "client"
-    ) -> None:
-        self._parallel = (
-            parallel
-            if parallel is not None
-            else env_int("TORCHFT_SERVING_PARALLEL", 4, minimum=1)
-        )
-        self._role = role
-        self._pool: "Optional[ThreadPoolExecutor]" = None
-        self._lock = threading.Lock()
-
-    def _executor(self) -> ThreadPoolExecutor:
-        with self._lock:
-            if self._pool is None:
-                self._pool = ThreadPoolExecutor(
-                    max_workers=self._parallel,
-                    thread_name_prefix="tft_serving_fetch",
-                )
-            return self._pool
-
-    def fetch_raw(
-        self, base: str, version: int, resource: str, timeout: float
-    ) -> np.ndarray:
-        return fetch_raw(base, version, resource, timeout, role=self._role)
-
-    def fetch_stream(
-        self,
-        base: str,
-        version: int,
-        resources: "List[str]",
-        deadline: float,
-    ) -> "Iterator[Tuple[str, np.ndarray, Tuple[float, float]]]":
-        """Pipelined raw fetches of ``resources`` from one source; yields
-        ``(resource, pooled_buffer, (wire_start, wire_end))`` in
-        submission order — the perf-counter interval each fetch occupied
-        the wire, so the consumer can compute true (union) wire busy
-        time across the concurrent in-flight window.  On failure,
-        buffers still in flight are drained back to the pool and the
-        error re-raised (the caller fails over to another source;
-        already-yielded items stay valid and staged)."""
-        if not resources:
-            return
-        ex = self._executor()
-        pending: "deque[Tuple[str, Future]]" = deque()
-        it = iter(enumerate(resources))
-
-        def _timed(
-            res: str, idx: int
-        ) -> "Tuple[np.ndarray, Tuple[float, float]]":
-            t0 = time.perf_counter()
-            buf = fetch_raw(
-                base, version, res,
-                timeout=max(deadline - time.monotonic(), 0.001),
-                role=self._role, frag_index=idx,
-            )
-            return buf, (t0, time.perf_counter())
-
-        def _submit_next() -> bool:
-            try:
-                idx, res = next(it)
-            except StopIteration:
-                return False
-            pending.append((res, ex.submit(_timed, res, idx)))
-            return True
-
-        def _drain_pending() -> None:
-            while pending:
-                _res, fut = pending.popleft()
-                try:
-                    buf, _ = fut.result()
-                except BaseException:  # noqa: BLE001 - already failing
-                    continue
-                POOL.give(buf)
-
-        for _ in range(self._parallel):
-            if not _submit_next():
-                break
-        try:
-            while pending:
-                res, fut = pending.popleft()
-                try:
-                    buf, span = fut.result()
-                except BaseException:
-                    _drain_pending()
-                    raise
-                _submit_next()
-                yield res, buf, span
-        except GeneratorExit:
-            # consumer abandoned the stream mid-flight (failover after a
-            # verify failure): nothing may leak out of the pool
-            _drain_pending()
-            raise
-
-    def close(self) -> None:
-        with self._lock:
-            pool, self._pool = self._pool, None
-        if pool is not None:
-            pool.shutdown(wait=False)

@@ -1,53 +1,30 @@
-"""Versioned weight-payload codec for the serving tier.
+"""Versioned weight-payload codec for the serving tier — thin alias.
 
-A published weight version is one staged checkpoint-transport document
-(``HTTPTransport`` multi-slot staging keyed by VERSION instead of step):
-
-.. code-block:: text
-
-    {
-      "frag:manifest": {version, wire, fragments, digests, skeleton,
-                        num_leaves, created_ns},
-      "frag:0": <serialized fragment wire bytes>,
-      ...
-      "frag:<F-1>": <bytes>,
-    }
-
-Every fragment is independently fetchable via the transport's
-``frag_<name>`` resource, so a client that already holds version ``V``
-can pull version ``V+1`` as *manifest + changed fragments only* — the
-per-fragment ``digests`` say which fragments moved.  A DiLoCo fragment
-maps naturally onto one payload fragment (the delta unit the training
-side already syncs).
-
-Fragments are stored (and staged, and relayed) as the **serialized wire
-stream itself** (``checkpointing/serialization.py`` format), and the
-publisher's digest is the sha256 of exactly those bytes.  That is the
-contract the streaming relay path (ISSUE 14) is built on: a relay can
-verify a fragment on receipt and re-serve it **verbatim** — zero decode
-passes, zero Python-object copies — and every node in the tree holds
-bitwise-identical bytes by construction, not by re-encoding
-deterministically.  A fragment travelling the tree may therefore appear
-as ``bytes`` (publisher-encoded), a bufpool-backed ``uint8`` ndarray
-(relay passthrough), or a decoded ``{slot: leaf}`` dict (tests/legacy);
-:func:`fragment_wire` normalizes the raw forms.
-
-Leaves are optionally int8-quantized through the same per-row absmax
-codec the quantized collectives use (``ops/quantization.py``, reusing
-its GIL-free native kernels): a float32 leaf becomes
-``{"q8": int8 payload, "scale": f32 row scales, "shape": [...]}``.
+The fragment codec was promoted to the shared fragment plane
+(``torchft_tpu/checkpointing/fragments.py``, ISSUE 15) so the heal path
+could ride the same digest-manifested fragment documents; this module
+keeps the serving tier's import surface stable.  See the fragments
+module for the format contract (serialized-wire fragments, sha256
+digests, zero-decode passthrough, optional int8 leaves).
 """
 
 from __future__ import annotations
 
-import hashlib
-import io
-import time
-from typing import Any, Dict, List, Optional, Tuple
-
-import numpy as np
-
-from torchft_tpu.checkpointing import serialization as ser
+from torchft_tpu.checkpointing.fragments import (  # noqa: F401
+    HEADER_FRAG,
+    MANIFEST_FRAG,
+    WIRE_F32,
+    WIRE_INT8,
+    _ViewReader,
+    assemble,
+    changed_fragments,
+    decode_fragment,
+    decode_manifest,
+    decode_payload,
+    encode_payload,
+    fragment_wire,
+    verify_fragment,
+)
 
 __all__ = [
     "WIRE_F32",
@@ -62,218 +39,3 @@ __all__ = [
     "fragment_wire",
     "verify_fragment",
 ]
-
-WIRE_F32 = "f32"
-WIRE_INT8 = "int8"
-
-#: the manifest travels as a fragment itself so the delta path is
-#: uniform: fetch ``frag_manifest``, diff digests, fetch what moved.
-MANIFEST_FRAG = "manifest"
-
-_Q8_KEY = "q8"
-
-
-def _encode_leaf(leaf: Any, wire: str) -> Any:
-    if wire != WIRE_INT8:
-        return leaf
-    if not isinstance(leaf, np.ndarray) and hasattr(leaf, "__array__"):
-        leaf = np.asarray(leaf)
-    if (
-        not isinstance(leaf, np.ndarray)
-        or leaf.dtype != np.float32
-        or leaf.size == 0
-    ):
-        return leaf
-    from torchft_tpu.ops import quantization as q
-
-    # The codec's own row view (``_as_rows``: leading dim = rows, rest
-    # flattened) — passing the leaf straight through keeps serving
-    # payload bytes in lockstep with the collective wire bytes by
-    # construction, not by a mirrored re-implementation.
-    scales, payload = q.quantize(np.ascontiguousarray(leaf), q.WIRE_INT8)
-    return {
-        _Q8_KEY: payload,
-        "scale": scales,
-        "shape": np.asarray(leaf.shape, dtype=np.int64),
-    }
-
-
-def _decode_leaf(leaf: Any) -> Any:
-    if isinstance(leaf, dict) and _Q8_KEY in leaf:
-        from torchft_tpu.ops import quantization as q
-
-        shape = tuple(int(d) for d in np.asarray(leaf["shape"]).tolist())
-        return q.dequantize(
-            np.asarray(leaf["scale"]),
-            np.asarray(leaf[_Q8_KEY]),
-            shape,
-            np.dtype(np.float32),
-        )
-    return leaf
-
-
-def fragment_wire(frag: Any) -> "Optional[memoryview]":
-    """Raw wire view of a fragment in passthrough form (``bytes`` from
-    the publisher's encode, a bufpool-backed ``uint8`` ndarray on a
-    relay); ``None`` for decoded/pytree fragments."""
-    return ser.raw_view(frag)
-
-
-class _ViewReader(io.RawIOBase):
-    """Zero-copy BinaryIO over a memoryview: ``deserialize_from`` reads
-    straight out of the received buffer into the final leaf arrays —
-    ``io.BytesIO(raw)`` would copy the whole fragment first."""
-
-    def __init__(self, view: memoryview) -> None:
-        self._view = view
-        self._off = 0
-
-    def readable(self) -> bool:
-        return True
-
-    def readinto(self, b: Any) -> int:
-        n = min(len(b), len(self._view) - self._off)
-        b[:n] = self._view[self._off:self._off + n]
-        self._off += n
-        return n
-
-
-def verify_fragment(name: str, frag: Any, manifest: "Dict[str, Any]") -> None:
-    """Check a raw fragment against the publisher-computed sha256 in the
-    manifest; raises ``ValueError`` on mismatch.  Decoded fragments (no
-    raw view) and fragments the manifest carries no digest for pass —
-    integrity is a property of the wire form."""
-    raw = fragment_wire(frag)
-    if raw is None:
-        return
-    want = (manifest.get("digests") or {}).get(name)
-    if want is None:
-        return
-    got = hashlib.sha256(raw).hexdigest()
-    if got != want:
-        raise ValueError(
-            f"serving fragment {name!r} v{manifest.get('version')}: digest "
-            f"mismatch ({got[:12]} != {want[:12]}) — corrupted or torn "
-            f"fragment must never be staged or served"
-        )
-
-
-def encode_payload(
-    state_dict: Any,
-    version: int,
-    wire: str = WIRE_F32,
-    fragments: int = 1,
-) -> "Dict[str, Any]":
-    """Build the staged document for one published weight version.
-
-    ``fragments``: leaf slots are split round-robin into this many
-    independently fetchable fragments (the delta unit); pass the DiLoCo
-    fragment count to align delta fetches with training's sync unit.
-    Fragment values are the serialized wire bytes; ``digests`` is the
-    sha256 of those bytes, so relays verify and re-serve them verbatim.
-    """
-    import jax
-
-    if wire not in (WIRE_F32, WIRE_INT8):
-        raise ValueError(f"serving wire must be f32|int8, got {wire!r}")
-    fragments = max(int(fragments), 1)
-    leaves, treedef = jax.tree_util.tree_flatten(state_dict)
-    skeleton = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
-    frag_names = [str(i) for i in range(min(fragments, max(len(leaves), 1)))]
-    doc: "Dict[str, Any]" = {}
-    digests: "Dict[str, str]" = {}
-    for fi, name in enumerate(frag_names):
-        frag: "Dict[str, Any]" = {}
-        for slot in range(fi, len(leaves), len(frag_names)):
-            frag[str(slot)] = _encode_leaf(leaves[slot], wire)
-        raw = ser.serialize(frag)
-        doc[f"frag:{name}"] = raw
-        digests[name] = hashlib.sha256(raw).hexdigest()
-    doc[f"frag:{MANIFEST_FRAG}"] = {
-        "version": int(version),
-        "wire": wire,
-        "fragments": frag_names,
-        "digests": digests,
-        "skeleton": skeleton,
-        "num_leaves": len(leaves),
-        "created_ns": time.time_ns(),
-    }
-    return doc
-
-
-def decode_fragment(frag: Any) -> "Dict[int, Any]":
-    """Decode one fragment (raw wire bytes or an already-deserialized
-    sub-dict) into ``{leaf slot: decoded leaf}``."""
-    raw = fragment_wire(frag)
-    if raw is not None:
-        skeleton, leaves, n = ser.deserialize_from(_ViewReader(raw))
-        frag = ser.reassemble(skeleton, leaves, n)
-    return {int(slot): _decode_leaf(leaf) for slot, leaf in frag.items()}
-
-
-def decode_manifest(raw: Any) -> "Dict[str, Any]":
-    """Decode a raw ``frag_manifest`` fetch into the manifest dict."""
-    view = fragment_wire(raw)
-    skeleton, leaves, n = ser.deserialize_from(
-        _ViewReader(view) if view is not None else io.BytesIO(raw)
-    )
-    manifest = ser.reassemble(skeleton, leaves, n)
-    if not isinstance(manifest, dict) or "fragments" not in manifest:
-        raise ValueError("serving fetch: frag_manifest is not a manifest")
-    return manifest
-
-
-def changed_fragments(
-    manifest: "Dict[str, Any]", prev_manifest: "Optional[Dict[str, Any]]"
-) -> "List[str]":
-    """Fragment names whose digest differs from ``prev_manifest`` (all of
-    them when there is no previous version or the shape changed)."""
-    names = list(manifest["fragments"])
-    if prev_manifest is None or prev_manifest.get("num_leaves") != manifest.get(
-        "num_leaves"
-    ):
-        return names
-    prev = prev_manifest.get("digests") or {}
-    return [n for n in names if manifest["digests"].get(n) != prev.get(n)]
-
-
-def assemble(
-    manifest: "Dict[str, Any]", leaves: "Dict[int, Any]"
-) -> Any:
-    """Rebuild the state dict from a complete ``{slot: decoded leaf}``
-    map and the manifest skeleton (the tail of :func:`decode_payload`,
-    split out so pipelined fetchers can merge leaves incrementally)."""
-    import jax
-
-    n = int(manifest["num_leaves"])
-    missing = [i for i in range(n) if i not in leaves]
-    if missing:
-        raise ValueError(
-            f"serving payload v{manifest.get('version')}: missing leaf "
-            f"slots {missing[:5]}{'...' if len(missing) > 5 else ''} "
-            f"(delta fetch without a complete previous version?)"
-        )
-    return jax.tree_util.tree_map(
-        lambda slot: leaves[slot], manifest["skeleton"]
-    )
-
-
-def decode_payload(
-    doc: "Dict[str, Any]",
-    prev: "Optional[Tuple[Dict[str, Any], Dict[int, Any]]]" = None,
-) -> "Tuple[Any, Dict[str, Any], Dict[int, Any]]":
-    """Decode a full fetched document (or a manifest + changed-fragment
-    subset merged over ``prev = (prev_manifest, prev_leaves)``).
-
-    Returns ``(state_dict, manifest, leaves)`` — keep ``(manifest,
-    leaves)`` around to decode the next delta fetch.
-    """
-    manifest = doc[f"frag:{MANIFEST_FRAG}"]
-    leaves: "Dict[int, Any]" = dict(prev[1]) if prev is not None else {}
-    for name in manifest["fragments"]:
-        frag = doc.get(f"frag:{name}")
-        if frag is not None:
-            verify_fragment(name, frag, manifest)
-            leaves.update(decode_fragment(frag))
-    state = assemble(manifest, leaves)
-    return state, manifest, leaves

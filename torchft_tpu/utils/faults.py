@@ -43,6 +43,9 @@ site                  fires in
                       max_step)
 ``transport.send``    ``send_checkpoint`` of both checkpoint transports
 ``transport.recv``    ``recv_checkpoint`` of both checkpoint transports
+``transport.heal.frag`` each striped-heal fragment fetch
+                      (checkpointing/fragments.py ``fetch_raw`` with the
+                      heal role; ``step`` = the fragment's stripe index)
 ``serving.publish``   ``WeightPublisher.publish`` before a weight
                       version is encoded/staged (``step`` = version)
 ``serving.fetch``     serving-tier fetch attempts — relay pull from the
@@ -137,6 +140,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "manager.layout_commit",
     "transport.send",
     "transport.recv",
+    "transport.heal.frag",
     "serving.publish",
     "serving.fetch",
     "serving.frag",

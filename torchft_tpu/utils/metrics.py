@@ -936,6 +936,34 @@ CHECKPOINT_RETRIES = counter(
     "Checkpoint fetch retries (sender not yet staged / transient errors)",
     ("transport",),
 )
+HEAL_INTO_FALLBACKS = counter(
+    "torchft_heal_into_fallbacks_total",
+    "Heal receives that could NOT reuse the retained leaf buffers "
+    "(state_dict_fn failed/mismatched — the decode allocates fresh "
+    "arrays; a nonzero rate means the zero-alloc heal path regressed)",
+)
+HEAL_FRAG_FAILOVERS = counter(
+    "torchft_heal_frag_failovers_total",
+    "Striped-heal fragments that failed over to another stripe source "
+    "(dead source, budget expiry, or digest mismatch)",
+)
+HEAL_STRIPE_SOURCES = gauge(
+    "torchft_heal_stripe_sources",
+    "Stripe sources the most recent striped heal fetched across "
+    "(1 = primary only)",
+)
+HEAL_WIRE_BYTES = counter(
+    "torchft_heal_wire_bytes_total",
+    "Striped-heal fragment bytes fetched, by mode (full vs delta — "
+    "delta bytes scale with the changed-fragment count)",
+    ("mode",),
+)
+HEAL_CHANGED_FRAGMENTS = gauge(
+    "torchft_heal_changed_fragments",
+    "Fragments the most recent delta heal actually fetched (digest "
+    "diff vs the rejoiner's own state); equals the fragment count on "
+    "a full heal",
+)
 DILOCO_SYNC_SECONDS = gauge(
     "torchft_diloco_last_sync_seconds",
     "Duration of the most recent DiLoCo fragment sync (perform_sync)",
