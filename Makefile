@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke
 
 native:
 	$(MAKE) -C native
@@ -103,6 +103,15 @@ heal-smoke:
 # compact-summary JSON line as the full bench.
 bench-heal:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --heal
+
+# Fleet link-state plane round trip alone: passive estimator accuracy
+# on a shaped topology (closed-loop vs the declared RTT/Gbps), the
+# record() hot-path budget, heartbeat digest -> lighthouse matrix ->
+# /links.json aggregation, the serving staleness ledger, and the
+# dropped-link-report chaos degradation (docs/observability.md
+# "Link-state plane").
+links-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_linkstats.py -q -m "not slow"
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

@@ -2004,8 +2004,9 @@ def _serving_depth_trial(
 ) -> "Tuple[List[float], List[float]]":
     """One (depth, mode) config: a fanout-1 CHAIN of ``depth`` relays;
     returns (full-change publish->leaf latencies, single-fragment delta
-    latencies) in seconds.  publish->leaf = publish() call to the LEAF
-    relay holding the version complete."""
+    latencies, publish-stamp staleness at leaf convergence) in seconds.
+    publish->leaf = publish() call to the LEAF relay holding the
+    version complete."""
     from torchft_tpu.serving import ServingReplica, WeightPublisher
 
     lh = LighthouseServer(
@@ -2026,6 +2027,7 @@ def _serving_depth_trial(
     leaf = reps[-1]
     full: "List[float]" = []
     delta: "List[float]" = []
+    stale: "List[float]" = []
     try:
         # wait for the full chain to form before measuring — and fail
         # LOUDLY if it never does: measuring a shallower tree would
@@ -2059,7 +2061,14 @@ def _serving_depth_trial(
                         f"(depth={depth} stream={stream})"
                     )
                 time.sleep(0.005)
-            return time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            # staleness-ledger cell: wall at leaf convergence minus the
+            # manifest publish stamp — the publish->leaf measurement the
+            # lighthouse's /serving.json staleness_ms rows report live
+            v_ms = pub.latest_version_ms()
+            if v_ms > 0:
+                stale.append(max(time.time() - v_ms / 1e3, 0.0))
+            return dt
 
         for t in range(SERVING_DEPTH_PUBLISHES + 1):
             # every leaf changes: the full payload moves each publish
@@ -2079,7 +2088,7 @@ def _serving_depth_trial(
                 pass
         pub.shutdown()
         lh.shutdown()
-    return full, delta
+    return full, delta, stale
 
 
 def bench_serving_depth() -> "Dict[str, Any]":
@@ -2131,9 +2140,9 @@ def bench_serving_depth() -> "Dict[str, Any]":
             _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
             leg: "Dict[str, Any]" = {}
             for depth in SERVING_DEPTHS:
-                flat_full, _ = _serving_depth_trial(base, depth, False)
-                stream_full, stream_delta = _serving_depth_trial(
-                    base, depth, True
+                flat_full, _, _ = _serving_depth_trial(base, depth, False)
+                stream_full, stream_delta, stream_stale = (
+                    _serving_depth_trial(base, depth, True)
                 )
                 f50, f99 = _pcts(flat_full)
                 s50, s99 = _pcts(stream_full)
@@ -2144,6 +2153,10 @@ def bench_serving_depth() -> "Dict[str, Any]":
                     "stream_delta_p50_ms": d50,
                     "stream_speedup_x": round(f50 / max(s50, 1e-9), 2),
                 }
+                if stream_stale:
+                    leg[f"d{depth}"]["stream_staleness_p50_ms"] = _pcts(
+                        stream_stale
+                    )[0]
                 log(
                     f"serving depth d={depth} rtt={rtt}ms: flat p50 "
                     f"{f50}ms stream p50 {s50}ms delta p50 {d50}ms"
@@ -2154,6 +2167,7 @@ def bench_serving_depth() -> "Dict[str, Any]":
         out["d3_rtt50_flat_p50_ms"] = d3.get("flat_p50_ms")
         out["d3_rtt50_stream_p50_ms"] = d3.get("stream_p50_ms")
         out["d3_rtt50_delta_p50_ms"] = d3.get("stream_delta_p50_ms")
+        out["d3_rtt50_staleness_p50_ms"] = d3.get("stream_staleness_p50_ms")
         out["winner"] = (
             "stream"
             if (d3.get("stream_speedup_x") or 0) > 1.0
@@ -2440,6 +2454,36 @@ def bench_ha() -> "Dict[str, Any]":
     }
 
 
+def links_summary() -> "Optional[Dict[str, Any]]":
+    """Distill this process's passive link-state registry (ISSUE 16)
+    into a handful of fleet-health cells: tracked pair count, matrix
+    version, the worst WAN link by goodput, and the worst observed RTT
+    tail.  The registry fills as a side effect of the shaped legs (WAN
+    sweep, striped heal, relay depth) — no probe traffic of its own.
+    Returns None when nothing was recorded (e.g. a CPU-only quick leg)."""
+    from torchft_tpu.utils import linkstats
+
+    matrix = linkstats.LINKS.snapshot()
+    if not matrix.entries:
+        return None
+    out: "Dict[str, Any]" = {
+        "pairs": len(matrix.entries),
+        "version": matrix.version,
+    }
+    wan = [
+        s for s in matrix.entries
+        if not s.local and s.goodput_bps > 0
+    ]
+    if wan:
+        worst = min(wan, key=lambda s: s.goodput_bps)
+        out["worst_wan_goodput_bps"] = round(worst.goodput_bps)
+        out["worst_wan_link"] = f"{worst.peer}/{worst.plane}"
+    tails = [s.rtt_p99_ms for s in matrix.entries if s.rtt_p99_ms > 0]
+    if tails:
+        out["rtt_p99_max_ms"] = round(max(tails), 3)
+    return out
+
+
 def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
     """Distill the full bench result into one < 1.5 KB JSON line: the
     primary recovery metric + cycle medians, overhead + cross-check
@@ -2576,6 +2620,12 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # striped-heal headline (ISSUE 15): 4-source wire-time speedup
         # over single-source on shaped links + the delta-rejoin row
         "heal": heal_compact,
+        # link-state headline (ISSUE 16): pairs the passive registry
+        # tracked + the worst WAN link it singled out
+        "links": result.get("links"),
+        # staleness-ledger headline (ISSUE 16): publish->leaf staleness
+        # at depth 3 / 50 ms RTT from the streaming-relay leg
+        "staleness": sdepth.get("d3_rtt50_staleness_p50_ms"),
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
         # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
@@ -2603,7 +2653,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
-        "ha", "serving", "serving_depth", "heal",
+        "links", "staleness", "ha", "serving", "serving_depth", "heal",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2657,6 +2707,7 @@ def main() -> None:
         result = {
             "metric": "serving_publish_to_leaf_latency",
             "serving_depth": sdepth,
+            "links": links_summary(),
         }
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
@@ -2667,7 +2718,11 @@ def main() -> None:
         # delta-rejoin row), with the compact tail (same last-line
         # contract as the full run)
         heal = bench_heal()
-        result = {"metric": "striped_heal_wire_time", "heal": heal}
+        result = {
+            "metric": "striped_heal_wire_time",
+            "heal": heal,
+            "links": links_summary(),
+        }
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
         return
@@ -2684,7 +2739,11 @@ def main() -> None:
         # `make bench-wan`: the RTT sweep alone, with the compact tail
         # (same last-line contract as the full run)
         wan = bench_wan(262.0)
-        result = {"metric": "wan_rtt_sweep", "wan": wan}
+        result = {
+            "metric": "wan_rtt_sweep",
+            "wan": wan,
+            "links": links_summary(),
+        }
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)
         return
@@ -2800,6 +2859,9 @@ def main() -> None:
         "serving_depth": serving_depth,
         "ha": ha,
         "heal": heal,
+        # passive link-state registry distilled (ISSUE 16): fills as a
+        # side effect of the shaped legs above, no probe traffic
+        "links": links_summary(),
     }
     print(json.dumps(result), flush=True)
     # LAST line, always < 1500 bytes: the driver's 2000-byte stdout tail

@@ -233,6 +233,28 @@ int tft_manager_report_summary(int64_t h, const char* summary_json) {
   return 0;
 }
 
+// Record a replica's bounded link-state digest (JSON: host, rows[...]);
+// the heartbeat loop piggybacks it once (consumed-on-send) so the
+// lighthouse can fold it into the fleet host-pair matrix (/links.json).
+// Invalid JSON is rejected here rather than poisoning the heartbeat path.
+int tft_manager_report_links(int64_t h, const char* links_json) {
+  tft::RpcServer* s = find_server(h);
+  auto* manager = dynamic_cast<tft::ManagerServer*>(s);
+  if (manager == nullptr) {
+    g_last_error = "bad manager handle";
+    return -1;
+  }
+  try {
+    tft::Json links = tft::Json::parse(links_json ? links_json : "{}");
+    if (!links.is_object()) throw std::runtime_error("links: not an object");
+    manager->report_links(links);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+  return 0;
+}
+
 // Pure quorum-result math, exposed for unit tests: input/output JSON.
 char* tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
                                  const char* quorum_json, int init_sync) {

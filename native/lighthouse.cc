@@ -752,6 +752,10 @@ Json LighthouseServer::handle(const std::string& method, const Json& params,
                        params.get("per_page").as_int(0),
                        params.get("replica").as_string());
   if (method == "timeline") return timeline_json();
+  // Fleet link-state matrix: same document as GET /links.json.
+  if (method == "links")
+    return links_json(params.get("page").as_int(-1),
+                      params.get("per_page").as_int(0));
   throw std::runtime_error("lighthouse: unknown method " + method);
 }
 
@@ -948,6 +952,10 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
   // timeline served at /timeline.json.
   const Json& summary = params.get("summary");
   if (summary.is_object()) note_summary_locked(rid, summary, now);
+  // Link-digest piggyback (optional): the replica's bounded link table
+  // folds into the fleet host-pair matrix served at /links.json.
+  const Json& links = params.get("links");
+  if (links.is_object()) note_links_locked(links, now);
   return out;
 }
 
@@ -986,6 +994,22 @@ int64_t LighthouseServer::serving_latest_version_locked() const {
   return v;
 }
 
+int64_t LighthouseServer::serving_latest_version_ms_locked() const {
+  // The staleness reference: publish stamp of the newest published
+  // version.  Same clock as every member's version_ms (the publisher
+  // mints both), so (latest_ms - member_ms) is skew-free.
+  int64_t v = -1, vms = 0;
+  for (const auto& [rid, m] : serving_) {
+    (void)rid;
+    if (m.role != "publisher") continue;
+    if (m.version > v || (m.version == v && m.version_ms > vms)) {
+      v = m.version;
+      vms = m.version_ms;
+    }
+  }
+  return vms;
+}
+
 Json LighthouseServer::rpc_serving_heartbeat(const Json& params) {
   std::lock_guard<std::mutex> g(mu_);
   int64_t now = now_ms();
@@ -1002,6 +1026,9 @@ Json LighthouseServer::rpc_serving_heartbeat(const Json& params) {
         "serving_heartbeat: role must be publisher|server, got " + m.role);
   m.version = params.get("version").as_int(0);
   m.capacity = params.get("capacity").as_int(0);
+  // Staleness ledger: publish wall-stamp of the held version, carried on
+  // the publisher's clock (0 = unknown).  Not a tree-shape field.
+  m.version_ms = params.get("version_ms").as_int(0);
   m.last_hb_ms = now;
   auto it = serving_.find(m.replica_id);
   // Epoch bumps only on TREE-SHAPE changes (join, address/role/capacity
@@ -1041,6 +1068,7 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
       p["replica_id"] = m.replica_id;
       p["address"] = m.address;
       p["version"] = m.version;
+      p["version_ms"] = m.version_ms;
       publishers.push_back(p);
       if (m.version > root_version) {
         root_version = m.version;
@@ -1073,6 +1101,7 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
   }
   Json nodes = Json::array();
   int64_t max_depth = 0;
+  const int64_t latest_ms = serving_latest_version_ms_locked();
   for (size_t i = 0; i < servers.size(); ++i) {
     Json n = Json::object();
     n["replica_id"] = servers[i]->replica_id;
@@ -1081,6 +1110,15 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
     n["depth"] = depth[i];
     n["children"] = children[i];
     n["version"] = servers[i]->version;
+    // Staleness ledger: how far behind the newest PUBLISH this node's
+    // held version is, in publish-clock ms (-1 = unknown — the node has
+    // not yet reported a stamped version).  Both stamps are minted by
+    // publishers, so the difference is skew-free across hosts.
+    n["version_ms"] = servers[i]->version_ms;
+    n["staleness_ms"] =
+        (latest_ms > 0 && servers[i]->version_ms > 0)
+            ? std::max<int64_t>(latest_ms - servers[i]->version_ms, 0)
+            : -1;
     nodes.push_back(n);
     max_depth = std::max(max_depth, depth[i]);
   }
@@ -1089,6 +1127,7 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
   out["generated_ms"] = wall_ms();
   out["fanout"] = opt_.serving_fanout;
   out["latest_version"] = serving_latest_version_locked();
+  out["latest_version_ms"] = latest_ms;
   out["root_source"] = root_source;
   out["publishers"] = publishers;
   out["nodes"] = nodes;
@@ -1175,6 +1214,51 @@ Json LighthouseServer::timeline_json() {
   }
   out["stragglers_worst"] = worst;
   return out;
+}
+
+// ---------------------------------------------------------------------------
+// Fleet link-state plane: replicas piggyback their bounded link digests
+// (utils/linkstats.py maybe_digest) on heartbeats; the lighthouse folds
+// them into a host-pair matrix.  Per-host latest-wins replacement keeps
+// the table bounded by hosts x digest size; a host that stops reporting
+// leaves its rows aging in place (stale age_ms, never missing data) —
+// the chaos-degradation contract of the lighthouse.links fault site.
+// ---------------------------------------------------------------------------
+
+void LighthouseServer::note_links_locked(const Json& links, int64_t now) {
+  const std::string host = links.get("host").as_string();
+  if (host.empty()) return;
+  const Json& rows = links.get("rows");
+  if (!rows.is_array()) return;
+  for (auto it = links_.begin(); it != links_.end();) {
+    if (std::get<0>(it->first) == host)
+      it = links_.erase(it);
+    else
+      ++it;
+  }
+  // Defensive row cap: the digest is worst-K bounded at the replica, but
+  // a hostile/miswired reporter must not grow the matrix unboundedly.
+  size_t n = 0;
+  for (const Json& r : rows.as_array()) {
+    if (!r.is_object() || ++n > 64) continue;
+    LinkRow row;
+    row.src_host = host;
+    row.peer = r.get("peer").as_string();
+    row.plane = r.get("plane").as_string();
+    if (row.peer.empty() || row.plane.empty()) continue;
+    row.local = r.get("local").as_bool(false);
+    row.goodput_bps = r.get("goodput_bps").as_double(0.0);
+    row.rtt_ms = r.get("rtt_ms").as_double(0.0);
+    row.rtt_p99_ms = r.get("rtt_p99_ms").as_double(0.0);
+    row.samples = r.get("samples").as_int(0);
+    row.bytes = r.get("bytes").as_int(0);
+    row.updated_ms = now;
+    links_[{host, row.peer, row.plane}] = row;
+  }
+  links_reports_total_ += 1;
+  // Monotone matrix version, ordered across leader failovers by the HA
+  // id idiom — equal versions name an identical matrix.
+  links_version_ = ha_epoch_id(term_, ++links_seq_in_term_);
 }
 
 void LighthouseServer::note_progress_locked(const std::string& rid,
@@ -1377,6 +1461,14 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
                rpc_serving_plan(Json::object()).dump());
     return;
   }
+  if (method == "GET" && path == "/links.json") {
+    // Same document as the links RPC: the fleet link-state matrix.
+    http_reply(fd, 200, "application/json",
+               links_json(query_int(query, "page", -1),
+                          query_int(query, "per_page", 0))
+                   .dump());
+    return;
+  }
   if (method == "GET" && path == "/metrics") {
     http_reply(fd, 200, "text/plain; version=0.0.4", render_metrics());
     return;
@@ -1565,6 +1657,89 @@ std::string LighthouseServer::render_metrics() {
        << "# TYPE torchft_lighthouse_serving_heartbeats_total counter\n"
        << "torchft_lighthouse_serving_heartbeats_total "
        << serving_heartbeats_total_ << "\n";
+    // Serving staleness ledger: worst publish->node lag across the
+    // fleet, skew-free (both stamps publisher-clock).  One series.
+    {
+      int64_t latest_ms = serving_latest_version_ms_locked();
+      int64_t worst_stale = 0;
+      for (const auto& [rid, m] : serving_) {
+        (void)rid;
+        if (latest_ms > 0 && m.version_ms > 0)
+          worst_stale =
+              std::max(worst_stale, latest_ms - m.version_ms);
+      }
+      os << "# HELP torchft_lighthouse_serving_staleness_ms_max Worst "
+            "publish-to-node version staleness across serving members "
+            "(publisher-clock ms; per-node rows live in /serving.json)\n"
+         << "# TYPE torchft_lighthouse_serving_staleness_ms_max gauge\n"
+         << "torchft_lighthouse_serving_staleness_ms_max " << worst_stale
+         << "\n";
+    }
+    // Link-state plane: bounded aggregates plus the worst-K WAN rows by
+    // goodput — the straggler-tier cardinality rule.  Named
+    // torchft_lighthouse_link_* (not torchft_link_*) so an embedding
+    // Python process exporting its own replica-local torchft_link_*
+    // gauges through the provider below never collides family names in
+    // the combined scrape.
+    {
+      std::set<std::string> link_hosts;
+      std::vector<const LinkRow*> wan;
+      for (const auto& [key, row] : links_) {
+        link_hosts.insert(std::get<0>(key));
+        if (!row.local && row.goodput_bps > 0.0) wan.push_back(&row);
+      }
+      std::sort(wan.begin(), wan.end(),
+                [](const LinkRow* a, const LinkRow* b) {
+                  return a->goodput_bps < b->goodput_bps;
+                });
+      os << "# HELP torchft_lighthouse_link_rows Link-matrix rows "
+            "tracked (full matrix in /links.json)\n"
+         << "# TYPE torchft_lighthouse_link_rows gauge\n"
+         << "torchft_lighthouse_link_rows "
+         << static_cast<int64_t>(links_.size()) << "\n"
+         << "# HELP torchft_lighthouse_link_hosts Hosts reporting link "
+            "digests\n"
+         << "# TYPE torchft_lighthouse_link_hosts gauge\n"
+         << "torchft_lighthouse_link_hosts "
+         << static_cast<int64_t>(link_hosts.size()) << "\n"
+         << "# HELP torchft_lighthouse_link_reports_total Link digests "
+            "folded into the matrix\n"
+         << "# TYPE torchft_lighthouse_link_reports_total counter\n"
+         << "torchft_lighthouse_link_reports_total " << links_reports_total_
+         << "\n"
+         << "# HELP torchft_lighthouse_link_goodput_min_bytes_per_s "
+            "Lowest estimated WAN goodput across the fleet "
+            "(unbounded-cardinality truth, one series)\n"
+         << "# TYPE torchft_lighthouse_link_goodput_min_bytes_per_s gauge\n"
+         << "torchft_lighthouse_link_goodput_min_bytes_per_s "
+         << (wan.empty() ? 0.0 : wan.front()->goodput_bps) << "\n";
+      if (!wan.empty()) {
+        size_t k = std::min<size_t>(
+            wan.size(), static_cast<size_t>(opt_.straggler_topk));
+        os << "# HELP torchft_lighthouse_link_goodput_bytes_per_s "
+              "Estimated goodput of the worst-K WAN links (bounded "
+              "tier)\n"
+           << "# TYPE torchft_lighthouse_link_goodput_bytes_per_s gauge\n";
+        char buf[64];
+        for (size_t i = 0; i < k; ++i) {
+          snprintf(buf, sizeof(buf), "%.6g", wan[i]->goodput_bps);
+          os << "torchft_lighthouse_link_goodput_bytes_per_s{src=\""
+             << escape_label(wan[i]->src_host) << "\",peer=\""
+             << escape_label(wan[i]->peer) << "\",plane=\""
+             << escape_label(wan[i]->plane) << "\"} " << buf << "\n";
+        }
+        os << "# HELP torchft_lighthouse_link_rtt_p99_ms First-byte p99 "
+              "of the worst-K WAN links (bounded tier)\n"
+           << "# TYPE torchft_lighthouse_link_rtt_p99_ms gauge\n";
+        for (size_t i = 0; i < k; ++i) {
+          snprintf(buf, sizeof(buf), "%.6g", wan[i]->rtt_p99_ms);
+          os << "torchft_lighthouse_link_rtt_p99_ms{src=\""
+             << escape_label(wan[i]->src_host) << "\",peer=\""
+             << escape_label(wan[i]->peer) << "\",plane=\""
+             << escape_label(wan[i]->plane) << "\"} " << buf << "\n";
+        }
+      }
+    }
   }
   {
     std::lock_guard<std::mutex> g(provider_mu_);
@@ -1799,6 +1974,72 @@ Json LighthouseServer::status_json(int64_t page, int64_t per_page,
   return out;
 }
 
+Json LighthouseServer::links_json(int64_t page, int64_t per_page) {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  if (per_page <= 0) per_page = opt_.status_page_size;
+  if (per_page > 100000) per_page = 100000;
+  if (page < 0) page = 0;
+  Json out = Json::object();
+  out["version"] = links_version_;
+  out["now_ms"] = wall_ms();
+  out["reports_total"] = links_reports_total_;
+  std::set<std::string> hosts;
+  for (const auto& [key, row] : links_) {
+    (void)row;
+    hosts.insert(std::get<0>(key));
+  }
+  out["hosts"] = static_cast<int64_t>(hosts.size());
+  size_t total = links_.size();
+  out["rows_total"] = static_cast<int64_t>(total);
+  out["page"] = page;
+  out["per_page"] = per_page;
+  out["pages"] = static_cast<int64_t>(
+      (total + static_cast<size_t>(per_page) - 1) /
+      static_cast<size_t>(per_page));
+  // Fleet truth on every page: the worst WAN link (lowest goodput with
+  // any estimate) — the slow_link culprit signal's one-row summary.
+  const LinkRow* worst = nullptr;
+  for (const auto& [key, row] : links_) {
+    (void)key;
+    if (row.local || row.goodput_bps <= 0.0) continue;
+    if (worst == nullptr || row.goodput_bps < worst->goodput_bps)
+      worst = &row;
+  }
+  if (worst != nullptr) {
+    Json w = Json::object();
+    w["src"] = worst->src_host;
+    w["peer"] = worst->peer;
+    w["plane"] = worst->plane;
+    w["goodput_bps"] = worst->goodput_bps;
+    w["rtt_p99_ms"] = worst->rtt_p99_ms;
+    out["worst"] = w;
+  }
+  Json rows = Json::array();
+  auto [lo, hi] = page_bounds(total, page, per_page);
+  size_t i = 0;
+  for (const auto& [key, row] : links_) {
+    (void)key;
+    if (i >= lo && i < hi) {
+      Json r = Json::object();
+      r["src"] = row.src_host;
+      r["peer"] = row.peer;
+      r["plane"] = row.plane;
+      r["local"] = row.local;
+      r["goodput_bps"] = row.goodput_bps;
+      r["rtt_ms"] = row.rtt_ms;
+      r["rtt_p99_ms"] = row.rtt_p99_ms;
+      r["samples"] = row.samples;
+      r["bytes"] = row.bytes;
+      r["age_ms"] = now - row.updated_ms;
+      rows.push_back(r);
+    }
+    ++i;
+  }
+  out["rows"] = rows;
+  return out;
+}
+
 std::string LighthouseServer::render_status_html(int64_t page) {
   // Parity with the reference's askama status page
   // (reference templates/status.html:1-52, src/lighthouse.rs:415-452):
@@ -1915,16 +2156,58 @@ std::string LighthouseServer::render_status_html(int64_t page) {
       os << "</table>";
     }
   }
+  if (!links_.empty()) {
+    // Worst-K WAN links by estimated goodput — the same bounded tier
+    // /metrics exports; the full matrix is one click away.
+    std::vector<const LinkRow*> wan;
+    for (const auto& [key, row] : links_) {
+      (void)key;
+      if (!row.local && row.goodput_bps > 0.0) wan.push_back(&row);
+    }
+    std::sort(wan.begin(), wan.end(),
+              [](const LinkRow* a, const LinkRow* b) {
+                return a->goodput_bps < b->goodput_bps;
+              });
+    size_t k = std::min<size_t>(
+        wan.size(), static_cast<size_t>(opt_.straggler_topk));
+    os << "<h2>link state (worst " << k << " of " << wan.size()
+       << " WAN links, " << links_.size()
+       << " rows &middot; <a href=\"/links.json\">matrix</a>)</h2>";
+    if (k > 0) {
+      os << "<table><tr><th>src</th><th>peer</th><th>plane</th>"
+         << "<th>goodput (MB/s)</th><th>rtt p50 (ms)</th>"
+         << "<th>rtt p99 (ms)</th><th>samples</th><th>age (ms)</th></tr>";
+      for (size_t i = 0; i < k; ++i) {
+        char gp[64], p50[64], p99[64];
+        snprintf(gp, sizeof(gp), "%.2f", wan[i]->goodput_bps / 1e6);
+        snprintf(p50, sizeof(p50), "%.2f", wan[i]->rtt_ms);
+        snprintf(p99, sizeof(p99), "%.2f", wan[i]->rtt_p99_ms);
+        int64_t age = now - wan[i]->updated_ms;
+        bool stale = age > 5 * opt_.heartbeat_timeout_ms;
+        os << "<tr class=\"" << (stale ? "recovering" : "healthy")
+           << "\"><td>" << wan[i]->src_host << "</td><td>" << wan[i]->peer
+           << "</td><td>" << wan[i]->plane << "</td><td>" << gp
+           << "</td><td>" << p50 << "</td><td>" << p99 << "</td><td>"
+           << wan[i]->samples << "</td><td>" << age << "</td></tr>";
+      }
+      os << "</table>";
+    }
+  }
   if (!serving_.empty()) {
     int64_t pubs = 0, srvs = 0;
+    int64_t latest_ms = serving_latest_version_ms_locked();
+    int64_t worst_stale = 0;
     for (const auto& [rid, m] : serving_) {
       (void)rid;
       (m.role == "publisher" ? pubs : srvs) += 1;
+      if (latest_ms > 0 && m.version_ms > 0)
+        worst_stale = std::max(worst_stale, latest_ms - m.version_ms);
     }
     os << "<h2>weight-serving tier</h2><p>epoch " << serving_epoch_
        << " &middot; " << pubs << " publisher(s) &middot; " << srvs
        << " server(s) &middot; latest version "
        << serving_latest_version_locked()
+       << " &middot; worst staleness " << worst_stale << "ms"
        << " &middot; <a href=\"/serving.json\">plan</a></p>";
   }
   {

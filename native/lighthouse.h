@@ -25,6 +25,7 @@
 #include <optional>
 #include <string>
 #include <thread>
+#include <tuple>
 #include <vector>
 
 #include "net.h"
@@ -161,6 +162,13 @@ class LighthouseServer : public RpcServer {
   Json rpc_lease(const Json& params);
   void note_summary_locked(const std::string& rid, const Json& summary,
                            int64_t now);
+  // Fold one replica's link digest ({"host", "rows"}) into the fleet
+  // host-pair matrix (caller holds mu_).
+  void note_links_locked(const Json& links, int64_t now);
+  // The fleet link matrix (the "links" RPC and GET /links.json); locks
+  // mu_ internally.  Paginated like status_json; fleet truth (version,
+  // totals, worst WAN pair) is on every page.
+  Json links_json(int64_t page, int64_t per_page);
   std::string render_status_html(int64_t page);
   std::string render_metrics();
 
@@ -222,6 +230,31 @@ class LighthouseServer : public RpcServer {
     int64_t version = 0;
     int64_t capacity = 0;  // max children (0 = opt_.serving_fanout)
     int64_t last_hb_ms = 0;
+    // Serving staleness ledger: the PUBLISH wall-clock stamp (ms) of
+    // `version`, minted on the publisher's clock and carried unmodified
+    // through the distribution tree — staleness_ms compares two stamps
+    // from the SAME clock (latest publisher stamp minus the member's),
+    // so cross-host clock skew cancels out.  0 = unknown (pre-ledger
+    // member or version 0).
+    int64_t version_ms = 0;
+  };
+
+  // One aggregated fleet link-state row, keyed (reporting host, peer
+  // host, plane) — the heartbeat-piggybacked digests land here with
+  // per-host latest-wins replacement, so the table is bounded by
+  // hosts x digest size (the digest itself is worst-K bounded at the
+  // replica, utils/linkstats.py).
+  struct LinkRow {
+    std::string src_host;
+    std::string peer;    // may be a host#gN pseudo-host (WAN-keyed)
+    std::string plane;   // "reduction" | "fragments" | "rpc"
+    bool local = false;
+    double goodput_bps = 0.0;
+    double rtt_ms = 0.0;      // first-byte p50
+    double rtt_p99_ms = 0.0;  // first-byte p99
+    int64_t samples = 0;
+    int64_t bytes = 0;
+    int64_t updated_ms = 0;  // lighthouse clock at last report
   };
 
  private:
@@ -235,6 +268,9 @@ class LighthouseServer : public RpcServer {
   // go stale: any read under mu_ sees a consistent (epoch, tree) pair.
   void serving_gc_locked(int64_t now);
   int64_t serving_latest_version_locked() const;
+  // Publish stamp (publisher-clock ms) of the newest published version —
+  // the staleness ledger's reference point (0 = unknown).
+  int64_t serving_latest_version_ms_locked() const;
 
   // Record progress for rid (caller holds mu_).
   void note_progress_locked(const std::string& rid, int64_t step,
@@ -338,6 +374,18 @@ class LighthouseServer : public RpcServer {
   std::map<std::string, ServingMember> serving_;
   int64_t serving_epoch_ = 0;
   int64_t serving_heartbeats_total_ = 0;
+  // Fleet link-state matrix keyed (src_host, peer, plane).  Rows age in
+  // place when a host stops reporting (a faulted links plane degrades to
+  // stale age_ms, never missing data) — memory stays bounded because a
+  // host's next digest replaces ALL of its rows.
+  std::map<std::tuple<std::string, std::string, std::string>, LinkRow>
+      links_;
+  // Monotone matrix version: the HA id idiom (term << 32 | seq), so a
+  // reader comparing versions across a leader failover still orders
+  // snapshots correctly with zero state transfer.
+  int64_t links_version_ = 0;
+  int64_t links_seq_in_term_ = 0;
+  int64_t links_reports_total_ = 0;
   // Rolling cluster step-timeline, keyed by step, capped to
   // opt_.timeline_ring buckets (oldest step evicted).
   std::map<int64_t, StepBucket> timeline_;

@@ -179,6 +179,11 @@ void ManagerServer::report_summary(const Json& summary) {
   pending_summary_ = summary;
 }
 
+void ManagerServer::report_links(const Json& links) {
+  std::lock_guard<std::mutex> g(mu_);
+  pending_links_ = links;
+}
+
 void ManagerServer::heartbeat_loop() {
   // Multi-endpoint failover client: with TORCHFT_LIGHTHOUSE as a comma
   // list this walks dead peers and follows NOT_LEADER redirects to the
@@ -188,6 +193,7 @@ void ManagerServer::heartbeat_loop() {
     Json params = Json::object();
     params["replica_id"] = opt_.replica_id;
     std::optional<Json> summary;
+    std::optional<Json> links;
     // Piggyback training progress (straggler telemetry): once the Python
     // Manager has reported a step, every heartbeat carries it so the
     // lighthouse can compute per-replica step lag without extra RPCs.
@@ -205,6 +211,12 @@ void ManagerServer::heartbeat_loop() {
         summary = std::move(pending_summary_);
         pending_summary_.reset();
         params["summary"] = *summary;
+      }
+      // Link digest rides the same way: once, restored on failure.
+      if (pending_links_.has_value()) {
+        links = std::move(pending_links_);
+        pending_links_.reset();
+        params["links"] = *links;
       }
     }
     try {
@@ -224,11 +236,13 @@ void ManagerServer::heartbeat_loop() {
     } catch (const std::exception&) {
       // Lighthouse unreachable: keep trying; quorum path surfaces errors.
       client.close();
-      if (summary.has_value()) {
-        // Undelivered digest: put it back unless a newer one arrived.
+      if (summary.has_value() || links.has_value()) {
+        // Undelivered digests: put them back unless newer ones arrived.
         std::lock_guard<std::mutex> g(mu_);
-        if (!pending_summary_.has_value())
+        if (summary.has_value() && !pending_summary_.has_value())
           pending_summary_ = std::move(summary);
+        if (links.has_value() && !pending_links_.has_value())
+          pending_links_ = std::move(links);
       }
     }
     // interruptible sleep: stop() must not wait out a full heartbeat

@@ -95,6 +95,14 @@ class ManagerServer : public RpcServer {
   // lighthouse's per-step aggregates).
   void report_summary(const Json& summary);
 
+  // Link-state plane: record this replica's bounded link digest (JSON
+  // object: host, rows[...] — utils/linkstats.py maybe_digest).  Same
+  // consumed-on-send contract as report_summary: the next heartbeat
+  // carries it ONCE, restored on RPC failure unless a newer digest
+  // arrived (the fleet matrix keeps per-host latest, so duplicates are
+  // harmless but wasteful).
+  void report_links(const Json& links);
+
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
@@ -130,6 +138,8 @@ class ManagerServer : public RpcServer {
   std::string progress_op_;
   // pending per-step digest; consumed by the next heartbeat (mu_)
   std::optional<Json> pending_summary_;
+  // pending link-state digest; same consumed-on-send contract (mu_)
+  std::optional<Json> pending_links_;
 
   std::thread heartbeat_thread_;
   // Lighthouse quorum calls run on detached threads (bounded by the request

@@ -89,7 +89,7 @@ class TestDriftGateClean:
         assert set(servers) == {"lighthouse", "manager", "store"}
         assert set(servers["lighthouse"]) == {
             "quorum", "heartbeat", "status", "timeline",
-            "serving_heartbeat", "serving_plan", "lease",
+            "serving_heartbeat", "serving_plan", "lease", "links",
         }
         assert set(servers["manager"]) == {
             "quorum", "should_commit", "checkpoint_metadata", "kill",
@@ -225,6 +225,30 @@ class TestSeededDrift:
         drifted = dict(native)
         drifted["lighthouse.cc"] = lh.replace(
             'out["holder"] = promised_to_;', 'out["holdr"] = promised_to_;'
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert "result-missing" in codes or "lock-drift" in codes
+
+    def test_python_links_param_rename_is_caught(self):
+        """Link-state surface (ISSUE 16): renaming the heartbeat's links
+        piggyback key on the Python side means the native aggregator
+        never sees a digest again — the gate must bite."""
+        py, *_ = _tree_inputs()
+        drifted = py.replace('params["links"] = links', 'params["lnks"] = links')
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_links_result_rename_is_caught(self):
+        """Renaming a links-reply field natively drifts the locked
+        matrix document out from under /links.json consumers."""
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'out["reports_total"] = links_reports_total_;',
+            'out["reportstotal"] = links_reports_total_;',
         )
         assert drifted["lighthouse.cc"] != lh
         codes = self._codes(native=drifted)
@@ -386,6 +410,17 @@ class TestLiveConformance:
             sp = c.serving_plan()
             self._check_result("lighthouse", "serving_plan", sp)
             assert [n["replica_id"] for n in sp["nodes"]] == ["live_srv"]
+            c.heartbeat(
+                "live_0:a",
+                links={"host": "h0", "rows": [{
+                    "peer": "h1", "plane": "reduction", "local": False,
+                    "goodput_bps": 1e8, "rtt_ms": 1.0, "rtt_p99_ms": 2.0,
+                    "samples": 9, "bytes": 1024, "age_s": 0.1,
+                }]},
+            )
+            lk = c.links()
+            self._check_result("lighthouse", "links", lk)
+            assert lk["rows_total"] == 1
         finally:
             c.close()
             lh.shutdown()

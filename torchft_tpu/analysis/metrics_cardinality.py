@@ -48,6 +48,13 @@ PER_ENTITY_KEYS = frozenset(
 # is stripped precisely so restarts reuse the series).
 _BOUNDED_NAME_SUFFIXES = ("_metric_replica_id",)
 
+# Method-name suffixes whose RETURN VALUE is the bounded tier: the
+# link-registry's ``peer_topk_label`` folds every peer beyond the
+# worst-K into a literal "other", so the label set is K+1 values by
+# construction (utils/linkstats.py) — the Python mirror of the native
+# lighthouse's straggler_topk tier.
+_TOPK_LABEL_SUFFIXES = ("topk_label",)
+
 
 def _is_bounded_value(node: ast.AST) -> bool:
     if isinstance(node, ast.Constant):
@@ -58,6 +65,13 @@ def _is_bounded_value(node: ast.AST) -> bool:
         for suffix in _BOUNDED_NAME_SUFFIXES
     ):
         return True
+    # the top-K folding tier: <registry>.peer_topk_label(<anything>) is
+    # bounded regardless of its argument — folding is the whole point
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if any(
+            node.func.attr.endswith(suffix) for suffix in _TOPK_LABEL_SUFFIXES
+        ):
+            return True
     # str(<bounded>) / int(<bounded>) wrappers
     if (
         isinstance(node, ast.Call)
@@ -155,6 +169,13 @@ M = counter("torchft_y_total", "d")
 def observe(self):
     M.labels(rank=str(self._group_rank_of_the_day())).inc()
 """,
+    # a lookalike method name is NOT the folding tier
+    "fake-topk-method": """
+from torchft_tpu.utils.metrics import gauge
+G = gauge("torchft_peer_x", "d")
+def export(reg, host):
+    G.labels(peer=reg.peer_label(host)).set(1.0)
+""",
 }
 
 _GOOD = {
@@ -179,6 +200,13 @@ from torchft_tpu.utils.metrics import histogram
 H = histogram("torchft_dur", "d")
 def observe(phase):
     H.labels(phase=phase).observe(1.0)
+""",
+    # the top-K folding tier bounds its own output (K+1 label values)
+    "topk-label-tier": """
+from torchft_tpu.utils.metrics import counter
+M = counter("torchft_peer_wait_total", "d")
+def observe(reg, host):
+    M.labels(peer=reg.peer_topk_label(host)).inc()
 """,
     # an argued waiver is honored
     "waived": """

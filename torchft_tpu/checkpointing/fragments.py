@@ -78,6 +78,7 @@ import numpy as np
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import linkstats as _linkstats
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.bufpool import POOL
@@ -596,18 +597,43 @@ def _count_fetch_bytes(role: str, nbytes: int) -> None:
 _wire_mod: "Optional[Any]" = None
 
 
-def _charge_wire(base: str, nbytes: int) -> None:
+def _charge_wire(base: str, nbytes: int) -> float:
     # WAN wire model (serving/wire.py): one RTT + bytes/rate of source-
     # uplink bucket debt per fetch message crossing the topology
     # boundary.  Lazily bound: checkpointing must stay importable
     # without dragging the serving package in at module-import time
-    # (serving's own modules alias THIS module).
+    # (serving's own modules alias THIS module).  Returns the seconds
+    # charged so the link-state plane can fold the modeled WAN cost into
+    # its passive goodput estimate.
     global _wire_mod
     if _wire_mod is None:
         from torchft_tpu.serving import wire as _w
 
         _wire_mod = _w
-    _wire_mod.get_shaper().charge(base, nbytes)
+    return _wire_mod.get_shaper().charge(base, nbytes)
+
+
+#: per-thread first-byte latency of the most recent _request_once (the
+#: fetch planes are thread-confined, like the keep-alive connections)
+_fb_local = threading.local()
+
+
+def _record_link(base: str, nbytes: int, seconds: float) -> None:
+    """Feed the fragment plane's passive link estimator
+    (utils/linkstats.py): bytes + whole-message wall (shaper charge
+    included — the modeled WAN cost IS the link cost) + first-byte
+    latency (connection RTT + the shaper's modeled first-byte leg)."""
+    shaper = _wire_mod.get_shaper()
+    host = _wire_mod.source_host(base) or "unknown"
+    fb = getattr(_fb_local, "seconds", 0.0) + shaper.first_byte_s(base)
+    _linkstats.record(
+        host,
+        "fragments",
+        nbytes,
+        seconds,
+        first_byte_s=fb,
+        local=not shaper.crosses_boundary(base),
+    )
 
 
 _conns = threading.local()
@@ -665,8 +691,12 @@ def _request_once(
     if traceparent:
         headers["traceparent"] = traceparent
     try:
+        t0 = time.perf_counter()
         conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
+        # observed first-byte latency of this request (headers arrived);
+        # the link-state plane adds the shaper's modeled RTT on top
+        _fb_local.seconds = time.perf_counter() - t0
         if resp.status != 200:
             body = resp.read()  # drain so the connection could be reused
             if resp.will_close:
@@ -750,8 +780,11 @@ def fetch_raw(
         t = max(budget if budget is not None else 0.001, 0.001)
         return _get_raw_once(base, path, t)
 
+    t0p = time.perf_counter()
     buf = policy.run(attempt, timeout=max(timeout, 0.001), op=site)
-    _charge_wire(base, buf.nbytes)
+    wall_s = time.perf_counter() - t0p
+    wall_s += _charge_wire(base, buf.nbytes)
+    _record_link(base, buf.nbytes, wall_s)
     _count_fetch_bytes(role, buf.nbytes)
     _flightrec.record(
         record, start_ns=t0_ns, step=version, resource=resource,
@@ -814,10 +847,13 @@ def fetch_serialized(
             _drop_conn(base)
         return out + (nbytes,)
 
+    t0p = time.perf_counter()
     skeleton, leaves, n, nbytes = policy.run(
         attempt, timeout=max(timeout, 0.001), op=site
     )
-    _charge_wire(base, nbytes)
+    wall_s = time.perf_counter() - t0p
+    wall_s += _charge_wire(base, nbytes)
+    _record_link(base, nbytes, wall_s)
     _count_fetch_bytes(role, nbytes)
     _flightrec.record(
         record, start_ns=t0_ns, step=version, resource=resource,

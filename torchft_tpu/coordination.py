@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from torchft_tpu import _native
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import linkstats as _linkstats
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils import tracing as _tracing
 from torchft_tpu.utils.env import env_bool
@@ -256,6 +257,14 @@ class _RpcClient:
         self._fault_site = fault_site
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # link-state plane (utils/linkstats.py): every round trip on this
+        # connection is one rpc-plane RTT sample against the peer host —
+        # resolved once here, not per call
+        from torchft_tpu.utils.hostident import local_host_identities
+
+        host, _port = parse_host_port(addr)
+        self._link_host = host or "unknown"
+        self._link_local = self._link_host in local_host_identities()
 
     def _host_port(self) -> "tuple[str, int]":
         return parse_host_port(self._addr)
@@ -322,10 +331,11 @@ class _RpcClient:
         # Default off (one env test per call; the bench flips it
         # mid-process, so it cannot be latched at import); the serving
         # import resolves lazily only when enabled.
+        charged = 0.0
         if env_bool("TORCHFT_WIRE_RPC", False):
             from torchft_tpu.serving import wire as _serving_wire
 
-            _serving_wire.get_shaper().charge(self._addr, 0)
+            charged = _serving_wire.get_shaper().charge(self._addr, 0)
         with self._lock:  # tft-lint: allow(lock-discipline)
             for attempt in range(attempts):
                 if self._sock is None:
@@ -344,8 +354,26 @@ class _RpcClient:
                     if self._fault_site is not None:
                         _faults.check(self._fault_site)
                     self._sock.settimeout(max(deadline - time.monotonic(), 0.001))
+                    t0 = time.perf_counter()
                     self._sock.sendall(struct.pack(">I", len(payload)) + payload)
                     reply = self._recv_frame(deadline)
+                    # rpc-plane link sample: one RTT per round trip (the
+                    # whole wall IS first-byte — sub-KB payloads carry no
+                    # bandwidth signal, so goodput stays unestimated on
+                    # this plane).  A shaped (TORCHFT_WIRE_RPC) call to a
+                    # local host keys under a WAN pseudo-host so the
+                    # modeled link never averages into the local fabric.
+                    rtt = charged + (time.perf_counter() - t0)
+                    wan_local = charged > 0.0 and self._link_local
+                    _linkstats.record(
+                        self._link_host + "#wan" if wan_local
+                        else self._link_host,
+                        "rpc",
+                        len(payload) + len(reply),
+                        rtt,
+                        first_byte_s=rtt,
+                        local=self._link_local and charged == 0.0,
+                    )
                     break
                 except (OSError, ConnectionError) as e:
                     self.close()
@@ -835,6 +863,23 @@ class ManagerServer(_NativeServer):
         if rc != 0:
             raise RuntimeError(_native.last_error())
 
+    def report_links(self, links: "Dict[str, Any]") -> None:
+        """Record this replica's bounded link-state digest
+        (``LinkRegistry.maybe_digest``: ``{"host", "rows"}``); the next
+        lighthouse heartbeat carries it exactly once (consumed-on-send,
+        restored on RPC failure — the per-step-digest idiom), feeding the
+        fleet host-pair matrix (``/links.json``)."""
+        if self._handle is None:
+            return
+        # chaos site: a dropped/raised link report degrades to stale
+        # matrix rows; it must never wedge the heartbeat loop
+        _faults.check("lighthouse.links")
+        rc = _native.get_lib().tft_manager_report_links(
+            self._handle, json.dumps(links).encode()
+        )
+        if rc != 0:
+            raise RuntimeError(_native.last_error())
+
 
 # ---------------------------------------------------------------------------
 # clients
@@ -905,6 +950,7 @@ class LighthouseClient:
         last_step_wall_ms: "Optional[int]" = None,
         inflight_op: "Optional[str]" = None,
         summary: "Optional[Dict[str, Any]]" = None,
+        links: "Optional[Dict[str, Any]]" = None,
     ) -> Dict[str, Any]:
         """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms.
 
@@ -917,9 +963,12 @@ class LighthouseClient:
         ``torchft_straggler_score``).  ``summary`` is the per-step digest
         (``step``, ``phase_ms`` name->ms, ``codec_busy_s``,
         ``wire_busy_s``) aggregated into the cluster step-timeline
-        (``/timeline.json``) — send a given step's digest ONCE.  Returns
-        the server reply (e.g. ``{"superseded": true}`` for an evicted
-        incarnation)."""
+        (``/timeline.json``) — send a given step's digest ONCE.  ``links``
+        is the replica's bounded link-state digest
+        (``LinkRegistry.maybe_digest``: ``{"host", "rows"}``) folded into
+        the fleet host-pair matrix (``/links.json``) — likewise send each
+        digest ONCE.  Returns the server reply (e.g.
+        ``{"superseded": true}`` for an evicted incarnation)."""
         # chaos site: the straggler-telemetry path must itself be
         # chaos-testable (docs/robustness.md site table)
         _faults.check("lighthouse.heartbeat", replica=replica_id)
@@ -932,6 +981,12 @@ class LighthouseClient:
             params["inflight_op"] = inflight_op
         if summary is not None:
             params["summary"] = summary
+        if links is not None:
+            # chaos site: a dropped/raised link report must degrade to
+            # stale matrix rows, never wedge the heartbeat itself — the
+            # caller catches and re-queues (docs/robustness.md)
+            _faults.check("lighthouse.links", replica=replica_id)
+            params["links"] = links
         return self._client.call("heartbeat", params, timeout)
 
     def status(
@@ -967,6 +1022,7 @@ class LighthouseClient:
         role: str = "server",
         version: int = 0,
         capacity: int = 0,
+        version_ms: int = 0,
         timeout: "float | timedelta" = 5.0,
     ) -> Dict[str, Any]:
         """Register/refresh a weight-serving member (docs/architecture.md
@@ -975,16 +1031,21 @@ class LighthouseClient:
         serving replica); ``address`` is the member's HTTP
         checkpoint-transport base address; ``version`` the newest weight
         version it holds; ``capacity`` overrides the tree fanout for this
-        node (0 = server default).  Expiry follows the lighthouse
-        heartbeat timeout.  Returns ``{"plan_epoch", "latest_version"}``
-        — a ``plan_epoch`` differing from the adopted one means the tree
-        re-formed and :meth:`serving_plan` should be re-fetched."""
+        node (0 = server default); ``version_ms`` is the PUBLISH
+        wall-clock stamp (ms) of ``version`` — the publisher's clock,
+        carried unmodified through the tree so the lighthouse can compute
+        per-node serving staleness on a single clock (0 = unknown).
+        Expiry follows the lighthouse heartbeat timeout.  Returns
+        ``{"plan_epoch", "latest_version"}`` — a ``plan_epoch`` differing
+        from the adopted one means the tree re-formed and
+        :meth:`serving_plan` should be re-fetched."""
         params: "Dict[str, Any]" = {
             "replica_id": replica_id,
             "address": address,
             "role": role,
             "version": int(version),
             "capacity": int(capacity),
+            "version_ms": int(version_ms),
         }
         result = self._client.call("serving_heartbeat", params, timeout)
         return {
@@ -1049,6 +1110,31 @@ class LighthouseClient:
         worst-K straggler snapshot — one scrape answers "what was the
         whole fleet doing at step N"."""
         return self._client.call("timeline", {}, timeout)
+
+    def links(
+        self,
+        timeout: "float | timedelta" = 5.0,
+        page: "Optional[int]" = None,
+        per_page: "Optional[int]" = None,
+    ) -> Dict[str, Any]:
+        """The fleet link-state matrix (same document as
+        ``GET /links.json``): host-pair rows aggregated from the
+        heartbeat-piggybacked link digests — per (reporting host, peer
+        host, plane): goodput, first-byte p50/p99, sample count and
+        report age.  ``rows`` is paginated like ``/status.json``
+        (``page``/``per_page``); fleet truth (``rows_total``, ``pages``,
+        ``version``, ``hosts``, ``worst``) is present on every page.
+        ``version`` is monotone — equal versions mean an identical
+        matrix.  See docs/observability.md "Link-state plane"."""
+        # chaos site: shared with the report path — a faulted links plane
+        # degrades reads the same way it degrades reports
+        _faults.check("lighthouse.links")
+        params: "Dict[str, Any]" = {}
+        if page is not None:
+            params["page"] = int(page)
+        if per_page is not None:
+            params["per_page"] = int(per_page)
+        return self._client.call("links", params, timeout)
 
     def close(self) -> None:
         """Close the underlying connection; the client is unusable after."""

@@ -24,6 +24,19 @@ answers "what was the whole fleet doing at step N"; its worst-K
 straggler snapshot names a culprit (signal ``timeline_straggler``) even
 when no flight dumps were collected at all.
 
+``--links <file-or-URL>`` folds in the lighthouse's fleet link-state
+matrix (``GET /links.json`` — aggregated from the heartbeat-piggybacked
+per-host link digests, utils/linkstats.py) and adds a ``slow_link``
+culprit signal: a host pair whose sustained goodput is a strong outlier
+below the fleet median names the wire itself as the culprit — the one
+degradation mode no per-replica evidence can see (every replica on the
+slow link looks equally unlucky from inside).  Combined with ``--trace``
+it also splits the critical-path ledger's ``wire`` category into
+**expected** (what the fleet-median link would have spent moving the
+same traffic) vs **excess** (the slow link's surcharge), so "wire ate
+the step" becomes "the wire was 4x slower than the fleet's, costing
+120ms/step".
+
 ``--trace <TORCHFT_TRACE_FILE>`` reads the distributed-tracing span sink
 (utils/tracing.py) and reconstructs the **cross-replica critical path**
 per step: trace ids are deterministic per step, every replica's
@@ -59,14 +72,18 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "load_records",
     "load_timeline",
+    "load_links",
     "load_spans",
     "analyze",
     "analyze_timeline",
+    "analyze_links",
     "analyze_trace",
+    "apply_wire_split",
     "ledger_categories",
     "dominant_contributor",
     "render_text",
     "render_timeline_text",
+    "render_links_text",
     "render_trace_text",
     "selftest",
     "main",
@@ -81,6 +98,12 @@ RETRY_STORM_THRESHOLD = 3
 # a straggler score this far past typical (~1.0) in the lighthouse
 # timeline snapshot is a culprit signal of its own
 TIMELINE_STRAGGLER_SCORE = 4.0
+# a WAN link whose goodput is this many times below the fleet median is
+# a slow_link culprit (with enough samples to call it sustained)
+SLOW_LINK_RATIO = 4.0
+# estimator samples required before a link can be named a culprit — a
+# couple of unlucky transfers are noise, not a slow wire
+SLOW_LINK_MIN_SAMPLES = 8
 
 #: protocol-phase name -> critical-path ledger cost category.  The same
 #: mapping bench.py uses for its per-leg dominant-contributor field, so
@@ -278,6 +301,41 @@ def load_timeline(src: str) -> "Dict[str, Any]":
             doc = json.load(fh)
     if not isinstance(doc, dict) or "steps" not in doc:
         raise ValueError(f"{src}: not a /timeline.json document")
+    return doc
+
+
+def load_links(src: str) -> "Dict[str, Any]":
+    """Load a lighthouse ``/links.json`` document from a file path, an
+    ``http(s)://`` URL, a ``host:port`` shorthand, or a replicated-
+    lighthouse ``h1:p,h2:p`` comma list (which rides the HA failover walk
+    via the ``links`` RPC).  Raises on unreadable/invalid input, same
+    contract as :func:`load_timeline`."""
+    if "," in src and ":" in src and not os.path.exists(src):
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(src)
+        try:
+            doc = client.links(timeout=10.0)
+        finally:
+            client.close()
+        if not isinstance(doc, dict) or "rows" not in doc:
+            raise ValueError(f"{src}: not a /links.json document")
+        return doc
+    if src.startswith(("http://", "https://")) or (
+        "/" not in src and ":" in src and not os.path.exists(src)
+    ):
+        import urllib.request
+
+        url = src if src.startswith("http") else f"http://{src}"
+        if not url.rstrip("/").endswith("/links.json"):
+            url = url.rstrip("/") + "/links.json"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+    else:
+        with open(src, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    if not isinstance(doc, dict) or "rows" not in doc:
+        raise ValueError(f"{src}: not a /links.json document")
     return doc
 
 
@@ -624,6 +682,109 @@ def analyze_timeline(timeline: "Dict[str, Any]") -> "Dict[str, Any]":
     }
 
 
+def _median(vals: "List[float]") -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def analyze_links(links: "Dict[str, Any]") -> "Dict[str, Any]":
+    """The ``slow_link`` culprit signal from the fleet link matrix.
+
+    Only **WAN rows** (``local=false``) compete — the intra-host fabric
+    runs at memory speed and would drag the median up until every real
+    wire looks like a culprit.  A link is named when its estimated
+    goodput is ``SLOW_LINK_RATIO``x below the fleet-median WAN goodput
+    with at least ``SLOW_LINK_MIN_SAMPLES`` samples behind the estimate
+    (sustained, not one unlucky transfer).  The culprit is the host
+    PAIR, not a replica: every replica crossing that wire is equally
+    slow from inside, which is exactly why no flight dump can see it."""
+    rows = [r for r in (links.get("rows") or []) if isinstance(r, dict)]
+    wan = [
+        r
+        for r in rows
+        if not r.get("local")
+        and float(r.get("goodput_bps") or 0.0) > 0.0
+    ]
+    med = _median([float(r["goodput_bps"]) for r in wan])
+    culprit: "Optional[Dict[str, Any]]" = None
+    slow: "List[Dict[str, Any]]" = []
+    for r in sorted(wan, key=lambda r: float(r["goodput_bps"])):
+        g = float(r["goodput_bps"])
+        if (
+            med > 0.0
+            and g * SLOW_LINK_RATIO < med
+            and int(r.get("samples") or 0) >= SLOW_LINK_MIN_SAMPLES
+        ):
+            slow.append(r)
+    if slow:
+        r = slow[0]  # sorted ascending: the slowest sustained outlier
+        culprit = {
+            "replica_id": f"link {r.get('src')}->{r.get('peer')}",
+            "reason": (
+                f"link-state matrix: {r.get('plane')} goodput "
+                f"{float(r['goodput_bps']) / 1e6:.1f} MB/s is "
+                f"{med / max(float(r['goodput_bps']), 1e-9):.1f}x below "
+                f"the fleet-median WAN link ({med / 1e6:.1f} MB/s, "
+                f"{r.get('samples')} samples)"
+            ),
+            "signal": "slow_link",
+        }
+    return {
+        "culprit": culprit,
+        "rows_total": links.get("rows_total", len(rows)),
+        "rows_wan": len(wan),
+        "hosts": links.get("hosts"),
+        "version": links.get("version"),
+        "median_wan_goodput_bps": med,
+        "slow_links": [
+            {
+                "src": r.get("src"),
+                "peer": r.get("peer"),
+                "plane": r.get("plane"),
+                "goodput_bps": float(r.get("goodput_bps") or 0.0),
+                "rtt_p99_ms": float(r.get("rtt_p99_ms") or 0.0),
+                "samples": int(r.get("samples") or 0),
+            }
+            for r in slow
+        ],
+    }
+
+
+def apply_wire_split(
+    trace_report: "Dict[str, Any]", links_report: "Dict[str, Any]"
+) -> None:
+    """Annotate the critical-path ledger with the expected-vs-excess wire
+    split, in place.
+
+    The ledger knows how long the wire was busy (``wire`` seconds); the
+    link matrix knows how fast the wire actually ran vs the fleet.  For
+    each step's critical replica: the same traffic on a fleet-median
+    link would have taken ``wire_s * (slow / median)`` — that is the
+    **expected** share; the rest is **excess**, the slow link's
+    surcharge.  With no sustained slow link the split is degenerate
+    (everything expected) and nothing is annotated — the split exists to
+    quantify a named culprit, not to invent one."""
+    slow = links_report.get("slow_links") or []
+    med = float(links_report.get("median_wan_goodput_bps") or 0.0)
+    if not slow or med <= 0.0:
+        return
+    g = float(slow[0]["goodput_bps"])
+    if g <= 0.0 or g >= med:
+        return
+    frac_expected = g / med
+    for step in trace_report.get("steps") or []:
+        info = step["replicas"].get(step["critical_replica"]) or {}
+        wire_s = float((info.get("categories") or {}).get("wire") or 0.0)
+        if wire_s <= 0.0:
+            continue
+        step["wire_expected_s"] = round(wire_s * frac_expected, 6)
+        step["wire_excess_s"] = round(wire_s * (1.0 - frac_expected), 6)
+        step["wire_slow_link"] = f"{slow[0]['src']}->{slow[0]['peer']}"
+
+
 def _span_dur_s(span: "Dict[str, Any]") -> float:
     try:
         return max(
@@ -921,6 +1082,46 @@ def render_timeline_text(
     return "\n".join(out)
 
 
+def render_links_text(
+    links: "Dict[str, Any]",
+    links_report: "Dict[str, Any]",
+    max_rows: int = 15,
+) -> str:
+    """The fleet link matrix as a text section: worst WAN links first
+    (goodput ascending), the fleet median for scale, and any sustained
+    slow-link outliers called out."""
+    out: "List[str]" = []
+    rows = [
+        r
+        for r in (links.get("rows") or [])
+        if isinstance(r, dict) and not r.get("local")
+    ]
+    rows.sort(key=lambda r: float(r.get("goodput_bps") or 0.0))
+    med = float(links_report.get("median_wan_goodput_bps") or 0.0)
+    out.append(
+        f"fleet link matrix ({min(len(rows), max_rows)} of {len(rows)} WAN "
+        f"links, {links_report.get('hosts')} hosts, "
+        f"median {med / 1e6:.1f} MB/s):"
+    )
+    for r in rows[:max_rows]:
+        g = float(r.get("goodput_bps") or 0.0)
+        ratio = f" ({med / g:.1f}x below median)" if med > 0 < g < med else ""
+        out.append(
+            f"  {str(r.get('src', '?'))[:20]:20s} -> "
+            f"{str(r.get('peer', '?'))[:20]:20s} {str(r.get('plane')):10s} "
+            f"{g / 1e6:8.1f} MB/s  rtt p99 "
+            f"{float(r.get('rtt_p99_ms') or 0.0):7.1f}ms  "
+            f"samples={r.get('samples')}{ratio}"
+        )
+    for s in links_report.get("slow_links") or []:
+        out.append(
+            f"  SLOW LINK: {s['src']}->{s['peer']} ({s['plane']}) "
+            f"{s['goodput_bps'] / 1e6:.1f} MB/s sustained over "
+            f"{s['samples']} samples"
+        )
+    return "\n".join(out)
+
+
 def render_trace_text(trace_report: "Dict[str, Any]", max_rows: int = 30) -> str:
     """The per-step critical-path ledger as a text section: one row per
     step (wall, critical replica, dominant category, category split) plus
@@ -948,6 +1149,13 @@ def render_trace_text(trace_report: "Dict[str, Any]", max_rows: int = 30) -> str
             f"critical={s['critical_replica'][:28]:28s} "
             f"dominant={s['dominant'] or '-':<14} {split}"
         )
+        if "wire_excess_s" in s:
+            out.append(
+                f"      wire split vs fleet-median link: expected "
+                f"{s['wire_expected_s'] * 1e3:.1f}ms + excess "
+                f"{s['wire_excess_s'] * 1e3:.1f}ms "
+                f"(slow link {s['wire_slow_link']})"
+            )
         for rid, info in sorted(s["replicas"].items()):
             marker = " " if info["ok"] else "!"
             out.append(
@@ -1088,6 +1296,13 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         "into the report — names a straggler culprit even without dumps",
     )
     parser.add_argument(
+        "--links", default=None, metavar="FILE_OR_URL",
+        help="lighthouse /links.json (file, URL, or host:port) to fold "
+        "into the report — names a sustained slow host-pair link "
+        "(signal slow_link) and, with --trace, splits the ledger's wire "
+        "cost into expected vs excess against the fleet-median link",
+    )
+    parser.add_argument(
         "--trace", default=None, metavar="TRACE_FILE",
         help="distributed-tracing span sink (TORCHFT_TRACE_FILE JSONL): "
         "reconstructs the per-step cross-replica critical-path ledger "
@@ -1109,7 +1324,13 @@ def main(argv: "Optional[List[str]]" = None) -> int:
 
     if args.selftest:
         return 0 if selftest() else 1
-    if not args.dumps and not args.events and not args.timeline and not args.trace:
+    if (
+        not args.dumps
+        and not args.events
+        and not args.timeline
+        and not args.trace
+        and not args.links
+    ):
         parser.print_usage(sys.stderr)
         print("torchft-diagnose: no input files", file=sys.stderr)
         return 2
@@ -1123,6 +1344,15 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         except Exception as e:  # noqa: BLE001 - report, don't die mid-postmortem
             print(f"warning: --timeline {args.timeline}: {e}", file=sys.stderr)
 
+    links_doc: "Optional[Dict[str, Any]]" = None
+    links_report: "Optional[Dict[str, Any]]" = None
+    if args.links:
+        try:
+            links_doc = load_links(args.links)
+            links_report = analyze_links(links_doc)
+        except Exception as e:  # noqa: BLE001 - report, don't die mid-postmortem
+            print(f"warning: --links {args.links}: {e}", file=sys.stderr)
+
     trace_report: "Optional[Dict[str, Any]]" = None
     trace_warnings: "List[str]" = []
     if args.trace:
@@ -1134,23 +1364,35 @@ def main(argv: "Optional[List[str]]" = None) -> int:
 
     entries, warnings = load_records(list(args.dumps), list(args.events))
     warnings.extend(trace_warnings)
-    if not entries and timeline_report is None and trace_report is None:
+    if (
+        not entries
+        and timeline_report is None
+        and trace_report is None
+        and links_report is None
+    ):
         for w in warnings:
             print(f"warning: {w}", file=sys.stderr)
         print("torchft-diagnose: no parseable records", file=sys.stderr)
         return 1
     report = analyze(entries)
+    if trace_report is not None and links_report is not None:
+        apply_wire_split(trace_report, links_report)
     # Culprit precedence: flight-record signals see INSIDE a replica and
     # win when present; the trace ledger's ok=false spans are next (they
     # also see inside, but dumps carry the fault tags); the lighthouse
-    # timeline sees the fleet from outside and fills the remaining gap.
-    # All three join on step/quorum_id — one report.
+    # timeline sees the fleet from outside; the link matrix is last — a
+    # slow wire is a degradation, not a failure, so any failure
+    # signature outranks it.  All four join into one report.
     if report["culprit"] is None and trace_report is not None:
         report["culprit"] = trace_report["culprit"]
     if report["culprit"] is None and timeline_report is not None:
         report["culprit"] = timeline_report["culprit"]
+    if report["culprit"] is None and links_report is not None:
+        report["culprit"] = links_report["culprit"]
     if timeline_report is not None:
         report["cluster_timeline"] = timeline_report
+    if links_report is not None:
+        report["link_matrix"] = links_report
     if trace_report is not None:
         report["trace_ledger"] = trace_report
     if args.json:
@@ -1162,6 +1404,8 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         print(render_text(entries, report, warnings, max_rows=args.max_rows))
         if cluster_timeline is not None:
             print(render_timeline_text(cluster_timeline))
+        if links_doc is not None and links_report is not None:
+            print(render_links_text(links_doc, links_report))
         if trace_report is not None:
             print(render_trace_text(trace_report))
     return 0

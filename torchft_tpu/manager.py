@@ -53,6 +53,7 @@ from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_
 from torchft_tpu.parallel.work import Work, completed_work
 from torchft_tpu.utils import faults as faults
 from torchft_tpu.utils import flightrecorder as flightrec
+from torchft_tpu.utils import linkstats as linkstats
 from torchft_tpu.utils import metrics as metrics
 from torchft_tpu.utils import tracing as tracing
 from torchft_tpu.utils.env import env_bool, env_float, env_int, env_str
@@ -1474,6 +1475,17 @@ class Manager:
             )
         except Exception:  # noqa: BLE001 - telemetry must not fail the step
             logger.debug("step summary report failed", exc_info=True)
+        # Piggyback the fleet link-state digest on the same heartbeat
+        # channel (consumed-on-send, like the summary).  maybe_digest
+        # rate-limits itself (TORCHFT_LINK_REPORT_S), so this is a no-op
+        # on most steps; a faulted or failing report never touches the
+        # step path.
+        try:
+            digest = linkstats.LINKS.maybe_digest(socket.gethostname())
+            if digest is not None:
+                server.report_links(digest)
+        except Exception:  # noqa: BLE001 - telemetry must not fail the step
+            logger.debug("link digest report failed", exc_info=True)
 
     def current_step(self) -> int:
         return self._step

@@ -49,6 +49,7 @@ from torchft_tpu.coordination import StoreClient
 from torchft_tpu.parallel.work import Work, completed_work, failed_work
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
+from torchft_tpu.utils import linkstats as _linkstats
 from torchft_tpu.utils import lockcheck as _lockcheck
 from torchft_tpu.utils import metrics as _metrics
 from torchft_tpu.utils.bufpool import POOL as _pool
@@ -323,7 +324,9 @@ class _TokenBucket:
         self._t = time.monotonic()
         self._lock = _lockcheck.lock("pg.token_bucket")
 
-    def consume(self, nbytes: int) -> None:
+    def consume(self, nbytes: int) -> float:
+        """Debit ``nbytes``; returns the seconds slept serving the debt
+        (the shaper-wait the per-peer wait accounting attributes)."""
         with self._lock:
             now = time.monotonic()
             self._tokens = min(
@@ -333,7 +336,10 @@ class _TokenBucket:
             self._tokens -= nbytes
             debt = -self._tokens
         if debt > 0:
-            time.sleep(debt / self.rate)
+            wait = debt / self.rate
+            time.sleep(wait)
+            return wait
+        return 0.0
 
 
 class _PGAborted(RuntimeError):
@@ -409,6 +415,13 @@ class ProcessGroupTCP(ProcessGroup):
         # ranks whose messages cross a topology boundary (computed per
         # configure from TORCHFT_TOPOLOGY; empty while unconfigured)
         self._inter_peers: "frozenset[int]" = frozenset()
+        # link-state plane identities (utils/linkstats.py): per peer
+        # rank, the peer host learned at configure and the derived
+        # (link label, is_local) pair — a same-host peer across a
+        # declared topology boundary gets a ``host#gN`` pseudo-host so
+        # the shaped link is never averaged into the local fabric
+        self._peer_hosts: "Dict[int, str]" = {}
+        self._link_labels: "Dict[int, Tuple[str, bool]]" = {}
         # In-flight op handle in the process-wide flight recorder
         # (utils/flightrecorder.py; subsumes the old ad-hoc ``_flight``
         # dict).  The FlightOp serializes its own updates (worker + sender
@@ -457,6 +470,30 @@ class ProcessGroupTCP(ProcessGroup):
             r for r in range(world) if r != rank and topo.inter(rank, r)
         )
 
+    def _link_peer_labels(
+        self, world: int
+    ) -> "Dict[int, Tuple[str, bool]]":
+        """(link label, is_local) per connected peer for the passive
+        link-state plane.  Cross-host peers key by their real host; a
+        same-host peer across the declared topology boundary keys by the
+        ``host#gN`` pseudo-host (its topology group) so WAN-modeled and
+        local-fabric traffic never share an estimator — intra-host pairs
+        report unshaped-fast, boundary pairs report the modeled link."""
+        from torchft_tpu.ops.topology import resolve_topology
+        from torchft_tpu.utils.hostident import local_host_identities
+
+        topo = resolve_topology(world) if world > 1 else None
+        local_ids = local_host_identities()
+        labels: "Dict[int, Tuple[str, bool]]" = {}
+        for r, host in self._peer_hosts.items():
+            wan = r in self._inter_peers
+            if wan and topo is not None and host in local_ids:
+                label = f"{host}#g{topo.group_index(r)}"
+            else:
+                label = host
+            labels[r] = (label, not wan)
+        return labels
+
     # -- lifecycle ---------------------------------------------------------
 
     def configure(
@@ -481,6 +518,8 @@ class ProcessGroupTCP(ProcessGroup):
 
         if world_size == 1:
             self._peers = {}
+            self._peer_hosts = {}
+            self._link_labels = {}
             self._start_worker(gen)
             _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
             _flightrec.record(
@@ -510,6 +549,7 @@ class ProcessGroupTCP(ProcessGroup):
             store.set(f"{prefix}/rank_{rank}", f"{host}:{port}")
 
             peers: Dict[int, _PeerConn] = {}
+            peer_hosts: Dict[int, str] = {}
             # Deterministic connect direction avoids duplicate links: lower
             # ranks dial higher ranks; higher ranks accept.
             for peer in range(rank + 1, world_size):
@@ -525,6 +565,7 @@ class ProcessGroupTCP(ProcessGroup):
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.sendall(struct.pack(">II", _HELLO_MAGIC, rank))
                 peers[peer] = _PeerConn(sock, peer)
+                peer_hosts[peer] = phost
             for _ in range(rank):
                 listener.settimeout(max(deadline - time.monotonic(), 0.001))
                 sock, _ = listener.accept()
@@ -533,7 +574,13 @@ class ProcessGroupTCP(ProcessGroup):
                 if magic != _HELLO_MAGIC:
                     raise RuntimeError("bad hello from peer")
                 peers[peer_rank] = _PeerConn(sock, peer_rank)
+                try:
+                    peer_hosts[peer_rank] = sock.getpeername()[0]
+                except OSError:
+                    peer_hosts[peer_rank] = "unknown"
             self._peers = peers
+            self._peer_hosts = peer_hosts
+            self._link_labels = self._link_peer_labels(world_size)
             self._start_worker(gen)
             _metrics.PG_RECONFIGURES.labels(transport="tcp").inc()
             _flightrec.record(
@@ -783,6 +830,8 @@ class ProcessGroupTCP(ProcessGroup):
             deadline_mono=deadline,
         )
         wan = dst in self._inter_peers
+        t0 = time.perf_counter()
+        shaper_wait = 0.0
         if wan and self._rtt_s > 0.0:
             # First-byte latency of the WAN model: once per MESSAGE,
             # before any byte moves, independent of the bandwidth debt
@@ -790,12 +839,13 @@ class ProcessGroupTCP(ProcessGroup):
             # 1x RTT).  Charged in the sender so a blocked receiver
             # observes the first byte RTT late, like a real WAN socket.
             time.sleep(self._rtt_s)
+            shaper_wait += self._rtt_s
         # boundary-scoped shaping: only messages crossing the declared
         # topology boundary ride the modeled WAN link (flat/unset
         # topology: every peer — see __init__)
         bucket = self._bucket if wan else None
         if bucket is not None:
-            bucket.consume(8 + len(header))
+            shaper_wait += bucket.consume(8 + len(header))
         peer.sock.settimeout(max(deadline - time.monotonic(), 0.001))
         peer.sock.sendall(struct.pack(">II", len(header), array.nbytes) + header)
         if array.nbytes:
@@ -814,11 +864,28 @@ class ProcessGroupTCP(ProcessGroup):
                 chunk_len = 1 << 20
                 for off in range(0, len(view), chunk_len):
                     chunk = view[off : off + chunk_len]
-                    bucket.consume(len(chunk))
+                    shaper_wait += bucket.consume(len(chunk))
                     peer.sock.settimeout(
                         max(deadline - time.monotonic(), 0.001)
                     )
                     peer.sock.sendall(chunk)
+        # Passive link-state measurement (utils/linkstats.py): every
+        # completed send is one sample — bytes + wall on the reduction
+        # plane, first-byte = the modeled RTT leg.  Shaper waits are
+        # additionally attributed per peer host (worst-K label tier).
+        label, is_local = self._link_labels.get(dst, ("unknown", not wan))
+        _linkstats.record(
+            label,
+            "reduction",
+            8 + len(header) + array.nbytes,
+            time.perf_counter() - t0,
+            first_byte_s=self._rtt_s if (wan and self._rtt_s > 0.0) else 0.0,
+            local=is_local,
+        )
+        if shaper_wait > 0.0:
+            _metrics.PG_WIRE_WAIT.labels(
+                peer=_linkstats.LINKS.peer_topk_label(label)
+            ).inc(shaper_wait)
 
     def _recv_msg(
         self,

@@ -237,6 +237,17 @@ class ServingClient:
         self._min_version = max(self._min_version, v)
         dt = time.perf_counter() - t0
         _metrics.SERVING_FETCH_SECONDS.labels(role="client").observe(dt)
+        # client-role staleness: publish->in-hand lag, from the fetched
+        # manifest's publish stamp (publisher clock vs this host's —
+        # subject to cross-host skew; the skew-free ledger is the
+        # lighthouse's /serving.json staleness_ms rows).
+        held = self._held
+        if held is not None:
+            v_ms = int(held[0].get("created_ns", 0) // 1_000_000)
+            if v_ms > 0:
+                _metrics.SERVING_STALENESS.labels(role="client").observe(
+                    max(time.time() - v_ms / 1e3, 0.0)
+                )
         tracer = _tracing.get_tracer()
         ctx = _tracing.get_current()
         if tracer is not None and ctx is not None and ctx.sampled:

@@ -82,6 +82,12 @@ class WeightPublisher:
         # version clients could never fetch).
         self._version = 0
         self._reserved = 0
+        # Staleness ledger: publish wall-stamp (ms, THIS process's clock
+        # — the reference clock every staleness comparison uses) of
+        # _version, taken from the manifest's created_ns so the stamp
+        # advertised here is bit-identical to the one relays/clients
+        # read out of the fetched manifest.
+        self._version_ms = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # publish() sets this so the next heartbeat (which advertises the
@@ -117,6 +123,13 @@ class WeightPublisher:
         with self._lock:
             return self._version
 
+    def latest_version_ms(self) -> int:
+        """Publish wall-stamp (ms since epoch, this process's clock) of
+        :meth:`latest_version` — the staleness ledger's reference point
+        (0 before the first publish)."""
+        with self._lock:
+            return self._version_ms
+
     def _hb_loop(self, interval: float) -> None:
         # Pacing loop (not a retry loop): one registration heartbeat per
         # interval; RPC failures are logged and the next beat retries
@@ -128,6 +141,7 @@ class WeightPublisher:
                     self.address(),
                     role="publisher",
                     version=self.latest_version(),
+                    version_ms=self.latest_version_ms(),
                 )
                 _metrics.SERVING_PLAN_EPOCH.labels(role="publisher").set(
                     reply["plan_epoch"]
@@ -166,9 +180,17 @@ class WeightPublisher:
             state_dict, v, wire=self._wire, fragments=self._fragments
         )
         self._transport.send_checkpoint([], v, doc, timeout=timeout)
+        # Staleness ledger: the manifest's created_ns IS the publish
+        # stamp — advertised here and carried in the payload, so every
+        # tier reads the same number.
+        v_ms = int(
+            doc[f"frag:{_payload.MANIFEST_FRAG}"].get("created_ns", 0)
+            // 1_000_000
+        )
         with self._lock:
             if v > self._version:
                 self._version = v
+                self._version_ms = v_ms
         # Advertise synchronously: when publish() returns, the version is
         # discoverable fleet-wide (a lighthouse hiccup degrades to the
         # background beat rather than failing the publish).
@@ -176,11 +198,17 @@ class WeightPublisher:
             try:
                 self._client.serving_heartbeat(
                     self._replica_id, self.address(),
-                    role="publisher", version=v,
+                    role="publisher", version=v, version_ms=v_ms,
                 )
             except Exception as e:  # noqa: BLE001 - next beat re-advertises
                 logger.warning("serving publish advertise failed: %s", e)
                 self._nudge.set()
+        # publisher-role staleness = publish->advertise lag on the
+        # publisher's own clock (encode + staging + the advertise RPC)
+        if v_ms > 0:
+            _metrics.SERVING_STALENESS.labels(role="publisher").observe(
+                max(time.time() - v_ms / 1e3, 0.0)
+            )
         dt = time.perf_counter() - t0
         _metrics.SERVING_PUBLISHES.labels(wire=self._wire).inc()
         _metrics.SERVING_PUBLISH_SECONDS.labels(wire=self._wire).observe(dt)

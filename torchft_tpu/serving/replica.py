@@ -129,6 +129,12 @@ class ServingReplica:
         self._frag_fetcher = _fetcher.FragmentFetcher(role="relay")
         self._lock = threading.Lock()
         self._version = 0
+        # Staleness ledger: publish wall-stamp (publisher's clock, ms)
+        # of the held version, read from the fetched manifest's
+        # created_ns and re-advertised unmodified on every heartbeat —
+        # the lighthouse compares stamps from the SAME clock, so
+        # per-node staleness in /serving.json is skew-free.
+        self._version_ms = 0
         # delta base: manifest of the newest COMPLETELY staged version
         # (digest diff against it decides which fragments need wire)
         self._held_manifest: "Optional[Dict[str, Any]]" = None
@@ -184,12 +190,15 @@ class ServingReplica:
             self._stop.wait(self._poll)
 
     def _beat_once(self) -> None:
+        with self._lock:
+            held_v, held_ms = self._version, self._version_ms
         reply = self._client.serving_heartbeat(
             self._replica_id,
             self.address(),
             role="server",
-            version=self.version(),
+            version=held_v,
             capacity=self._capacity,
+            version_ms=held_ms,
         )
         if reply["plan_epoch"] != self.plan_epoch():
             self._adopt_plan()
@@ -288,9 +297,20 @@ class ServingReplica:
         with self._lock:
             if target > self._version:
                 self._version = target
+                m = self._held_manifest or {}
+                self._version_ms = int(m.get("created_ns", 0) // 1_000_000)
+            held_ms = self._version_ms
         dt = time.perf_counter() - t0
         _metrics.SERVING_FETCH_SECONDS.labels(role="relay").observe(dt)
         _metrics.SERVING_VERSION.labels(role="server").set(self.version())
+        # server-role staleness: publish->this-node availability lag.
+        # Publisher clock vs this host's clock — subject to cross-host
+        # skew (the skew-free per-node ledger is the lighthouse's, in
+        # /serving.json); on a well-synced fleet this IS publish->leaf.
+        if held_ms > 0:
+            _metrics.SERVING_STALENESS.labels(role="server").observe(
+                max(time.time() - held_ms / 1e3, 0.0)
+            )
 
     def _pull_flat(
         self, target: int, ordered: "List[str]", op: Any

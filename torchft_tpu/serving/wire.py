@@ -91,6 +91,14 @@ class WireShaper:
             return True
         return source_host(source) not in self._local
 
+    def first_byte_s(self, source: str) -> float:
+        """The modeled first-byte latency a fetch from ``source`` pays
+        (0 when unshaped or intra-host) — the component of charge() the
+        link-state plane attributes to RTT rather than bandwidth."""
+        if not self.active or not self.crosses_boundary(source):
+            return 0.0
+        return self._rtt_s
+
     def charge(self, source: str, nbytes: int) -> float:
         """Sleep off one message's WAN cost; returns seconds slept."""
         if not self.active or not self.crosses_boundary(source):
@@ -115,7 +123,16 @@ class WireShaper:
                 wait += debt / self._rate
         if wait > 0:
             time.sleep(wait)
-            _metrics.SERVING_WIRE_WAIT.inc(wait)
+            # per-host-pair attribution: shaped waits and the passively
+            # measured goodput (utils/linkstats.py) join on the same
+            # peer-host key; the worst-K label tier bounds cardinality
+            from torchft_tpu.utils import linkstats as _linkstats
+
+            _metrics.SERVING_WIRE_WAIT.labels(
+                peer=_linkstats.LINKS.peer_topk_label(
+                    source_host(source) or "unknown"
+                )
+            ).inc(wait)
         return wait
 
 

@@ -25,6 +25,12 @@ site                  fires in
                       lighthouse (``step`` = proposed term; the native
                       electors' C++ lease exchanges do not consult this
                       registry)
+``lighthouse.links``  link-state digest reporting — the Python
+                      ``LighthouseClient.heartbeat(links=...)`` /
+                      ``links()`` readers and ``ManagerServer.
+                      report_links`` handoff (a dropped report degrades
+                      the fleet matrix to stale rows; the heartbeat
+                      itself never carries the fault)
 ``manager.quorum``    ``Manager._async_quorum`` before the quorum RPC
 ``manager.heal``      ``Manager._async_quorum`` heal send/recv branches
 ``pg.reconfigure``    ``ProcessGroupTCP.configure`` /
@@ -130,6 +136,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "lighthouse.rpc",
     "lighthouse.heartbeat",
     "lighthouse.lease",
+    "lighthouse.links",
     "manager.quorum",
     "manager.heal",
     "pg.reconfigure",

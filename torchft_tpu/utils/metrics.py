@@ -1100,8 +1100,10 @@ SERVING_WIRE_WAIT = counter(
     "torchft_serving_wire_wait_seconds_total",
     "Seconds serving-tier fetches slept to honor the WAN wire model "
     "(TORCHFT_WIRE_RTT_MS + TORCHFT_WIRE_GBPS across the "
-    "TORCHFT_TOPOLOGY boundary; serving/wire.py)",
-    (),
+    "TORCHFT_TOPOLOGY boundary; serving/wire.py), by source peer host — "
+    "worst-K bounded tier (TORCHFT_LINK_TOPK names + 'other'); the "
+    "unlabeled aggregate is the process total",
+    ("peer",),
 )
 SERVING_RELAY_DECODE = histogram(
     "torchft_serving_relay_decode_seconds",
@@ -1121,6 +1123,52 @@ SERVING_CUT_OCCUPANCY = gauge(
     "[0, 1] — the serving twin of torchft_quant_overlap_efficiency "
     "(serving/replica.py)",
     (),
+)
+PG_WIRE_WAIT = counter(
+    "torchft_pg_wire_wait_seconds_total",
+    "Seconds ProcessGroupTCP sends slept to honor the WAN wire model "
+    "(first-byte RTT + token-bucket debt on boundary-crossing messages; "
+    "parallel/process_group.py), by peer host — worst-K bounded tier "
+    "(TORCHFT_LINK_TOPK names + 'other'); the unlabeled aggregate is "
+    "the process total",
+    ("peer",),
+)
+LINK_GOODPUT = gauge(
+    "torchft_link_goodput_bytes_per_s",
+    "Passively measured link goodput by peer host and transfer plane "
+    "(reduction/fragments/rpc; utils/linkstats.py) — worst-K WAN links "
+    "only (TORCHFT_LINK_TOPK); fleet-local truth in "
+    "torchft_link_pairs_tracked / torchft_link_goodput_min_bytes_per_s",
+    ("peer", "plane"),
+)
+LINK_RTT_P99 = gauge(
+    "torchft_link_rtt_p99_seconds",
+    "Windowed p99 first-byte latency of a measured link by peer host "
+    "and plane (TORCHFT_LINK_WINDOW samples; utils/linkstats.py) — "
+    "worst-K WAN links only",
+    ("peer", "plane"),
+)
+LINK_PAIRS = gauge(
+    "torchft_link_pairs_tracked",
+    "Links (peer, plane) in this process's full passive link table "
+    "(worst-K of these export per-peer series)",
+    (),
+)
+LINK_GOODPUT_MIN = gauge(
+    "torchft_link_goodput_min_bytes_per_s",
+    "Lowest measured WAN-link goodput in the full link table (one "
+    "series at any fleet size — the aggregate under the worst-K tier)",
+    (),
+)
+SERVING_STALENESS = histogram(
+    "torchft_serving_staleness_seconds",
+    "Serving staleness ledger: publish-stamp age of a weight version at "
+    "the moment a node finished holding/fetching it, by role "
+    "(publisher = encode+stage+advertise lag, server = publish-to-relay "
+    "propagation, client = publish-to-consumer; stamps ride the payload "
+    "manifest on the publisher's clock, so depth legs compare on ONE "
+    "clock)",
+    ("role",),
 )
 HA_FAILOVERS = counter(
     "torchft_ha_failovers_total",
