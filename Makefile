@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore
 
 native:
 	$(MAKE) -C native
@@ -103,6 +103,22 @@ heal-smoke:
 # compact-summary JSON line as the full bench.
 bench-heal:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --heal
+
+# Durable-store round trip alone (ISSUE 17): store unit surface (dedup,
+# torn-blob digest verify, cut selection, spiller, durable.py on the
+# store), whole-fleet SIGKILL cold restore with bitwise resume, the
+# torn-disk failover and degrade-to-fresh chaos legs, and the
+# cold-restore golden fixture (docs/architecture.md "Durable fragment
+# store").
+cold-restore-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_store.py tests/test_cold_restore.py tests/test_golden_fixtures.py -q -m "not slow"
+
+# Durable-store bench alone: spill wall, content-addressed dedup bytes,
+# cold-restore wall striped over {1,2} disks + the warm delta row
+# (docs/benchmarks.md); ends with the same < 1.5 KB compact-summary
+# JSON line as the full bench.
+bench-cold-restore:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --cold-restore
 
 # Fleet link-state plane round trip alone: passive estimator accuracy
 # on a shaped topology (closed-loop vs the declared RTT/Gbps), the

@@ -2341,6 +2341,160 @@ def bench_heal() -> "Dict[str, Any]":
     return out
 
 
+# ---------------------------------------------------------------------------
+# durable cold restore (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+CR_STATE_LEAVES = 16
+CR_LEAF_ELEMS = 1 << 17  # 16 x 512 KB = 8 MB f32 restore state
+CR_FRAGMENTS = 16
+CR_TRIALS = 3
+CR_DISKS = (1, 2)
+
+
+def _dir_bytes(path: str) -> int:
+    import os
+
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _cold_restore_trial(
+    stores: "List[Any]", version: int,
+    local: "Optional[Dict[str, Any]]" = None,
+) -> "Tuple[float, Dict[str, Any]]":
+    """One cold restore against ``stores`` as stripe sources: transports
+    with NO RAM staging (the fleet is dead — every ``frag_<name>`` fetch
+    is served straight off the attached disk store), reassembled by the
+    PR 15 striped fetch path; returns ``(wall_s, info)``."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    srcs = [HTTPTransport(timeout=60.0) for _ in stores]
+    for t, s in zip(srcs, stores):
+        t.attach_store(s)
+    healer = HTTPTransport(timeout=60.0)
+    try:
+        t0 = time.perf_counter()
+        _got, info = healer.recv_checkpoint_striped(
+            [t.metadata() for t in srcs], version, timeout=120.0,
+            local_state_fn=(lambda: local) if local is not None else None,
+            delta=local is not None,
+        )
+        wall = time.perf_counter() - t0
+    finally:
+        healer.shutdown()
+        for t in srcs:
+            t.shutdown()
+    return wall, info
+
+
+def bench_cold_restore() -> "Dict[str, Any]":
+    """Durable fragment store (ISSUE 17): spill + whole-fleet cold
+    restore off disk.  An 8 MB state is spilled to 2 rank-local stores;
+    the headline is the cold-restore wall (disk -> reassembled state)
+    striped over {1, 2} disks, plus the spill-side rows the design
+    claims: content-addressed DEDUP (respilling an unchanged state
+    writes ~0 new blob bytes) and the WARM delta restore (a rejoiner
+    whose memory survived fetches only the manifest)."""
+    import os
+    import shutil
+    import tempfile
+
+    from torchft_tpu.checkpointing.store import FragmentStore
+
+    rng = np.random.RandomState(41)
+    state = {
+        "user": {
+            f"w{i}": rng.randn(CR_LEAF_ELEMS).astype(np.float32)
+            for i in range(CR_STATE_LEAVES)
+        },
+        "torchft": {"step": 7, "batches_committed": 14},
+    }
+    payload_bytes = sum(a.nbytes for a in state["user"].values())
+    root = tempfile.mkdtemp(prefix="tft_bench_store_")
+    out: "Dict[str, Any]" = {
+        "state_mb": round(payload_bytes / 2**20, 2),
+        "fragments": CR_FRAGMENTS,
+        "trials": CR_TRIALS,
+    }
+    try:
+        stores = [
+            FragmentStore(os.path.join(root, f"rank{i}"), max_versions=0)
+            for i in range(max(CR_DISKS))
+        ]
+        # spill row: wall to durably persist one full version per disk
+        spill_walls: "List[float]" = []
+        for s in stores:
+            t0 = time.perf_counter()
+            s.put_state(7, state, fragments=CR_FRAGMENTS)
+            spill_walls.append(time.perf_counter() - t0)
+        spill_walls.sort()
+        out["spill"] = {
+            "wall_p50_s": round(spill_walls[len(spill_walls) // 2], 3),
+            "disk_bytes": _dir_bytes(stores[0].directory),
+        }
+        # dedup row: respill the SAME state as a newer version — blobs
+        # are content-addressed, so only the manifest should hit disk
+        before = _dir_bytes(stores[0].directory)
+        t0 = time.perf_counter()
+        stores[0].put_state(8, state, fragments=CR_FRAGMENTS)
+        dedup_wall = time.perf_counter() - t0
+        out["dedup"] = {
+            "wall_s": round(dedup_wall, 3),
+            "new_bytes": _dir_bytes(stores[0].directory) - before,
+            "payload_bytes": payload_bytes,
+        }
+        stores[1].put_state(8, state, fragments=CR_FRAGMENTS)
+        # cold-restore rows: striped reassembly with disks as sources
+        for n in CR_DISKS:
+            walls: "List[float]" = []
+            for _t in range(CR_TRIALS):
+                wall, info = _cold_restore_trial(stores[:n], 8)
+                walls.append(wall)
+            walls.sort()
+            out[f"d{n}"] = {
+                "wall_p50_s": round(walls[len(walls) // 2], 3),
+                "sources": n,
+            }
+            log(
+                f"cold restore d{n}: wall p50 "
+                f"{out[f'd{n}']['wall_p50_s']}s"
+            )
+        # warm delta row: local memory survived — only the manifest moves
+        local = {
+            "user": {k: v.copy() for k, v in state["user"].items()},
+            "torchft": dict(state["torchft"]),
+        }
+        wall, info = _cold_restore_trial(stores[:2], 8, local=local)
+        out["warm_delta"] = {
+            "wall_s": round(wall, 3),
+            "changed_fragments": info["changed"],
+            "wire_bytes": info["wire_bytes"],
+            "bytes_ratio": round(info["wire_bytes"] / payload_bytes, 4),
+        }
+        log(
+            f"cold restore warm delta: {info['changed']} changed, "
+            f"{info['wire_bytes']} B "
+            f"({out['warm_delta']['bytes_ratio']:.1%} of full)"
+        )
+        out["restore_wall_p50_s"] = out["d2"]["wall_p50_s"]
+        out["dedup_new_bytes"] = out["dedup"]["new_bytes"]
+        out["winner"] = (
+            "dedup"
+            if out["dedup"]["new_bytes"] < payload_bytes / 10
+            else "rewrite"
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 COMPACT_SUMMARY_MAX_BYTES = 1500
 
 
@@ -2555,6 +2709,17 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         )
         heal_compact["delta_bytes_ratio"] = heal["delta"].get("bytes_ratio")
     heal_compact = heal_compact or None
+    cr = result.get("cold_restore") or {}
+    cold_restore_compact = {
+        k: cr.get(k)
+        for k in ("restore_wall_p50_s", "dedup_new_bytes", "winner")
+        if cr.get(k) is not None
+    }
+    if isinstance(cr.get("warm_delta"), dict):
+        cold_restore_compact["warm_bytes_ratio"] = cr["warm_delta"].get(
+            "bytes_ratio"
+        )
+    cold_restore_compact = cold_restore_compact or None
     sdepth = result.get("serving_depth") or {}
     serving_depth_compact = {
         k: sdepth.get(k)
@@ -2620,6 +2785,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # striped-heal headline (ISSUE 15): 4-source wire-time speedup
         # over single-source on shaped links + the delta-rejoin row
         "heal": heal_compact,
+        # durable-store headline (ISSUE 17): cold-restore wall off 2
+        # disks + the content-addressed dedup and warm-delta verdicts
+        "cold_restore": cold_restore_compact,
         # link-state headline (ISSUE 16): pairs the passive registry
         # tracked + the worst WAN link it singled out
         "links": result.get("links"),
@@ -2654,6 +2822,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
         "links", "staleness", "ha", "serving", "serving_depth", "heal",
+        "cold_restore",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2722,6 +2891,18 @@ def main() -> None:
             "metric": "striped_heal_wire_time",
             "heal": heal,
             "links": links_summary(),
+        }
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
+    if "--cold-restore" in sys.argv:
+        # `make bench-cold-restore`: the durable-store leg alone (spill,
+        # dedup, disk-striped cold restore, warm delta), with the
+        # compact tail (same last-line contract as the full run)
+        cr = bench_cold_restore()
+        result = {
+            "metric": "cold_restore_wall_time",
+            "cold_restore": cr,
         }
         print(json.dumps(result), flush=True)
         print(json.dumps(compact_summary(result)), flush=True)

@@ -37,12 +37,22 @@ Two fixtures under tests/fixtures/:
   Pinned bitwise: the per-step per-leaf parameter sums of both
   replicas AND the changed-fragment count.
 
+- ``cold_restore.json`` (ISSUE 17): a WHOLE-FLEET kill at a fixed step
+  with parameter memory lost (fresh zeros on restart; only
+  ``TORCHFT_STORE_DIR`` disks survive).  The fleet cold-restores the
+  newest spilled cut through the striped fragment plane and resumes —
+  the committed per-step parameter history, pre-kill AND post-restore,
+  is pinned bitwise.  Any drift in spill timing (post-optimizer
+  snapshot), cut selection, or the disk-backed striped reassembly moves
+  the fixture.
+
 Regenerate (after an *intentional* semantics change) with:
     TORCHFT_TPU_REGEN_FIXTURES=1 python -m pytest tests/test_golden_fixtures.py
 """
 
 import json
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
@@ -406,6 +416,142 @@ class TestDeltaHealGolden:
             },
         }
         _check_or_regen(FIXTURES / "delta_heal.json", produced)
+
+
+# ---------------------------------------------------------------------------
+# whole-fleet cold restore (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+CR_KILL_STEP = 2
+CR_TOTAL_STEPS = 5
+
+
+def _cold_restore_replica(
+    replica_id: int,
+    lighthouse_addr: str,
+    restart_barrier: "threading.Barrier",
+) -> "list":
+    """Deterministic momentum-SGD replica for the cold-restore golden.
+    A ``train.step`` fault is a process DEATH: parameters restart as
+    fresh zeros — only the durable store survives.  The barrier holds
+    every replica down until the whole fleet has crashed (and flushed
+    its final spill in shutdown), so the restart is a true whole-fleet
+    cold start, not a rolling restart that would live-heal."""
+    history: "list" = []
+    for _attempt in range(3):
+        params = {"w": np.zeros(4, dtype=np.float32)}
+        momentum = {"w": np.zeros(4, dtype=np.float32)}
+
+        def load_state_dict(sd):
+            params["w"] = np.array(sd["params"]["w"])
+            momentum["w"] = np.array(sd["momentum"]["w"])
+
+        def state_dict():
+            return {
+                "params": {"w": params["w"].copy()},
+                "momentum": {"w": momentum["w"].copy()},
+            }
+
+        manager = Manager(
+            pg=ProcessGroupTCP(timeout=10.0),
+            min_replica_size=2,
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            lighthouse_addr=lighthouse_addr,
+            replica_id=f"golden_cr_{replica_id}",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=20.0,
+        )
+        try:
+            while manager.current_step() < CR_TOTAL_STEPS:
+                faults.check(
+                    "train.step",
+                    replica=f"golden_cr_{replica_id}",
+                    step=manager.current_step(),
+                )
+                manager.start_quorum()
+                # post-quorum read: the cold restore advances the step
+                # inside start_quorum, and the per-step pseudo-gradient
+                # must follow the restored step for the history to align
+                # with an uninterrupted run
+                step = manager.current_step()
+                grads = {
+                    "w": np.full(4, float(step + 1), dtype=np.float32)
+                    * (1.0 + 0.5 * replica_id)
+                }
+                avg = manager.allreduce(grads).wait(timeout=30)
+                if manager.should_commit():
+                    momentum["w"] = 0.9 * momentum["w"] + avg["w"]
+                    params["w"] = params["w"] - np.float32(0.1) * momentum["w"]
+                    history.append(
+                        {
+                            "step": manager.current_step(),
+                            "w": [float(x) for x in params["w"]],
+                            "momentum": [float(x) for x in momentum["w"]],
+                        }
+                    )
+            return history
+        except InjectedFault:
+            restart_barrier.wait(timeout=60)
+            continue  # whole-fleet outage: restart with memory LOST
+        finally:
+            manager.shutdown()
+    raise RuntimeError(f"replica {replica_id} exhausted attempts")
+
+
+class TestColdRestoreGolden:
+    def test_fleet_kill_cold_restore_history_matches_fixture(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TORCHFT_STORE_DIR", str(tmp_path))
+        faults.FAULTS.configure(
+            [
+                FaultRule(
+                    site="train.step",
+                    replica=f"golden_cr_{i}",
+                    step=CR_KILL_STEP,
+                )
+                for i in range(2)
+            ]
+        )
+        barrier = threading.Barrier(2)
+        server = LighthouseServer(
+            min_replicas=2, join_timeout_ms=100, heartbeat_timeout_ms=1000
+        )
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futures = [
+                    ex.submit(
+                        _cold_restore_replica, i, server.address(), barrier
+                    )
+                    for i in range(2)
+                ]
+                histories = [f.result(timeout=180) for f in futures]
+        finally:
+            server.shutdown()
+        assert faults.FAULTS.injected("train.step") == 2
+
+        # structural invariants first: the fleet resumed at the spilled
+        # step (each step committed exactly once — a fresh init would
+        # recommit 1..KILL_STEP), and both replicas end bitwise equal
+        for h in histories:
+            assert [e["step"] for e in h] == list(
+                range(1, CR_TOTAL_STEPS + 1)
+            )
+        assert histories[0][-1]["w"] == histories[1][-1]["w"]
+        assert histories[0][-1]["momentum"] == histories[1][-1]["momentum"]
+
+        produced = {
+            "kill_step": CR_KILL_STEP,
+            "total_steps": CR_TOTAL_STEPS,
+            "history": {
+                f"replica_{i}": h for i, h in enumerate(histories)
+            },
+        }
+        _check_or_regen(FIXTURES / "cold_restore.json", produced)
 
 
 HIER_WORLD = 4

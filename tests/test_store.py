@@ -1,0 +1,422 @@
+"""Durable content-addressed fragment store (ISSUE 17) — unit layer.
+
+FragmentStore invariants: bitwise spill/load round-trip, digest dedup
+across versions, atomic manifests (no torn files under the final name),
+torn blobs detected at read and treated as missing (never served, never
+silently wrong), the TORCHFT_STORE_VERSIONS retirement window with
+refcount-by-scan blob GC, deterministic fleet-wide cut selection
+(newest complete consistent cut, degrade-never-wedge), the HTTP
+``/store/versions`` + disk-backed ``frag_<name>`` surface, and the
+single-worker StoreSpiller that keeps spill off the training hot path
+and degrades (skip + count) on failure.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import fragments as frags
+from torchft_tpu.checkpointing import serialization as ser
+from torchft_tpu.checkpointing import store as store_mod
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.store import (
+    FragmentStore,
+    StoreSpiller,
+    cut_id,
+    select_cut,
+    store_from_env,
+)
+from torchft_tpu.utils import faults
+from torchft_tpu.utils import metrics as _metrics
+from torchft_tpu.utils.faults import FaultRule
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.FAULTS.configure([], seed=0)
+    yield
+    faults.FAULTS.configure([])
+
+
+def make_state(leaves: int = 8, seed: int = 7) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "user": {
+            f"w{i}": rng.standard_normal(129).astype(np.float32)
+            for i in range(leaves)
+        },
+        "torchft": {"step": 1, "batches_committed": 1},
+    }
+
+
+def assert_state_equal(a: dict, b: dict) -> None:
+    assert a["torchft"] == b["torchft"]
+    assert set(a["user"]) == set(b["user"])
+    for k in a["user"]:
+        np.testing.assert_array_equal(a["user"][k], b["user"][k])
+
+
+def blob_names(store: FragmentStore) -> set:
+    return set(os.listdir(os.path.join(store.directory, "blobs")))
+
+
+class TestFragmentStore:
+    def test_spill_load_round_trip_bitwise(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        state = make_state()
+        manifest = store.put_state(3, state, fragments=4)
+        assert manifest["version"] == 3
+        assert store.versions() == [3]
+        out = store.load_state(store.manifest(3))
+        assert_state_equal(out, state)
+
+    def test_unchanged_fragments_dedup_across_versions(self, tmp_path):
+        """Content addressing: re-spilling identical state writes zero
+        new blob bytes; a one-leaf change writes exactly the changed
+        fragment's blob."""
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        state = make_state()
+        store.put_state(1, state, fragments=4)
+        before = blob_names(store)
+        spilled = _metrics.STORE_SPILL_BYTES.get()
+        store.put_state(2, state, fragments=4)
+        assert blob_names(store) == before
+        assert _metrics.STORE_SPILL_BYTES.get() == spilled
+        # one changed leaf -> exactly one new blob
+        state["user"]["w0"][:] = -1.0
+        store.put_state(3, state, fragments=4)
+        assert len(blob_names(store)) == len(before) + 1
+        assert _metrics.STORE_SPILL_BYTES.get() > spilled
+
+    def test_no_tmp_files_survive_a_spill(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        store.put_state(1, make_state(), fragments=4)
+        leftovers = [
+            n
+            for root, _d, names in os.walk(str(tmp_path))
+            for n in names
+            if ".tmp" in n
+        ]
+        assert leftovers == []
+
+    def test_torn_blob_is_missing_never_served(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        state = make_state()
+        manifest = store.put_state(1, state, fragments=4)
+        name = manifest["fragments"][1]
+        digest = manifest["digests"][name]
+        torn_before = _metrics.STORE_TORN_BLOBS.get()
+        with open(store.blob_path(digest), "r+b") as f:
+            f.seek(8)
+            f.write(b"\xff\xff\xff\xff")
+        assert store.read_blob(digest) is None
+        assert store.fragment(1, name) is None
+        assert _metrics.STORE_TORN_BLOBS.get() > torn_before
+        # loud, never silently wrong weights
+        with pytest.raises(ValueError, match="digest"):
+            store.load_state(store.manifest(1))
+        # the catalog reports the hole so cut selection can fail over
+        cat = store.catalog()
+        assert not cat[1]["complete"]
+        assert name not in cat[1]["frags_ok"]
+
+    def test_version_window_retires_and_gcs_blobs(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=2)
+        for v in range(1, 5):
+            state = make_state(seed=v)
+            store.put_state(v, state, fragments=4)
+        assert store.versions() == [3, 4]
+        # every surviving blob is referenced by a surviving manifest
+        referenced = set()
+        for v in store.versions():
+            referenced.update(store.manifest(v)["digests"].values())
+        assert blob_names(store) == referenced
+        assert _metrics.STORE_VERSIONS.get() == 2
+
+    def test_torn_manifest_is_not_a_restorable_version(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        store.put_state(1, make_state(), fragments=4)
+        path = os.path.join(str(tmp_path), "manifest_v1.tft")
+        with open(path, "wb") as f:
+            f.write(b"garbage")
+        assert store.manifest(1) is None
+        assert store.manifest_bytes(1) is None
+        assert store.catalog() == {}
+
+    def test_store_from_env_is_opt_in(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TORCHFT_STORE_DIR", raising=False)
+        assert store_from_env("r0") is None
+        monkeypatch.setenv("TORCHFT_STORE_DIR", str(tmp_path))
+        s0 = store_from_env("r0")
+        s1 = store_from_env("r0", group_rank=1)
+        assert s0.directory != s1.directory
+        assert s0.directory.startswith(str(tmp_path))
+
+
+class TestSelectCut:
+    def _catalog(self, store: FragmentStore) -> dict:
+        return store.catalog()
+
+    def test_newest_complete_cut_wins(self, tmp_path):
+        a = FragmentStore(str(tmp_path / "a"), max_versions=0)
+        b = FragmentStore(str(tmp_path / "b"), max_versions=0)
+        state = make_state()
+        for s in (a, b):
+            s.put_state(1, state, fragments=4)
+            s.put_state(2, state, fragments=4)
+        got = select_cut({"http://a": a.catalog(), "http://b": b.catalog()})
+        assert got is not None
+        version, bases = got
+        assert version == 2
+        assert sorted(bases) == ["http://a", "http://b"]
+
+    def test_incomplete_newest_degrades_to_older_complete(self, tmp_path):
+        """v2 torn on EVERY disk -> the fleet restores v1, never wedges
+        and never splices v1 blobs into the v2 cut."""
+        a = FragmentStore(str(tmp_path / "a"), max_versions=0)
+        state = make_state()
+        a.put_state(1, state, fragments=4)
+        state["user"]["w0"][:] = 5.0
+        m2 = a.put_state(2, state, fragments=4)
+        # tear v2's changed fragment (its only non-shared blob)
+        changed = [
+            n for n in m2["fragments"]
+            if m2["digests"][n] not in a.manifest(1)["digests"].values()
+        ]
+        for n in changed:
+            with open(a.blob_path(m2["digests"][n]), "r+b") as f:
+                f.seek(0)
+                f.write(b"\x00\x00\x00\x00\xff")
+        got = select_cut({"http://a": a.catalog()})
+        assert got is not None
+        assert got[0] == 1
+
+    def test_union_coverage_across_disks_restores_newest(self, tmp_path):
+        """Each disk is torn on a DIFFERENT fragment of the same cut:
+        neither alone is complete, their union is — the striped restore
+        can fail over per-fragment, so the cut is selectable."""
+        a = FragmentStore(str(tmp_path / "a"), max_versions=0)
+        b = FragmentStore(str(tmp_path / "b"), max_versions=0)
+        state = make_state()
+        ma = a.put_state(1, state, fragments=4)
+        mb = b.put_state(1, state, fragments=4)
+        assert cut_id(ma) == cut_id(mb)
+        for s, m, idx in ((a, ma, 0), (b, mb, 1)):
+            name = m["fragments"][idx]
+            with open(s.blob_path(m["digests"][name]), "r+b") as f:
+                f.seek(4)
+                f.write(b"\xde\xad\xbe\xef")
+        got = select_cut({"http://a": a.catalog(), "http://b": b.catalog()})
+        assert got is not None
+        version, bases = got
+        assert version == 1 and len(bases) == 2
+
+    def test_complete_disks_order_first(self, tmp_path):
+        a = FragmentStore(str(tmp_path / "a"), max_versions=0)
+        b = FragmentStore(str(tmp_path / "b"), max_versions=0)
+        state = make_state()
+        ma = a.put_state(1, state, fragments=4)
+        b.put_state(1, state, fragments=4)
+        name = ma["fragments"][0]
+        with open(a.blob_path(ma["digests"][name]), "r+b") as f:
+            f.seek(4)
+            f.write(b"\xde\xad\xbe\xef")
+        _v, bases = select_cut(
+            {"http://a": a.catalog(), "http://b": b.catalog()}
+        )
+        assert bases[0] == "http://b"  # the complete disk is primary
+
+    def test_nothing_restorable_returns_none(self, tmp_path):
+        empty = FragmentStore(str(tmp_path), max_versions=0)
+        assert select_cut({}) is None
+        assert select_cut({"http://a": empty.catalog()}) is None
+
+    def test_selection_is_deterministic(self, tmp_path):
+        a = FragmentStore(str(tmp_path / "a"), max_versions=0)
+        b = FragmentStore(str(tmp_path / "b"), max_versions=0)
+        state = make_state()
+        a.put_state(1, state, fragments=4)
+        b.put_state(1, state, fragments=4)
+        cats = {"http://b": b.catalog(), "http://a": a.catalog()}
+        assert select_cut(cats) == select_cut(dict(reversed(cats.items())))
+
+
+class TestStoreHTTPSurface:
+    def test_catalog_and_fragments_served_from_disk(self, tmp_path):
+        """A transport with NO RAM staging serves manifests + fragments
+        straight off the attached store — the cold-start surface."""
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        state = make_state()
+        manifest = store.put_state(7, state, fragments=4)
+        t = HTTPTransport(timeout=5.0)
+        t.attach_store(store)
+        try:
+            base = t.metadata()
+            with urllib.request.urlopen(f"{base}/store/versions", timeout=5) as r:
+                cat = json.loads(r.read().decode())
+            assert cat["7"]["complete"] is True
+            raw = frags.fetch_raw(
+                base, 7, f"frag_{frags.MANIFEST_FRAG}", timeout=5.0,
+                role="heal",
+            )
+            served = frags.decode_manifest(raw)
+            assert served["digests"] == manifest["digests"]
+            name = manifest["fragments"][0]
+            raw = frags.fetch_raw(base, 7, f"frag_{name}", timeout=5.0,
+                                  role="heal")
+            frags.verify_fragment(name, raw, manifest)  # raises on mismatch
+        finally:
+            t.shutdown()
+
+    def test_torn_blob_on_disk_is_a_permanent_404(self, tmp_path):
+        """A torn blob must read as MISSING over HTTP (404 -> striped
+        failover), never as bytes."""
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        manifest = store.put_state(7, make_state(), fragments=4)
+        name = manifest["fragments"][2]
+        with open(store.blob_path(manifest["digests"][name]), "r+b") as f:
+            f.seek(4)
+            f.write(b"\xde\xad\xbe\xef")
+        t = HTTPTransport(timeout=5.0)
+        t.attach_store(store)
+        try:
+            base = t.metadata()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/checkpoint/7/frag_{name}", timeout=5
+                )
+            assert ei.value.code == 404
+        finally:
+            t.shutdown()
+
+    def test_no_store_no_catalog(self):
+        t = HTTPTransport(timeout=5.0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{t.metadata()}/store/versions", timeout=5
+                )
+            assert ei.value.code == 404
+        finally:
+            t.shutdown()
+
+
+class TestStoreSpiller:
+    def test_spill_happens_off_the_submitting_thread(self, tmp_path):
+        """Hot-path budget: submit() returns immediately even when the
+        disk write is slow (a scheduled delay on store.spill), and the
+        spill completes in the background."""
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        spiller = StoreSpiller(store)
+        faults.FAULTS.configure(
+            [FaultRule(site="store.spill", action="delay", delay=0.5,
+                       times=1)],
+            seed=1,
+        )
+        try:
+            t0 = time.perf_counter()
+            assert spiller.submit(1, make_state(), fragments=4)
+            submit_cost = time.perf_counter() - t0
+            assert submit_cost < 0.2, (
+                f"submit blocked the training thread for {submit_cost:.3f}s"
+            )
+            spiller.flush(timeout=10)
+            assert store.versions() == [1]
+        finally:
+            spiller.shutdown()
+
+    def test_spill_failure_degrades_skip_and_count(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        spiller = StoreSpiller(store)
+        failures = _metrics.STORE_SPILL_FAILURES.get()
+        faults.FAULTS.configure(
+            [FaultRule(site="store.spill", action="raise", times=1)],
+            seed=2,
+        )
+        try:
+            assert spiller.submit(1, make_state(), fragments=4)
+            spiller.flush(timeout=10)  # never raises into the caller
+            assert store.versions() == []  # version skipped, not torn
+            assert _metrics.STORE_SPILL_FAILURES.get() == failures + 1
+            # the next spill succeeds: degraded, not wedged
+            assert spiller.submit(2, make_state(), fragments=4)
+            spiller.flush(timeout=10)
+            assert store.versions() == [2]
+        finally:
+            spiller.shutdown()
+
+    def test_inflight_spill_skips_not_backlogs(self, tmp_path):
+        store = FragmentStore(str(tmp_path), max_versions=0)
+        spiller = StoreSpiller(store)
+        gate = threading.Event()
+        orig = store.put_state
+
+        def slow_put(version, state_dict, fragments=None, **kw):
+            gate.wait(timeout=10)
+            return orig(version, state_dict, fragments, **kw)
+
+        store.put_state = slow_put
+        try:
+            assert spiller.submit(1, make_state(), fragments=4)
+            assert not spiller.submit(2, make_state(), fragments=4)
+            gate.set()
+            spiller.flush(timeout=10)
+            assert store.versions() == [1]
+        finally:
+            gate.set()
+            spiller.shutdown()
+
+    def test_submit_after_shutdown_is_refused(self, tmp_path):
+        spiller = StoreSpiller(FragmentStore(str(tmp_path), max_versions=0))
+        spiller.shutdown()
+        assert not spiller.submit(1, make_state(), fragments=4)
+
+
+class TestDurableOnStore:
+    """Satellite 1/2: durable.py rides the content-addressed store —
+    same API, deduped blobs, and the no-integrity-check bug fixed."""
+
+    def test_saved_checkpoints_dedup_unchanged_fragments(self, tmp_path):
+        from torchft_tpu.checkpointing import save_checkpoint
+
+        state = make_state()
+        save_checkpoint(str(tmp_path), 1, state)
+        blobs = set(os.listdir(str(tmp_path / "blobs")))
+        save_checkpoint(str(tmp_path), 2, state)
+        assert set(os.listdir(str(tmp_path / "blobs"))) == blobs
+
+    def test_corrupt_blob_fails_loudly_on_load(self, tmp_path):
+        """Regression for the no-integrity-check bug: flipped bits in a
+        checkpoint blob must raise, never load silently wrong weights."""
+        from torchft_tpu.checkpointing import (
+            latest_checkpoint,
+            save_checkpoint,
+            load_checkpoint,
+        )
+
+        state = make_state()
+        save_checkpoint(str(tmp_path), 3, state)
+        blob_dir = str(tmp_path / "blobs")
+        victim = sorted(os.listdir(blob_dir))[0]
+        with open(os.path.join(blob_dir, victim), "r+b") as f:
+            f.seek(8)
+            f.write(b"\xff\x00\xff\x00")
+        with pytest.raises(ValueError, match="digest"):
+            load_checkpoint(latest_checkpoint(str(tmp_path)))
+
+    def test_legacy_whole_payload_checkpoints_still_load(self, tmp_path):
+        """Read-only fallback: pre-store ``.tft`` files (one serialized
+        state dict, no manifest) keep loading."""
+        from torchft_tpu.checkpointing import load_checkpoint
+
+        state = make_state()
+        path = str(tmp_path / "ckpt_step4.tft")
+        with open(path, "wb") as f:
+            f.write(bytes(memoryview(ser.serialize(state))))
+        assert_state_equal(load_checkpoint(path), state)

@@ -102,6 +102,7 @@ class TestMutationGate:
                 or scenario in mc.SCENARIOS
                 or scenario in mc.RESIZE_SCENARIOS
                 or scenario in mc.ELECTION_SCENARIOS
+                or scenario in mc.RESTORE_SCENARIOS
             )
 
     def test_every_invariant_is_exercised_by_a_mutation(self):
@@ -242,6 +243,65 @@ class TestElectionSubModel:
         assert len(errs) == 1
         # the violating phase renders in the Manager's vocabulary
         assert errs[0]["op"] == "quorum_rpc"
+
+
+class TestRestoreSubModel:
+    """ISSUE 17: the durable-store cold-restore scenario — the fleet-wide
+    cut selection must be complete (digest-valid bytes for every
+    fragment), version-consistent (one outer sync, never a cross-version
+    splice) and newest-first, proven over every per-disk spill order,
+    one bit-rot and the whole-fleet crash, with both seeded restore bugs
+    provably caught by their named invariants."""
+
+    def test_clean_restore_space_reaches_restores(self):
+        r = mc.explore_restore(mc.RESTORE_SCENARIOS["restore"])
+        assert r.ok, f"restore scenario violated: {r.violation}"
+        # non-vacuous: the bounded space contains completed restores
+        assert r.goal_states > 0
+
+    def test_exploration_is_deterministic(self):
+        a = mc.explore_restore(mc.RESTORE_SCENARIOS["restore"])
+        b = mc.explore_restore(mc.RESTORE_SCENARIOS["restore"])
+        assert (a.states, a.transitions, a.goal_states) == (
+            b.states, b.transitions, b.goal_states
+        )
+
+    def test_space_contains_torn_blobs_and_partial_spills(self):
+        """The clean space must exercise the failure shapes the
+        invariants guard against: a rot budget (torn blobs exist) and a
+        mid-spill crash (incomplete newest versions exist) — else
+        restore-cut-complete/-consistent would be vacuously true."""
+        cfg = mc.RESTORE_SCENARIOS["restore"]
+        assert cfg.rot_budget >= 1
+        assert cfg.n_versions >= 2 and cfg.n_fragments >= 2
+
+    def test_serve_torn_blob_is_caught(self):
+        r = mc.explore_restore(
+            mc.RESTORE_SCENARIOS["restore"],
+            mutations=frozenset({"serve_torn_blob"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "restore-cut-complete"
+
+    def test_mix_versions_in_cut_is_caught(self):
+        r = mc.explore_restore(
+            mc.RESTORE_SCENARIOS["restore"],
+            mutations=frozenset({"mix_versions_in_cut"}),
+        )
+        assert not r.ok
+        assert r.violation.invariant == "restore-cut-consistent"
+
+    def test_counterexample_renders_as_flight_dump(self, tmp_path):
+        r = mc.check_mutation("serve_torn_blob")
+        assert not r.ok and r.trace
+        path = str(tmp_path / "restore_cex.jsonl")
+        mc.write_flight_dump(r, path)
+        lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert lines[0]["flight"] == "meta"
+        errs = [rec for rec in lines[1:] if rec["status"] == "error"]
+        assert len(errs) == 1
+        # the violating phase renders in the Manager's vocabulary
+        assert errs[0]["op"] == "heal_recv"
 
 
 class TestDiagnoseRoundTrip:

@@ -38,6 +38,7 @@ from torchft_tpu.analysis.protocol_model import (
     ElectionConfig,
     ModelConfig,
     ResizeConfig,
+    RestoreConfig,
     State,
     Transition,
     Violation,
@@ -57,6 +58,11 @@ from torchft_tpu.analysis.protocol_model import (
     resize_enabled,
     resize_initial,
     resize_is_goal,
+    restore_apply,
+    restore_check,
+    restore_enabled,
+    restore_initial,
+    restore_is_goal,
     vote_apply,
     vote_check,
     vote_enabled,
@@ -69,10 +75,12 @@ __all__ = [
     "explore_votes",
     "explore_resize",
     "explore_election",
+    "explore_restore",
     "run_schedule",
     "SCENARIOS",
     "RESIZE_SCENARIOS",
     "ELECTION_SCENARIOS",
+    "RESTORE_SCENARIOS",
     "LIVENESS_SCHEDULES",
     "trace_to_flight_dump",
     "write_flight_dump",
@@ -319,6 +327,54 @@ def explore_election(
     return CheckResult(True, len(seen), transitions, goal, None, ())
 
 
+def explore_restore(
+    cfg: "RestoreConfig" = RestoreConfig(),
+    mutations: "FrozenSet[str]" = frozenset(),
+    max_states: int = 400_000,
+) -> CheckResult:
+    """Exhaustive exploration of the durable-store cold-restore sub-model:
+    per-disk spill orders (blobs before manifest), bounded bit-rot,
+    whole-fleet crash, and the fleet-wide cut selection a cold start must
+    keep complete, version-consistent, and newest-first."""
+    init = restore_initial(cfg)
+    seen = {init}
+    transitions = 0
+    goal = 0
+    stack = [(init, restore_enabled(cfg, init, mutations), 0)]
+    path: "List[Tuple[str, int, str, int, int]]" = []
+    while stack:
+        st, ts, idx = stack[-1]
+        if idx >= len(ts):
+            stack.pop()
+            if path:
+                path.pop()
+            continue
+        stack[-1] = (st, ts, idx + 1)
+        t = ts[idx]
+        nxt = restore_apply(cfg, st, t, mutations)
+        transitions += 1
+        if nxt in seen:
+            continue
+        seen.add(nxt)
+        op, i = t
+        rid = "fleet" if i < 0 else f"disk{i}"
+        chosen = nxt.ghost.chosen if nxt.ghost is not None else -1
+        path.append((op, i, rid, max(chosen, 0), 0))
+        violations = restore_check(cfg, nxt)
+        if violations:
+            return CheckResult(
+                False, len(seen), transitions, goal, violations[0], tuple(path)
+            )
+        if restore_is_goal(cfg, nxt):
+            goal += 1
+            path.pop()
+            continue
+        if len(seen) >= max_states:
+            raise RuntimeError("restore state-space bound exceeded")
+        stack.append((nxt, restore_enabled(cfg, nxt, mutations), 0))
+    return CheckResult(True, len(seen), transitions, goal, None, ())
+
+
 # ---------------------------------------------------------------------------
 # scenarios (the bounded state spaces tier-1 proves clean)
 # ---------------------------------------------------------------------------
@@ -409,6 +465,15 @@ ELECTION_SCENARIOS: "Dict[str, ElectionConfig]" = {
     ),
 }
 
+#: durable-store cold-restore sub-model scenarios (explore_restore): two
+#: disks spilling two versions of a two-fragment cut in every order, one
+#: bit-rot, whole-fleet crash, then the cold-start cut selection.
+RESTORE_SCENARIOS: "Dict[str, RestoreConfig]" = {
+    "restore": RestoreConfig(
+        n_disks=2, n_fragments=2, n_versions=2, rot_budget=1
+    ),
+}
+
 #: scenario used to catch each mutation (the smallest space where the
 #: mutated behavior is reachable)
 MUTATION_SCENARIOS: "Dict[str, str]" = {
@@ -424,6 +489,8 @@ MUTATION_SCENARIOS: "Dict[str, str]" = {
     "reuse_epoch_after_rollback": "resize",
     "two_leaders_same_term": "election",  # coordination-plane HA sub-model
     "reuse_quorum_seq_after_takeover": "election",
+    "serve_torn_blob": "restore",  # durable-store cold-restore sub-model
+    "mix_versions_in_cut": "restore",
 }
 
 
@@ -440,6 +507,10 @@ def check_mutation(name: str) -> CheckResult:
     if scenario in ELECTION_SCENARIOS:
         return explore_election(
             ELECTION_SCENARIOS[scenario], mutations=frozenset({name})
+        )
+    if scenario in RESTORE_SCENARIOS:
+        return explore_restore(
+            RESTORE_SCENARIOS[scenario], mutations=frozenset({name})
         )
     return explore(SCENARIOS[scenario], mutations=frozenset({name}))
 

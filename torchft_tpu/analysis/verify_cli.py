@@ -88,6 +88,10 @@ def run_explore_all(verbose: bool = False) -> int:
         r = mc.explore_election(ecfg)
         _print_result(name, r, verbose)
         bad += 0 if r.ok else 1
+    for name, scfg in mc.RESTORE_SCENARIOS.items():
+        r = mc.explore_restore(scfg)
+        _print_result(name, r, verbose)
+        bad += 0 if r.ok else 1
     print(f"explored clean in {time.monotonic() - t0:.1f}s"
           if not bad else f"{bad} scenario(s) violated")
     return 1 if bad else 0
@@ -234,6 +238,8 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
             print(f"scenario {name:12s} {rcfg}")
         for name, ecfg in mc.ELECTION_SCENARIOS.items():
             print(f"scenario {name:12s} {ecfg}")
+        for name, scfg in mc.RESTORE_SCENARIOS.items():
+            print(f"scenario {name:12s} {scfg}")
         for m in MUTATIONS:
             print(f"mutation {m.name:26s} -> {m.catches}: {m.doc}")
         for name, scenario, rotation in mc.LIVENESS_SCHEDULES:
@@ -265,6 +271,10 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
             return 0 if r.ok else 1
         if args.scenario in mc.ELECTION_SCENARIOS:
             r = mc.explore_election(mc.ELECTION_SCENARIOS[args.scenario])
+            _print_result(args.scenario, r, args.verbose)
+            return 0 if r.ok else 1
+        if args.scenario in mc.RESTORE_SCENARIOS:
+            r = mc.explore_restore(mc.RESTORE_SCENARIOS[args.scenario])
             _print_result(args.scenario, r, args.verbose)
             return 0 if r.ok else 1
         if args.scenario not in mc.SCENARIOS:

@@ -6,14 +6,24 @@ from torchft_tpu.checkpointing.durable import (
 )
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.checkpointing.store import (
+    FragmentStore,
+    StoreSpiller,
+    select_cut,
+    store_from_env,
+)
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
 __all__ = [
     "CheckpointTransport",
+    "FragmentStore",
     "HTTPTransport",
     "PGTransport",
+    "StoreSpiller",
     "latest_checkpoint",
     "list_checkpoints",
     "load_checkpoint",
     "save_checkpoint",
+    "select_cut",
+    "store_from_env",
 ]

@@ -155,12 +155,94 @@ class _Handler(BaseHTTPRequestHandler):
         except BrokenPipeError:
             pass
 
+    def _send_bytes(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+
+    def _serve_store_catalog(self, transport: "HTTPTransport") -> None:
+        """``/store/versions``: this rank's durable-store restore
+        inventory (version -> cut id, fragment list, digest-valid
+        fragments) for fleet-wide cold-start cut selection."""
+        import json
+
+        store = transport._store
+        if store is None:
+            self.send_error(404, "no durable store attached")
+            return
+        try:
+            body = json.dumps(store.catalog()).encode()
+        except Exception as e:
+            self.send_error(503, f"store catalog unavailable: {e}")
+            return
+        self._send_bytes(body, "application/json")
+
+    def _serve_from_store(
+        self, transport: "HTTPTransport", step: int, what: str
+    ) -> bool:
+        """Serve a ``frag_*`` resource for a version that is NOT
+        RAM-staged from the attached durable store.  Returns True when a
+        response (200 or permanent 404) was written; False falls through
+        to the retryable 503 (the version may simply be staging late).
+
+        Called under the staged read lock — disk reads are local and
+        bounded, and the lock is writer-priority so stagers stay live.
+        """
+        from torchft_tpu.checkpointing import fragments as frags
+
+        store = transport._store
+        if store is None or not what.startswith("frag_"):
+            return False
+        name = what[len("frag_"):]
+        t0_ns = time.time_ns()
+        if name == frags.MANIFEST_FRAG:
+            body = store.manifest_bytes(step)
+            if body is None:
+                return False
+        elif name == frags.HEADER_FRAG:
+            manifest = store.manifest(step)
+            if manifest is None:
+                return False
+            body = ser.serialize(
+                {k: v for k, v in manifest.items() if k != "digests"}
+            )
+        else:
+            if store.manifest(step) is None:
+                return False
+            frag = store.fragment(step, name)
+            if frag is None:
+                # Version known but this blob is torn/missing: permanent
+                # 404 so the striped restorer fails over to another disk
+                # immediately instead of polling a hole.
+                self.send_error(404, "fragment missing or torn on disk")
+                return True
+            body = frag
+        self._send_bytes(body, "application/octet-stream")
+        _metrics.CHECKPOINT_BYTES.labels(
+            transport="http", direction="send"
+        ).inc(len(body))
+        _flightrec.record(
+            "checkpoint.http.send", start_ns=t0_ns, step=step,
+            bytes=len(body), resource=what, source="store",
+        )
+        return True
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         # request received: the idle-reap timeout must not bound the
         # serve itself (see class docstring; re-armed per request above)
         self.connection.settimeout(None)
         transport = self.server.transport  # type: ignore[attr-defined]
         parts = self.path.strip("/").split("/")
+        # /store/versions — the durable store's restore catalog (plain
+        # JSON, not a framed RPC: the wire-schema lock is untouched).
+        if parts == ["store", "versions"]:
+            self._serve_store_catalog(transport)
+            return
         # /checkpoint/{step}/{what}
         if len(parts) != 3 or parts[0] != "checkpoint":
             self.send_error(404, "unknown path")
@@ -194,6 +276,12 @@ class _Handler(BaseHTTPRequestHandler):
             with transport._staged_lock.r_lock(timeout=transport._lock_timeout):
                 staged = transport._staged.get(step)
                 if staged is None:
+                    # Not in RAM: a cold-start restorer may still be able
+                    # to serve this version from the attached durable
+                    # fragment store (blobs digest-verified at read; a
+                    # torn blob 404s so the striped fetch fails over).
+                    if self._serve_from_store(transport, step, what):
+                        return
                     # Healer raced the sender's staging: retryable 503 (the
                     # receiver polls until its deadline). Permanent problems
                     # (bad path, chunk out of range) stay 404 and fail fast.
@@ -362,6 +450,11 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._lock_timeout = timeout
         self._num_chunks = num_chunks
         self._state_dict_fn = state_dict_fn
+        # Durable fragment store (checkpointing/store.py): when attached,
+        # versions absent from RAM serve their fragments from disk —
+        # cold-start restore rides the exact same frag_* resources and
+        # striped fetch path as live heal.
+        self._store: "Optional[Any]" = None
         # Staged-slot budget: heal/reshard transports keep the default;
         # the weight-serving tier sizes it to its version window so a
         # burst of publishes cannot retire a version clients still fetch.
@@ -397,6 +490,13 @@ class HTTPTransport(CheckpointTransport[Any]):
 
     def metadata(self) -> str:
         return self._address
+
+    def attach_store(self, store: Any) -> None:
+        """Expose a durable :class:`~torchft_tpu.checkpointing.store.
+        FragmentStore` through this server: peers' cold-start restores
+        fetch ``frag_*`` resources of spilled versions (and the
+        ``/store/versions`` catalog) exactly like a live heal."""
+        self._store = store
 
     def send_checkpoint(
         self, dst_ranks: "List[int]", step: int, state_dict: Any, timeout: float

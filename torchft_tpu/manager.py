@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 import jax
 import numpy as np
 
+from torchft_tpu.checkpointing import store as fragment_store
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, StoreServer
 from torchft_tpu.parallel.process_group import ProcessGroup, REDUCE_AVG, REDUCE_SUM
@@ -377,6 +378,23 @@ class Manager:
         self._publish_executor: (
             "Optional[concurrent.futures.ThreadPoolExecutor]"
         ) = None
+        # Durable fragment store (checkpointing/store.py, ISSUE 17):
+        # opt-in via TORCHFT_STORE_DIR.  Committed steps spill to disk
+        # off the hot path (single-worker spiller) and the store is
+        # attached to the checkpoint transport so peers' cold-start
+        # restores can stripe-fetch spilled fragments from this rank's
+        # disk exactly like a live heal.
+        self._frag_store = fragment_store.store_from_env(
+            self._metric_replica_id, self._group_rank
+        )
+        self._spiller: "Optional[Any]" = None
+        self._spill_pending: "Optional[int]" = None
+        self._last_spill = 0.0
+        if self._frag_store is not None:
+            attach = getattr(self._checkpoint_transport, "attach_store", None)
+            if attach is not None:
+                attach(self._frag_store)
+            self._spiller = fragment_store.StoreSpiller(self._frag_store)
 
     @staticmethod
     def _endpoint_alive(addr: str, probe_timeout: float = 1.0) -> bool:
@@ -480,6 +498,37 @@ class Manager:
             self._publish_executor.shutdown(wait=True)
             self._publish_executor = None
 
+    def _flush_pending_spill(self, wait: bool = False) -> None:
+        """Spill the last committed step to the durable fragment store,
+        if one is pending and the ``TORCHFT_STORE_SPILL_S`` cadence has
+        elapsed (0 = every commit).  Only the snapshot runs on the
+        caller (under the state-dict read lock — the exact bytes a live
+        replica at this step holds); encode + blob writes + manifest
+        publish run on the single spill worker, and a failed spill skips
+        the version (counted), never failing or stalling training."""
+        version, self._spill_pending = self._spill_pending, None
+        spiller = self._spiller
+        if spiller is None:
+            return
+        if wait:
+            # shutdown path: drain the in-flight spill FIRST so the final
+            # committed version is accepted instead of skipped (the
+            # no-backlog rule exists to protect the training loop, which
+            # is over by now)
+            spiller.flush()
+        if version is not None:
+            interval = env_float("TORCHFT_STORE_SPILL_S", 0.0, minimum=0.0)
+            if time.monotonic() - self._last_spill >= interval:
+                try:
+                    state = self._manager_state_dict()
+                except Exception:  # noqa: BLE001 - spill never fails training
+                    self._logger.exception("store spill snapshot failed")
+                    state = None
+                if state is not None and spiller.submit(version, state):
+                    self._last_spill = time.monotonic()
+        if wait:
+            spiller.flush()
+
     def _manager_state_dict(self) -> "Dict[str, Any]":
         with self._state_dict_lock.r_lock():
             assert self._user_state_dicts, "user state_dict is not initialized"
@@ -527,6 +576,7 @@ class Manager:
         # should_commit and this call) — publish them as that step's
         # weight version before the new round begins.
         self._flush_pending_publish()
+        self._flush_pending_spill()
 
         self._errored = None
         self._healing = False
@@ -757,6 +807,24 @@ class Manager:
             )
             is True
         )
+
+        # Whole-fleet cold start (ISSUE 17): nobody in the quorum holds
+        # live state (max_step == 0) but disks might — restore the newest
+        # complete, consistent spilled cut through the striped heal path
+        # with files as stripe sources.  Every replica computes the same
+        # deterministic cut from the same fleet catalogs, so a
+        # successful restore replaces this round's live init-sync
+        # branches entirely; a failed one degrades to fresh init (and a
+        # replica whose restore failed alone re-heals live next round
+        # once its peers commit) — never a wedge.
+        if (
+            self._frag_store is not None
+            and streamed_heal
+            and self._step == 0
+            and quorum.max_step == 0
+        ):
+            if self._maybe_cold_restore(quorum):
+                return
 
         # Proactive stripe-source staging: a max-step participant can
         # tell healers exist this round (the max-step cohort is smaller
@@ -1023,6 +1091,121 @@ class Manager:
         return [
             m for m in resolved if m and m != primary_metadata
         ]
+
+    def _resolve_store_bases(self, quorum: Any, own: str) -> "List[str]":
+        """Checkpoint-transport addresses of every reachable quorum
+        participant plus our own — cold restore canvasses ALL disks
+        (everyone is at step 0, so there is no max-step cohort to
+        prefer).  Sorted + deduped so every replica that resolves the
+        same roster derives the same base list, which keeps cut
+        selection deterministic fleet-wide."""
+        addrs: "List[str]" = []
+        for p in quorum.participants:
+            if isinstance(p, dict) and p.get("address"):
+                addrs.append(p["address"])
+
+        def _resolve(addr: str) -> "Optional[str]":
+            client = ManagerClient(
+                addr, connect_timeout=self._connect_timeout
+            )
+            try:
+                return client._checkpoint_metadata(
+                    self._group_rank, timeout=self._connect_timeout
+                )
+            except Exception as e:  # noqa: BLE001 - best-effort discovery
+                self._logger.info(
+                    f"store base {addr} unresolvable ({e}); restoring "
+                    f"without its disk"
+                )
+                return None
+            finally:
+                client.close()
+
+        resolved: "List[Optional[str]]" = []
+        if addrs:
+            with ThreadPoolExecutor(
+                max_workers=min(len(addrs), 4),
+                thread_name_prefix="tft_store_resolve",
+            ) as pool:
+                resolved = list(pool.map(_resolve, addrs))
+        return sorted({m for m in resolved if m} | {own})
+
+    def _maybe_cold_restore(self, quorum: Any) -> bool:
+        """Whole-fleet cold-start restore (ISSUE 17, docs/architecture.md
+        "Durable fragment store").
+
+        Discovers spilled catalogs across every reachable disk (own +
+        peers' via ``/store/versions``), picks the newest complete,
+        consistent cut (:func:`~torchft_tpu.checkpointing.store.
+        select_cut` — deterministic, never mixes fragment versions), and
+        reassembles it via ``recv_checkpoint_striped`` with disks as
+        stripe sources: per-fragment failover across disks, delta reuse
+        of surviving local state.  Returns True when restored (state is
+        pending; the standard healing application path applies it).
+        Any failure returns False — fresh init, never a wedge."""
+        t0 = time.perf_counter()
+        try:
+            faults.check("store.restore", replica=self._replica_id, step=0)
+            own = self._checkpoint_transport.metadata()
+            bases = self._resolve_store_bases(quorum, own)
+            catalogs: "Dict[str, Any]" = {}
+            for base in bases:
+                cat = fragment_store.fetch_catalog(
+                    base, timeout=self._connect_timeout
+                )
+                if cat:
+                    catalogs[base] = cat
+            plan = fragment_store.select_cut(catalogs)
+            if plan is None:
+                return False
+            version, sources = plan
+            self._logger.info(
+                f"cold restore: selected spilled v{version} across "
+                f"{len(sources)} disk(s)"
+            )
+            self._healing = True
+            (
+                self._pending_state_dict,
+                info,
+            ) = self._checkpoint_transport.recv_checkpoint_striped(
+                sources,
+                step=version,
+                timeout=self._timeout,
+                local_state_fn=self._manager_state_dict,
+            )
+            metrics.STORE_RESTORE_BYTES.labels(
+                mode=info.get("mode", "full")
+            ).inc(int(info.get("wire_bytes") or 0))
+            self.load_state_dict(
+                cast(Dict[str, int], self._pending_state_dict["torchft"])
+            )
+            self._record_phase("heal_recv", time.perf_counter() - t0)
+            metrics.HEALS.labels(
+                replica_id=self._metric_replica_id, direction="recv"
+            ).inc()
+            log_event(
+                "heal",
+                "cold-restored from durable store",
+                job_id=env_str("JOB_ID", "unknown"),
+                replica_id=self._replica_id,
+                rank=self._group_rank,
+                quorum_id=quorum.quorum_id,
+                step=version,
+                direction="recv",
+                mode=info.get("mode", "full"),
+                stripe_sources=info.get("sources", 1),
+                changed_fragments=info.get("changed"),
+            )
+            self._logger.info(
+                f"cold-restored to step {version} from {len(sources)} "
+                f"store source(s) mode={info.get('mode')}"
+            )
+            return True
+        except Exception as e:  # noqa: BLE001 - degrade to fresh init
+            self._logger.warning(f"cold restore failed (starting fresh): {e}")
+            self._healing = False
+            self._pending_state_dict = None
+            return False
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"
@@ -1299,6 +1482,12 @@ class Manager:
             # after the user's post-commit optimizer update lands
             # (attach_weight_publisher; no-op when unattached).
             self._publish_pending = self._step
+            # Durable store: the committed step spills to disk at the
+            # NEXT round's start (same timing as publish — the user's
+            # post-commit optimizer update must land first so the
+            # spilled bytes equal what a live replica at this step
+            # holds), off the hot path on the single spill worker.
+            self._spill_pending = self._step
         else:
             self._commit_failures += 1
             if (
@@ -1535,6 +1724,12 @@ class Manager:
         # attached and the loop ended right after its commit; wait=True
         # drains the publish queue before the transports die.
         self._flush_pending_publish(wait=True)
+        # Final committed step spills too (wait=True drains the worker),
+        # so a clean shutdown leaves the newest step restorable on disk.
+        self._flush_pending_spill(wait=True)
+        if self._spiller is not None:
+            self._spiller.shutdown()
+            self._spiller = None
         legs = [
             lambda: self._checkpoint_transport.shutdown(wait=wait),
             self._client.close,

@@ -47,6 +47,11 @@ class WeightPublisher:
         max_versions: staged versions retained; a publish burst never
             retires a version inside this window while clients still
             fetch it.  Default ``TORCHFT_SERVING_VERSIONS``.
+        store: optional durable :class:`~torchft_tpu.checkpointing.
+            store.FragmentStore` — each published document's fragments
+            (already-encoded wire bytes + digest manifest) also spill to
+            disk via ``put_doc``, no re-encode; a spill failure degrades
+            (logged + counted), never failing the publish.
     """
 
     def __init__(
@@ -57,7 +62,9 @@ class WeightPublisher:
         fragments: "Optional[int]" = None,
         max_versions: "Optional[int]" = None,
         heartbeat_interval: "Optional[float]" = None,
+        store: "Optional[Any]" = None,
     ) -> None:
+        self._store = store
         self._wire = wire if wire is not None else (
             env_str("TORCHFT_SERVING_QUANT") or _payload.WIRE_F32
         )
@@ -180,6 +187,17 @@ class WeightPublisher:
             state_dict, v, wire=self._wire, fragments=self._fragments
         )
         self._transport.send_checkpoint([], v, doc, timeout=timeout)
+        # Durable spill hook: the staged document already holds every
+        # fragment's wire bytes + the digest manifest, so the spill is
+        # pure disk writes (deduped by digest) — publish() runs on the
+        # manager's single publish worker, already off the training hot
+        # path.  Failures degrade and are counted by the store.
+        if self._store is not None:
+            try:
+                self._store.put_doc(doc)
+            except Exception as e:  # noqa: BLE001 - spill never fails publish
+                _metrics.STORE_SPILL_FAILURES.inc()
+                logger.warning("durable spill of v%s failed: %s", v, e)
         # Staleness ledger: the manifest's created_ns IS the publish
         # stamp — advertised here and carried in the payload, so every
         # tier reads the same number.

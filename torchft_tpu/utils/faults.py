@@ -66,6 +66,14 @@ site                  fires in
                       distribution-tree plan epoch (``step`` = epoch)
 ``store.barrier``     blocking ``StoreClient.get(wait=True)`` (the
                       rendezvous-barrier wait PG configure relies on)
+``store.spill``       durable fragment-store spill — ``FragmentStore.
+                      put_state`` / ``put_doc`` before blobs are written
+                      (checkpointing/store.py; ``step`` = version; a
+                      failed spill skips the version, never stalls a
+                      training step)
+``store.restore``     ``Manager`` whole-fleet cold-start restore before
+                      catalog discovery (``step`` = 0; a failed restore
+                      degrades to fresh initialization, never a wedge)
 ``local_sgd.sync``    ``LocalSGD.sync`` / DiLoCo fragment sync entry
 ``train.step``        user training loops that opt in by calling
                       :func:`check` at the top of each step (the chaos
@@ -153,6 +161,8 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "serving.frag",
     "serving.tree_commit",
     "store.barrier",
+    "store.spill",
+    "store.restore",
     "local_sgd.sync",
     "train.step",
 )

@@ -964,6 +964,33 @@ HEAL_CHANGED_FRAGMENTS = gauge(
     "diff vs the rejoiner's own state); equals the fragment count on "
     "a full heal",
 )
+STORE_SPILL_BYTES = counter(
+    "torchft_store_spill_bytes_total",
+    "Fragment bytes newly written by the durable store spill path "
+    "(dedup by digest: unchanged fragments cost zero — steady-state "
+    "write amplification scales with the update delta)",
+)
+STORE_SPILL_FAILURES = counter(
+    "torchft_store_spill_failures_total",
+    "Spill attempts that failed and were skipped (the spill tier "
+    "degrades — it never raises into or stalls a training step)",
+)
+STORE_RESTORE_BYTES = counter(
+    "torchft_store_restore_bytes_total",
+    "Wire bytes fetched by whole-fleet cold restore, by mode (delta "
+    "restores reuse surviving local fragments and fetch only the diff)",
+    ("mode",),
+)
+STORE_TORN_BLOBS = counter(
+    "torchft_store_torn_blobs_total",
+    "Store blob reads that failed sha256 digest verify (torn write or "
+    "bit rot) — treated as missing so restore fails over, never served",
+)
+STORE_VERSIONS = gauge(
+    "torchft_store_versions",
+    "Durable store versions currently on this rank's disk after "
+    "retirement under the TORCHFT_STORE_VERSIONS window",
+)
 DILOCO_SYNC_SECONDS = gauge(
     "torchft_diloco_last_sync_seconds",
     "Duration of the most recent DiLoCo fragment sync (perform_sync)",
