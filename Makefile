@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore
+.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore fragments-smoke
 
 native:
 	$(MAKE) -C native
@@ -128,6 +128,16 @@ bench-cold-restore:
 # "Link-state plane").
 links-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_linkstats.py -q -m "not slow"
+
+# Fragment provenance plane round trip alone (ISSUE 18): the version
+# vector's semantics, the hop-audit ring + crash-durable .prov
+# companion dumps, heartbeat digest -> lighthouse per-(host, frag_id)
+# matrix -> /fragments.json (incl. the 64-node 16 KB byte budget and
+# per-fragment staleness consistency), and torchft-diagnose --fragment
+# naming a poisoned hop from dumps alone (docs/observability.md
+# "Fragment provenance plane").
+fragments-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_provenance.py -q -m "not slow"
 
 # WAN sweep alone: flat vs hierarchical int8 DiLoCo at simulated
 # 0/10/50 ms inter-host RTT (docs/benchmarks.md §WAN); ends with the

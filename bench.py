@@ -2007,8 +2007,10 @@ def _serving_depth_trial(
     latencies, publish-stamp staleness at leaf convergence) in seconds.
     publish->leaf = publish() call to the LEAF relay holding the
     version complete."""
+    from torchft_tpu.checkpointing import provenance as _prov
     from torchft_tpu.serving import ServingReplica, WeightPublisher
 
+    _prov.PROV.reset()  # per-trial hop ring: versions restart at 1
     lh = LighthouseServer(
         min_replicas=1, heartbeat_timeout_ms=3000, quorum_tick_ms=50,
         serving_fanout=1,
@@ -2028,6 +2030,7 @@ def _serving_depth_trial(
     full: "List[float]" = []
     delta: "List[float]" = []
     stale: "List[float]" = []
+    frag_stale: "List[float]" = []
     try:
         # wait for the full chain to form before measuring — and fail
         # LOUDLY if it never does: measuring a shallower tree would
@@ -2068,6 +2071,26 @@ def _serving_depth_trial(
             v_ms = pub.latest_version_ms()
             if v_ms > 0:
                 stale.append(max(time.time() - v_ms / 1e3, 0.0))
+            # per-FRAGMENT staleness spread (ISSUE 18): the LAST relay
+            # hold per frag id for this version is the deepest node to
+            # stage it; its ring stamp minus the manifest publish stamp
+            # is that fragment's individual publish->stage staleness
+            last_hold: "Dict[str, Dict[str, Any]]" = {}
+            for r in _prov.PROV.hop_records():
+                if (
+                    r.get("op") == "fragment.hold"
+                    and r.get("version") == v
+                    and r.get("role") == "relay"
+                ):
+                    last_hold[str(r.get("frag"))] = r
+            for r in last_hold.values():
+                if int(r.get("version_ms") or 0) > 0:
+                    frag_stale.append(
+                        max(
+                            r["end_ns"] / 1e6 - r["version_ms"], 0.0
+                        )
+                        / 1e3
+                    )
             return dt
 
         for t in range(SERVING_DEPTH_PUBLISHES + 1):
@@ -2088,7 +2111,7 @@ def _serving_depth_trial(
                 pass
         pub.shutdown()
         lh.shutdown()
-    return full, delta, stale
+    return full, delta, stale, frag_stale
 
 
 def bench_serving_depth() -> "Dict[str, Any]":
@@ -2140,8 +2163,10 @@ def bench_serving_depth() -> "Dict[str, Any]":
             _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
             leg: "Dict[str, Any]" = {}
             for depth in SERVING_DEPTHS:
-                flat_full, _, _ = _serving_depth_trial(base, depth, False)
-                stream_full, stream_delta, stream_stale = (
+                flat_full, _, _, _ = _serving_depth_trial(
+                    base, depth, False
+                )
+                stream_full, stream_delta, stream_stale, stream_fstale = (
                     _serving_depth_trial(base, depth, True)
                 )
                 f50, f99 = _pcts(flat_full)
@@ -2157,6 +2182,12 @@ def bench_serving_depth() -> "Dict[str, Any]":
                     leg[f"d{depth}"]["stream_staleness_p50_ms"] = _pcts(
                         stream_stale
                     )[0]
+                if stream_fstale:
+                    # per-fragment staleness spread (ISSUE 18): the
+                    # provenance vector's per-frag publish->stage stamps
+                    fp50, fmax = _pcts(stream_fstale)
+                    leg[f"d{depth}"]["frag_staleness_p50_ms"] = fp50
+                    leg[f"d{depth}"]["frag_staleness_max_ms"] = fmax
                 log(
                     f"serving depth d={depth} rtt={rtt}ms: flat p50 "
                     f"{f50}ms stream p50 {s50}ms delta p50 {d50}ms"
@@ -2168,6 +2199,12 @@ def bench_serving_depth() -> "Dict[str, Any]":
         out["d3_rtt50_stream_p50_ms"] = d3.get("stream_p50_ms")
         out["d3_rtt50_delta_p50_ms"] = d3.get("stream_delta_p50_ms")
         out["d3_rtt50_staleness_p50_ms"] = d3.get("stream_staleness_p50_ms")
+        out["d3_rtt50_frag_staleness_p50_ms"] = d3.get(
+            "frag_staleness_p50_ms"
+        )
+        out["d3_rtt50_frag_staleness_max_ms"] = d3.get(
+            "frag_staleness_max_ms"
+        )
         out["winner"] = (
             "stream"
             if (d3.get("stream_speedup_x") or 0) > 1.0
@@ -2732,6 +2769,16 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         )
         if sdepth.get(k) is not None
     } or None
+    # fragment-provenance headline (ISSUE 18): per-fragment staleness
+    # spread at the deepest WAN leg of the streaming-relay bench
+    fragments_compact = {
+        key: sdepth.get(src)
+        for key, src in (
+            ("stale_p50_ms", "d3_rtt50_frag_staleness_p50_ms"),
+            ("stale_max_ms", "d3_rtt50_frag_staleness_max_ms"),
+        )
+        if sdepth.get(src) is not None
+    } or None
     serving_compact = {
         k: serving.get(k)
         for k in (
@@ -2794,6 +2841,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # staleness-ledger headline (ISSUE 16): publish->leaf staleness
         # at depth 3 / 50 ms RTT from the streaming-relay leg
         "staleness": sdepth.get("d3_rtt50_staleness_p50_ms"),
+        # fragment-provenance headline (ISSUE 18): per-fragment
+        # staleness spread (p50/max) on the same leg
+        "fragments": fragments_compact,
         "wan": wan_winners,
         "wan_hops_50ms": wan_hops,
         # per-leg dominant-ledger-contributor (torchft_tpu/diagnose.py
@@ -2821,8 +2871,8 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "diloco_wire_reduction_x", "step_ms", "wan_hops_50ms",
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
-        "links", "staleness", "ha", "serving", "serving_depth", "heal",
-        "cold_restore",
+        "links", "staleness", "fragments", "ha", "serving",
+        "serving_depth", "heal", "cold_restore",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable

@@ -255,6 +255,31 @@ int tft_manager_report_links(int64_t h, const char* links_json) {
   return 0;
 }
 
+// Record a replica's bounded fragment-provenance digest (JSON: host,
+// frags[...]); the heartbeat loop piggybacks it once (consumed-on-send)
+// so the lighthouse can fold it into the fleet per-(host, frag_id)
+// version matrix (/fragments.json).  Invalid JSON is rejected here
+// rather than poisoning the heartbeat path.
+int tft_manager_report_fragments(int64_t h, const char* fragments_json) {
+  tft::RpcServer* s = find_server(h);
+  auto* manager = dynamic_cast<tft::ManagerServer*>(s);
+  if (manager == nullptr) {
+    g_last_error = "bad manager handle";
+    return -1;
+  }
+  try {
+    tft::Json fragments =
+        tft::Json::parse(fragments_json ? fragments_json : "{}");
+    if (!fragments.is_object())
+      throw std::runtime_error("fragments: not an object");
+    manager->report_fragments(fragments);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+  return 0;
+}
+
 // Pure quorum-result math, exposed for unit tests: input/output JSON.
 char* tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
                                  const char* quorum_json, int init_sync) {

@@ -756,6 +756,10 @@ Json LighthouseServer::handle(const std::string& method, const Json& params,
   if (method == "links")
     return links_json(params.get("page").as_int(-1),
                       params.get("per_page").as_int(0));
+  // Fleet fragment-version matrix: same document as GET /fragments.json.
+  if (method == "fragments")
+    return fragments_json(params.get("page").as_int(-1),
+                          params.get("per_page").as_int(0));
   throw std::runtime_error("lighthouse: unknown method " + method);
 }
 
@@ -956,6 +960,11 @@ Json LighthouseServer::rpc_heartbeat(const Json& params) {
   // folds into the fleet host-pair matrix served at /links.json.
   const Json& links = params.get("links");
   if (links.is_object()) note_links_locked(links, now);
+  // Fragment-provenance piggyback (optional): the replica's bounded
+  // version-vector digest folds into the fleet fragment matrix served
+  // at /fragments.json.
+  const Json& fragments = params.get("fragments");
+  if (fragments.is_object()) note_fragments_locked(fragments, now);
   return out;
 }
 
@@ -1039,6 +1048,11 @@ Json LighthouseServer::rpc_serving_heartbeat(const Json& params) {
       it->second.role != m.role || it->second.capacity != m.capacity;
   serving_[m.replica_id] = m;
   if (shape_changed) bump_serving_epoch_locked();
+  // Fragment-provenance piggyback (optional): serving members (relays,
+  // publishers) carry the same digest managers do, so the fleet matrix
+  // sees every holder regardless of which heartbeat plane it rides.
+  const Json& fragments = params.get("fragments");
+  if (fragments.is_object()) note_fragments_locked(fragments, now);
   Json out = Json::object();
   out["plan_epoch"] = serving_epoch_;
   out["latest_version"] = serving_latest_version_locked();
@@ -1101,7 +1115,13 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
   }
   Json nodes = Json::array();
   int64_t max_depth = 0;
+  int64_t staleness_unknown = 0;
   const int64_t latest_ms = serving_latest_version_ms_locked();
+  // Worst-K stalest serving nodes: ranked over KNOWN stamps only — an
+  // unknown stamp (-1) is "no data", not "infinitely stale"; mixing it
+  // into the ranking would either hide it (sorted last) or fake a
+  // number.  Unknown nodes are counted distinctly instead.
+  std::vector<std::pair<int64_t, size_t>> ranked;
   for (size_t i = 0; i < servers.size(); ++i) {
     Json n = Json::object();
     n["replica_id"] = servers[i]->replica_id;
@@ -1115,12 +1135,37 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
     // not yet reported a stamped version).  Both stamps are minted by
     // publishers, so the difference is skew-free across hosts.
     n["version_ms"] = servers[i]->version_ms;
-    n["staleness_ms"] =
-        (latest_ms > 0 && servers[i]->version_ms > 0)
-            ? std::max<int64_t>(latest_ms - servers[i]->version_ms, 0)
-            : -1;
+    bool known = latest_ms > 0 && servers[i]->version_ms > 0;
+    int64_t stale_ms =
+        known ? std::max<int64_t>(latest_ms - servers[i]->version_ms, 0)
+              : -1;
+    n["staleness_ms"] = stale_ms;
+    // Renderer contract: "is -1 unknown or a value?" must not be an
+    // inline sentinel test at every consumer — the flag names it.
+    n["staleness_known"] = known;
+    if (known)
+      ranked.emplace_back(stale_ms, i);
+    else
+      staleness_unknown += 1;
     nodes.push_back(n);
     max_depth = std::max(max_depth, depth[i]);
+  }
+  std::sort(ranked.begin(), ranked.end(),
+            [&servers](const std::pair<int64_t, size_t>& a,
+                       const std::pair<int64_t, size_t>& b) {
+              if (a.first != b.first) return a.first > b.first;
+              return servers[a.second]->replica_id <
+                     servers[b.second]->replica_id;
+            });
+  Json stalest = Json::array();
+  size_t topk = std::min<size_t>(
+      ranked.size(), static_cast<size_t>(opt_.straggler_topk));
+  for (size_t i = 0; i < topk; ++i) {
+    Json w = Json::object();
+    w["replica_id"] = servers[ranked[i].second]->replica_id;
+    w["version"] = servers[ranked[i].second]->version;
+    w["staleness_ms"] = ranked[i].first;
+    stalest.push_back(w);
   }
   Json out = Json::object();
   out["epoch"] = serving_epoch_;
@@ -1132,6 +1177,8 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
   out["publishers"] = publishers;
   out["nodes"] = nodes;
   out["depth"] = max_depth;
+  out["stalest"] = stalest;
+  out["staleness_unknown"] = staleness_unknown;
   return out;
 }
 
@@ -1259,6 +1306,42 @@ void LighthouseServer::note_links_locked(const Json& links, int64_t now) {
   // Monotone matrix version, ordered across leader failovers by the HA
   // id idiom — equal versions name an identical matrix.
   links_version_ = ha_epoch_id(term_, ++links_seq_in_term_);
+}
+
+void LighthouseServer::note_fragments_locked(const Json& fragments,
+                                             int64_t now) {
+  const std::string host = fragments.get("host").as_string();
+  if (host.empty()) return;
+  const Json& rows = fragments.get("frags");
+  if (!rows.is_array()) return;
+  // UPSERT per row — NOT the links wipe-all: a provenance digest is
+  // partial (worst-K stalest + changed-since-last-report), so a host's
+  // unchanged fragments must keep their previous rows.  Defensive row
+  // cap: the digest is bounded at the replica, but a hostile/miswired
+  // reporter must not grow the matrix unboundedly.
+  size_t n = 0;
+  for (const Json& r : rows.as_array()) {
+    if (!r.is_object() || ++n > 128) continue;
+    FragRow row;
+    row.host = host;
+    row.frag = r.get("frag").as_string();
+    if (row.frag.empty()) continue;
+    row.version = r.get("version").as_int(0);
+    row.digest8 = r.get("digest8").as_string();
+    row.version_ms = r.get("version_ms").as_int(0);
+    row.held_ms = r.get("held_ms").as_int(0);
+    row.pub = r.get("pub").as_bool(false);
+    row.updated_ms = now;
+    // Version-vector fold: a holder's newer version for a frag_id
+    // replaces its older row; a stale duplicate (an out-of-order
+    // restored digest) must not roll the matrix backwards.
+    auto it = fragments_.find({host, row.frag});
+    if (it != fragments_.end() && it->second.version > row.version)
+      continue;
+    fragments_[{host, row.frag}] = row;
+  }
+  fragments_reports_total_ += 1;
+  fragments_version_ = ha_epoch_id(term_, ++fragments_seq_in_term_);
 }
 
 void LighthouseServer::note_progress_locked(const std::string& rid,
@@ -1466,6 +1549,14 @@ void LighthouseServer::handle_http(int fd, const std::string& request_head) {
     http_reply(fd, 200, "application/json",
                links_json(query_int(query, "page", -1),
                           query_int(query, "per_page", 0))
+                   .dump());
+    return;
+  }
+  if (method == "GET" && path == "/fragments.json") {
+    // Same document as the fragments RPC: the fleet fragment matrix.
+    http_reply(fd, 200, "application/json",
+               fragments_json(query_int(query, "page", -1),
+                              query_int(query, "per_page", 0))
                    .dump());
     return;
   }
@@ -1737,6 +1828,67 @@ std::string LighthouseServer::render_metrics() {
              << escape_label(wan[i]->src_host) << "\",peer=\""
              << escape_label(wan[i]->peer) << "\",plane=\""
              << escape_label(wan[i]->plane) << "\"} " << buf << "\n";
+        }
+      }
+    }
+    // Fragment provenance plane: bounded counts plus the worst-K stalest
+    // (host, frag) rows — same cardinality discipline as the link tier.
+    {
+      std::set<std::string> frag_hosts;
+      std::map<std::string, int64_t> frag_latest;
+      for (const auto& [key, row] : fragments_) {
+        frag_hosts.insert(key.first);
+        int64_t& lm = frag_latest[key.second];
+        lm = std::max(lm, row.version_ms);
+      }
+      std::vector<std::pair<int64_t, const FragRow*>> ranked;
+      for (const auto& [key, row] : fragments_) {
+        (void)key;
+        auto lm = frag_latest.find(row.frag);
+        if (lm == frag_latest.end() || lm->second <= 0 ||
+            row.version_ms <= 0)
+          continue;  // unknown stamps are listed in the matrix, not ranked
+        ranked.emplace_back(
+            std::max<int64_t>(lm->second - row.version_ms, 0), &row);
+      }
+      std::sort(ranked.begin(), ranked.end(),
+                [](const std::pair<int64_t, const FragRow*>& a,
+                   const std::pair<int64_t, const FragRow*>& b) {
+                  return a.first > b.first;
+                });
+      os << "# HELP torchft_lighthouse_fragment_rows Fragment-matrix "
+            "rows tracked (full matrix in /fragments.json)\n"
+         << "# TYPE torchft_lighthouse_fragment_rows gauge\n"
+         << "torchft_lighthouse_fragment_rows "
+         << static_cast<int64_t>(fragments_.size()) << "\n"
+         << "# HELP torchft_lighthouse_fragment_hosts Hosts reporting "
+            "fragment digests\n"
+         << "# TYPE torchft_lighthouse_fragment_hosts gauge\n"
+         << "torchft_lighthouse_fragment_hosts "
+         << static_cast<int64_t>(frag_hosts.size()) << "\n"
+         << "# HELP torchft_lighthouse_fragment_reports_total Fragment "
+            "digests folded into the matrix\n"
+         << "# TYPE torchft_lighthouse_fragment_reports_total counter\n"
+         << "torchft_lighthouse_fragment_reports_total "
+         << fragments_reports_total_ << "\n"
+         << "# HELP torchft_lighthouse_fragment_staleness_ms_max Worst "
+            "per-fragment publish-stamp staleness across holders "
+            "(publisher-clock ms; per-row truth in /fragments.json)\n"
+         << "# TYPE torchft_lighthouse_fragment_staleness_ms_max gauge\n"
+         << "torchft_lighthouse_fragment_staleness_ms_max "
+         << (ranked.empty() ? 0 : ranked.front().first) << "\n";
+      if (!ranked.empty()) {
+        size_t k = std::min<size_t>(
+            ranked.size(), static_cast<size_t>(opt_.straggler_topk));
+        os << "# HELP torchft_lighthouse_fragment_staleness_ms "
+              "Publish-stamp staleness of the worst-K stalest "
+              "(host, frag) rows (bounded tier)\n"
+           << "# TYPE torchft_lighthouse_fragment_staleness_ms gauge\n";
+        for (size_t i = 0; i < k; ++i) {
+          os << "torchft_lighthouse_fragment_staleness_ms{host=\""
+             << escape_label(ranked[i].second->host) << "\",frag=\""
+             << escape_label(ranked[i].second->frag) << "\"} "
+             << ranked[i].first << "\n";
         }
       }
     }
@@ -2040,6 +2192,94 @@ Json LighthouseServer::links_json(int64_t page, int64_t per_page) {
   return out;
 }
 
+Json LighthouseServer::fragments_json(int64_t page, int64_t per_page) {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t now = now_ms();
+  if (per_page <= 0) per_page = opt_.status_page_size;
+  if (per_page > 100000) per_page = 100000;
+  if (page < 0) page = 0;
+  Json out = Json::object();
+  out["version"] = fragments_version_;
+  out["now_ms"] = wall_ms();
+  out["reports_total"] = fragments_reports_total_;
+  // Per-fragment freshness reference: the NEWEST publish stamp any
+  // holder reports for that frag_id.  Both stamps ride the manifest
+  // unmodified from the publisher, so the difference is skew-free —
+  // the serving staleness-ledger idiom applied per fragment.
+  std::map<std::string, int64_t> latest_ms;
+  std::set<std::string> hosts;
+  for (const auto& [key, row] : fragments_) {
+    hosts.insert(key.first);
+    int64_t& lm = latest_ms[key.second];
+    lm = std::max(lm, row.version_ms);
+  }
+  out["hosts"] = static_cast<int64_t>(hosts.size());
+  out["frags"] = static_cast<int64_t>(latest_ms.size());
+  size_t total = fragments_.size();
+  out["rows_total"] = static_cast<int64_t>(total);
+  out["page"] = page;
+  out["per_page"] = per_page;
+  out["pages"] = static_cast<int64_t>(
+      (total + static_cast<size_t>(per_page) - 1) /
+      static_cast<size_t>(per_page));
+  auto staleness_of = [&latest_ms](const FragRow& row) -> int64_t {
+    auto lm = latest_ms.find(row.frag);
+    if (lm == latest_ms.end() || lm->second <= 0 || row.version_ms <= 0)
+      return -1;  // unknown stamp: never fake freshness
+    return std::max<int64_t>(lm->second - row.version_ms, 0);
+  };
+  // Fleet truth on every page: the worst-K stalest (host, frag) rows —
+  // the bounded tier the dashboard and /metrics render; unknown-stamp
+  // rows are excluded from the ranking (they are listed, not ranked).
+  std::vector<std::pair<int64_t, const FragRow*>> ranked;
+  for (const auto& [key, row] : fragments_) {
+    (void)key;
+    int64_t s = staleness_of(row);
+    if (s >= 0) ranked.emplace_back(s, &row);
+  }
+  std::sort(ranked.begin(), ranked.end(),
+            [](const std::pair<int64_t, const FragRow*>& a,
+               const std::pair<int64_t, const FragRow*>& b) {
+              if (a.first != b.first) return a.first > b.first;
+              if (a.second->host != b.second->host)
+                return a.second->host < b.second->host;
+              return a.second->frag < b.second->frag;
+            });
+  Json stalest = Json::array();
+  size_t topk = std::min<size_t>(
+      ranked.size(), static_cast<size_t>(opt_.straggler_topk));
+  for (size_t i = 0; i < topk; ++i) {
+    Json w = Json::object();
+    w["host"] = ranked[i].second->host;
+    w["frag"] = ranked[i].second->frag;
+    w["version"] = ranked[i].second->version;
+    w["staleness_ms"] = ranked[i].first;
+    stalest.push_back(w);
+  }
+  out["stalest"] = stalest;
+  Json rows = Json::array();
+  auto [lo, hi] = page_bounds(total, page, per_page);
+  size_t i = 0;
+  for (const auto& [key, row] : fragments_) {
+    (void)key;
+    if (i >= lo && i < hi) {
+      Json r = Json::object();
+      r["host"] = row.host;
+      r["frag"] = row.frag;
+      r["version"] = row.version;
+      r["digest8"] = row.digest8;
+      r["version_ms"] = row.version_ms;
+      r["staleness_ms"] = staleness_of(row);
+      r["pub"] = row.pub;
+      r["age_ms"] = now - row.updated_ms;
+      rows.push_back(r);
+    }
+    ++i;
+  }
+  out["rows"] = rows;
+  return out;
+}
+
 std::string LighthouseServer::render_status_html(int64_t page) {
   // Parity with the reference's askama status page
   // (reference templates/status.html:1-52, src/lighthouse.rs:415-452):
@@ -2193,22 +2433,78 @@ std::string LighthouseServer::render_status_html(int64_t page) {
       os << "</table>";
     }
   }
+  if (!fragments_.empty()) {
+    // Worst-K stalest (host, frag) rows — the same bounded tier
+    // /metrics exports; the full matrix is one click away.  Staleness
+    // is publish-stamp vs the freshest stamp any holder reports for
+    // that frag (skew-free); unknown stamps are counted, not ranked.
+    std::map<std::string, int64_t> frag_latest;
+    for (const auto& [key, row] : fragments_) {
+      int64_t& lm = frag_latest[key.second];
+      lm = std::max(lm, row.version_ms);
+    }
+    std::vector<std::pair<int64_t, const FragRow*>> ranked;
+    int64_t unknown = 0;
+    for (const auto& [key, row] : fragments_) {
+      (void)key;
+      auto lm = frag_latest.find(row.frag);
+      if (lm == frag_latest.end() || lm->second <= 0 ||
+          row.version_ms <= 0) {
+        unknown += 1;
+        continue;
+      }
+      ranked.emplace_back(
+          std::max<int64_t>(lm->second - row.version_ms, 0), &row);
+    }
+    std::sort(ranked.begin(), ranked.end(),
+              [](const std::pair<int64_t, const FragRow*>& a,
+                 const std::pair<int64_t, const FragRow*>& b) {
+                return a.first > b.first;
+              });
+    size_t k = std::min<size_t>(
+        ranked.size(), static_cast<size_t>(opt_.straggler_topk));
+    os << "<h2>fragment provenance (stalest " << k << " of "
+       << fragments_.size() << " rows";
+    if (unknown > 0) os << ", " << unknown << " unknown stamp(s)";
+    os << " &middot; <a href=\"/fragments.json\">matrix</a>)</h2>";
+    if (k > 0) {
+      os << "<table><tr><th>host</th><th>frag</th><th>version</th>"
+         << "<th>digest</th><th>staleness (ms)</th><th>age (ms)</th>"
+         << "</tr>";
+      for (size_t i = 0; i < k; ++i) {
+        const FragRow* row = ranked[i].second;
+        int64_t age = now - row->updated_ms;
+        bool stale = age > 5 * opt_.heartbeat_timeout_ms;
+        os << "<tr class=\"" << (stale ? "recovering" : "healthy")
+           << "\"><td>" << row->host << "</td><td>" << row->frag
+           << "</td><td>" << row->version << "</td><td>" << row->digest8
+           << "</td><td>" << ranked[i].first << "</td><td>" << age
+           << "</td></tr>";
+      }
+      os << "</table>";
+    }
+  }
   if (!serving_.empty()) {
-    int64_t pubs = 0, srvs = 0;
+    int64_t pubs = 0, srvs = 0, unknown = 0;
     int64_t latest_ms = serving_latest_version_ms_locked();
     int64_t worst_stale = 0;
     for (const auto& [rid, m] : serving_) {
       (void)rid;
       (m.role == "publisher" ? pubs : srvs) += 1;
+      // Unknown stamps render as a distinct count — never as a fake
+      // number in the worst-staleness figure (which ranks known only).
       if (latest_ms > 0 && m.version_ms > 0)
         worst_stale = std::max(worst_stale, latest_ms - m.version_ms);
+      else if (m.role != "publisher")
+        unknown += 1;
     }
     os << "<h2>weight-serving tier</h2><p>epoch " << serving_epoch_
        << " &middot; " << pubs << " publisher(s) &middot; " << srvs
        << " server(s) &middot; latest version "
        << serving_latest_version_locked()
-       << " &middot; worst staleness " << worst_stale << "ms"
-       << " &middot; <a href=\"/serving.json\">plan</a></p>";
+       << " &middot; worst staleness " << worst_stale << "ms";
+    if (unknown > 0) os << " &middot; " << unknown << " unknown";
+    os << " &middot; <a href=\"/serving.json\">plan</a></p>";
   }
   {
     os << "<h2>pending participants (" << participants_.size()

@@ -169,6 +169,14 @@ class LighthouseServer : public RpcServer {
   // mu_ internally.  Paginated like status_json; fleet truth (version,
   // totals, worst WAN pair) is on every page.
   Json links_json(int64_t page, int64_t per_page);
+  // Fold one replica's fragment-provenance digest ({"host", "frags"})
+  // into the fleet per-(host, frag_id) version matrix (caller holds
+  // mu_).  UPSERT per row — digests are partial by design.
+  void note_fragments_locked(const Json& fragments, int64_t now);
+  // The fleet fragment-version matrix (the "fragments" RPC and GET
+  // /fragments.json); locks mu_ internally.  Paginated like links_json;
+  // fleet truth (version, totals, worst-K stalest rows) on every page.
+  Json fragments_json(int64_t page, int64_t per_page);
   std::string render_status_html(int64_t page);
   std::string render_metrics();
 
@@ -254,6 +262,26 @@ class LighthouseServer : public RpcServer {
     double rtt_p99_ms = 0.0;  // first-byte p99
     int64_t samples = 0;
     int64_t bytes = 0;
+    int64_t updated_ms = 0;  // lighthouse clock at last report
+  };
+
+  // One fleet fragment-version-matrix row, keyed (holder host, frag_id)
+  // — the heartbeat-piggybacked provenance digests
+  // (checkpointing/provenance.py maybe_digest) land here with per-row
+  // UPSERT (a digest is PARTIAL: worst-K stalest + changed-since-last,
+  // so replacing all of a host's rows would forget fragments that
+  // simply didn't change).  version_ms is the PUBLISH wall-stamp of the
+  // held version, minted on the publisher's clock and carried
+  // unmodified by every holder, so staleness (freshest stamp for the
+  // frag minus this row's stamp) is skew-free.
+  struct FragRow {
+    std::string host;
+    std::string frag;     // "<payload>/<layout index>", e.g. "weights/0"
+    int64_t version = 0;
+    std::string digest8;  // first 8 hex chars of the fragment sha256
+    int64_t version_ms = 0;  // publish stamp (publisher clock; 0=unknown)
+    int64_t held_ms = 0;     // holder clock: when the hold was recorded
+    bool pub = false;        // reported by the publishing process itself
     int64_t updated_ms = 0;  // lighthouse clock at last report
   };
 
@@ -386,6 +414,15 @@ class LighthouseServer : public RpcServer {
   int64_t links_version_ = 0;
   int64_t links_seq_in_term_ = 0;
   int64_t links_reports_total_ = 0;
+  // Fleet fragment-version matrix keyed (holder host, frag_id).  Rows
+  // upsert (digests are partial) and age in place when a host stops
+  // reporting; memory stays bounded by hosts x held fragments, with a
+  // per-report row cap as the hostile-reporter backstop.
+  std::map<std::pair<std::string, std::string>, FragRow> fragments_;
+  // Monotone matrix version under the same HA id idiom as links_.
+  int64_t fragments_version_ = 0;
+  int64_t fragments_seq_in_term_ = 0;
+  int64_t fragments_reports_total_ = 0;
   // Rolling cluster step-timeline, keyed by step, capped to
   // opt_.timeline_ring buckets (oldest step evicted).
   std::map<int64_t, StepBucket> timeline_;

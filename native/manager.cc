@@ -184,6 +184,11 @@ void ManagerServer::report_links(const Json& links) {
   pending_links_ = links;
 }
 
+void ManagerServer::report_fragments(const Json& fragments) {
+  std::lock_guard<std::mutex> g(mu_);
+  pending_fragments_ = fragments;
+}
+
 void ManagerServer::heartbeat_loop() {
   // Multi-endpoint failover client: with TORCHFT_LIGHTHOUSE as a comma
   // list this walks dead peers and follows NOT_LEADER redirects to the
@@ -194,6 +199,7 @@ void ManagerServer::heartbeat_loop() {
     params["replica_id"] = opt_.replica_id;
     std::optional<Json> summary;
     std::optional<Json> links;
+    std::optional<Json> fragments;
     // Piggyback training progress (straggler telemetry): once the Python
     // Manager has reported a step, every heartbeat carries it so the
     // lighthouse can compute per-replica step lag without extra RPCs.
@@ -218,6 +224,12 @@ void ManagerServer::heartbeat_loop() {
         pending_links_.reset();
         params["links"] = *links;
       }
+      // Fragment-provenance digest: same once/restore contract.
+      if (pending_fragments_.has_value()) {
+        fragments = std::move(pending_fragments_);
+        pending_fragments_.reset();
+        params["fragments"] = *fragments;
+      }
     }
     try {
       Json reply = client.call("heartbeat", params, opt_.connect_timeout_ms);
@@ -236,13 +248,15 @@ void ManagerServer::heartbeat_loop() {
     } catch (const std::exception&) {
       // Lighthouse unreachable: keep trying; quorum path surfaces errors.
       client.close();
-      if (summary.has_value() || links.has_value()) {
+      if (summary.has_value() || links.has_value() || fragments.has_value()) {
         // Undelivered digests: put them back unless newer ones arrived.
         std::lock_guard<std::mutex> g(mu_);
         if (summary.has_value() && !pending_summary_.has_value())
           pending_summary_ = std::move(summary);
         if (links.has_value() && !pending_links_.has_value())
           pending_links_ = std::move(links);
+        if (fragments.has_value() && !pending_fragments_.has_value())
+          pending_fragments_ = std::move(fragments);
       }
     }
     // interruptible sleep: stop() must not wait out a full heartbeat

@@ -103,6 +103,14 @@ class ManagerServer : public RpcServer {
   // harmless but wasteful).
   void report_links(const Json& links);
 
+  // Fragment provenance plane: record this replica's bounded fragment
+  // version-vector digest (JSON object: host, frags[...] —
+  // checkpointing/provenance.py maybe_digest).  Same consumed-on-send /
+  // restored-on-failure contract as report_links; the lighthouse folds
+  // it into the fleet per-(host, frag_id) version matrix
+  // (/fragments.json).
+  void report_fragments(const Json& fragments);
+
  protected:
   Json handle(const std::string& method, const Json& params,
               int64_t timeout_ms) override;
@@ -140,6 +148,8 @@ class ManagerServer : public RpcServer {
   std::optional<Json> pending_summary_;
   // pending link-state digest; same consumed-on-send contract (mu_)
   std::optional<Json> pending_links_;
+  // pending fragment-provenance digest; same contract (mu_)
+  std::optional<Json> pending_fragments_;
 
   std::thread heartbeat_thread_;
   // Lighthouse quorum calls run on detached threads (bounded by the request

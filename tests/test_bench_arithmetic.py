@@ -113,6 +113,18 @@ class TestCompactTailSummary:
                 "quorum_id_monotone": True, "term_advanced": True,
                 "takeover_terms": [2, 2, 2],
             },
+            "serving_depth": {
+                "payload_mb": 2.0, "fragments": 8, "publishes": 3,
+                "d3_rtt50_speedup_x": 2.1,
+                "d3_rtt50_flat_p50_ms": 980.0,
+                "d3_rtt50_stream_p50_ms": 466.0,
+                "d3_rtt50_delta_p50_ms": 120.0,
+                "d3_rtt50_staleness_p50_ms": 510.0,
+                "d3_rtt50_frag_staleness_p50_ms": 410.0,
+                "d3_rtt50_frag_staleness_max_ms": 495.0,
+                "winner": "stream",
+                "rtt_50ms": {"d3": {"flat_p50_ms": 980.0}},
+            },
         }
 
     def test_summary_under_budget_with_primary_metric(self):
@@ -142,6 +154,11 @@ class TestCompactTailSummary:
         assert parsed["ha"]["kill_to_quorum_p50_s"] == 0.81
         assert parsed["ha"]["quorum_id_monotone"] is True
         assert parsed["ha"]["term_advanced"] is True
+        # the fragment-provenance headline survives the budget
+        # (ISSUE 18): per-fragment staleness spread at depth 3 / 50 ms
+        assert parsed["fragments"]["stale_p50_ms"] == 410.0
+        assert parsed["fragments"]["stale_max_ms"] == 495.0
+        assert parsed["serving_depth"]["d3_rtt50_speedup_x"] == 2.1
 
     def test_tail_of_captured_emission_parses_to_summary(self):
         """Simulate the driver: capture full-result line + compact line,

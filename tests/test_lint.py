@@ -160,6 +160,50 @@ class TestSeededViolations:
         assert "sleep-in-loop" in capsys.readouterr().out
 
 
+class TestFragmentSpanFamily:
+    """ISSUE 18: `fragment.*` is a first-class span family — the vocab
+    pass must accept a well-formed fragment.hop emitter and still bite
+    on a near-miss family name."""
+
+    def _run(self, tmp_path, src):
+        paths = _plant(tmp_path, {"pkg/frag.py": textwrap.dedent(src)})
+        project = Project(str(tmp_path), paths)
+        lint_pass = next(p for p in PASSES if p.id == "span-vocab")
+        results = run_passes(
+            [lint_pass], project, baseline_dir=str(tmp_path / "nb")
+        )
+        return [f for r in results for f in r.findings]
+
+    def test_fragment_hop_span_with_flight_reach_is_clean(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            from torchft_tpu.utils import flightrecorder as _flightrec
+
+            def note_hop(tracer):
+                _flightrec.RECORDER.record(op="fragment.hop", status="ok")
+                tracer.export_span("fragment.hop", "t", 0, 1)
+            """,
+        )
+        assert findings == [], [f.message for f in findings]
+
+    def test_near_miss_fragment_family_is_caught(self, tmp_path):
+        findings = self._run(
+            tmp_path,
+            """
+            from torchft_tpu.utils import flightrecorder as _flightrec
+
+            def note_hop(tracer):
+                _flightrec.RECORDER.record(op="fragments.hop", status="ok")
+                tracer.export_span("fragments.hop", "t", 0, 1)
+            """,
+        )
+        assert any(
+            f.pass_id == "span-vocab" and "fragments.hop" in f.message
+            for f in findings
+        ), [f.message for f in findings]
+
+
 class TestBaselineWorkflow:
     def test_write_baseline_then_clean(self, tmp_path, capsys):
         """Grandfathering: --write-baseline makes a dirty tree pass, and

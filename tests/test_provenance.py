@@ -1,0 +1,466 @@
+"""Fragment provenance plane (ISSUE 18): the per-fragment version
+vector's semantics (newest-version-wins, dirty consume/restore, bounded
+digests), the hop-audit ring (bounded, crash-durable ``.prov`` companion
+dumps), the heartbeat-digest -> lighthouse version matrix ->
+/fragments.json aggregation round trip at fleet scale, and
+``torchft-diagnose --fragment`` rebuilding a journey from the dumps
+alone."""
+
+import json
+import urllib.request
+
+import pytest
+
+from torchft_tpu.checkpointing import provenance
+from torchft_tpu.checkpointing.provenance import PROV, frag_id
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+from torchft_tpu.utils import flightrecorder as _flightrec
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setenv("TORCHFT_FRAG_REPORT_S", "0")
+    PROV.reset()
+    yield
+    PROV.reset()
+
+
+class TestFragId:
+    def test_identity_is_payload_slash_index(self):
+        assert frag_id("weights", 3) == "weights/3"
+        assert frag_id("heal", "7") == "heal/7"
+
+
+class TestVersionVector:
+    def test_newest_version_wins_and_stale_rehold_never_regresses(self):
+        PROV.note_hold("weights/0", 5, digest="aaaa1111", version_ms=500)
+        PROV.note_hold("weights/0", 3, digest="bbbb2222", version_ms=300)
+        row = PROV.snapshot()["weights/0"]
+        assert row["version"] == 5
+        assert row["digest8"] == "aaaa1111"
+        assert row["version_ms"] == 500
+
+    def test_publisher_flag_sticks(self):
+        PROV.note_hold("weights/0", 1, publisher=True)
+        PROV.note_hold("weights/0", 2, publisher=False)
+        assert PROV.snapshot()["weights/0"]["pub"] is True
+
+    def test_digest_consumed_on_send(self):
+        # version_ms=0 keeps the row out of the always-reported
+        # worst-K-stalest tier, so the second digest must be empty
+        PROV.note_hold("weights/0", 1)
+        d = PROV.maybe_digest("h0")
+        assert d is not None and d["host"] == "h0"
+        assert [r["frag"] for r in d["frags"]] == ["weights/0"]
+        assert PROV.maybe_digest("h0") is None
+
+    def test_restore_digest_re_reports_on_next_beat(self):
+        PROV.note_hold("weights/0", 1)
+        d = PROV.maybe_digest("h0")
+        assert d is not None
+        assert PROV.maybe_digest("h0") is None
+        PROV.restore_digest(d)  # the RPC failed: hand the digest back
+        d2 = PROV.maybe_digest("h0")
+        assert d2 is not None
+        assert [r["frag"] for r in d2["frags"]] == ["weights/0"]
+
+    def test_stamped_worst_k_always_reports(self):
+        # a stamped fragment is fleet-staleness input: it re-reports
+        # every digest even with nothing dirty
+        PROV.note_hold("weights/0", 1, version_ms=1000)
+        assert PROV.maybe_digest("h0") is not None
+        assert PROV.maybe_digest("h0") is not None
+
+    def test_rate_limit_holds_back_digests(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FRAG_REPORT_S", "3600")
+        PROV.reset()
+        PROV.note_hold("weights/0", 1, version_ms=1000)
+        assert PROV.maybe_digest("h0") is not None
+        PROV.note_hold("weights/1", 1, version_ms=1000)
+        assert PROV.maybe_digest("h0") is None  # not due yet
+
+    def test_digest_is_hard_capped_at_8x_topk(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FRAG_TOPK", "4")
+        PROV.reset()
+        for i in range(200):
+            PROV.note_hold(f"weights/{i}", 1, version_ms=1000 + i)
+        d = PROV.maybe_digest("h0")
+        assert d is not None
+        assert len(d["frags"]) <= 8 * 4
+
+    def test_frag_topk_label_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FRAG_TOPK", "4")
+        PROV.reset()
+        labels = {PROV.frag_topk_label(f"weights/{i}") for i in range(32)}
+        assert "other" in labels
+        assert len(labels) <= 4 + 1  # first-K names + the fold tier
+
+
+class TestHopRing:
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FRAG_RING", "16")
+        PROV.reset()
+        for i in range(100):
+            PROV.note_hop("weights/0", i, "http://src:1", "serving")
+        assert len(PROV.hop_records()) <= 16
+
+    def test_hop_record_carries_the_audit_fields(self):
+        PROV.set_holder("me:1")
+        PROV.note_hop(
+            "weights/0", 7, "http://src:1", "heal",
+            verdict="mismatch", nbytes=4096, first_byte_ms=1.25,
+        )
+        (rec,) = PROV.hop_records()
+        assert rec["op"] == "fragment.hop"
+        assert rec["status"] == "error"  # mismatch is an error hop
+        assert rec["frag"] == "weights/0"
+        assert rec["version"] == 7
+        assert rec["source"] == "http://src:1"
+        assert rec["plane"] == "heal"
+        assert rec["verdict"] == "mismatch"
+        assert rec["bytes"] == 4096
+        assert rec["first_byte_ms"] == 1.25
+        assert rec["holder"] == "me:1"
+
+    def test_hold_records_join_the_ring(self):
+        PROV.note_hold("weights/0", 3, digest="ff00ff00", version_ms=10,
+                       role="relay")
+        (rec,) = PROV.hop_records()
+        assert rec["op"] == "fragment.hold"
+        assert rec["role"] == "relay"
+        assert rec["digest8"] == "ff00ff00"
+
+
+class TestCompanionDump:
+    def test_explicit_dump_writes_flight_format_jsonl(self, tmp_path):
+        PROV.note_hold("weights/0", 1, version_ms=10)
+        PROV.note_hop("weights/0", 1, "http://src:1", "serving")
+        out = tmp_path / "prov.jsonl"
+        assert PROV.dump("test", path=str(out)) == str(out)
+        lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert lines[0]["flight"] == "meta"
+        assert {ln["op"] for ln in lines[1:]} == {
+            "fragment.hold", "fragment.hop",
+        }
+
+    def test_process_flight_dump_cascades_to_prov(self, tmp_path,
+                                                  monkeypatch):
+        """One crash trigger freezes BOTH rings: dumping the process
+        recorder leaves <target>.prov next to <target>."""
+        PROV.note_hop("weights/0", 1, "http://src:1", "serving")
+        target = tmp_path / "flight.jsonl"
+        _flightrec.RECORDER.record("test.op")
+        assert _flightrec.RECORDER.dump("test", path=str(target))
+        prov_path = tmp_path / "flight.jsonl.prov"
+        assert prov_path.exists()
+        recs = [json.loads(ln) for ln in prov_path.read_text().splitlines()]
+        assert any(r.get("op") == "fragment.hop" for r in recs[1:])
+
+    def test_private_ring_dump_does_not_cascade(self, tmp_path):
+        priv = _flightrec.FlightRecorder(capacity=16)
+        priv.record("x")
+        target = tmp_path / "private.jsonl"
+        assert priv.dump("test", path=str(target))
+        assert not (tmp_path / "private.jsonl.prov").exists()
+
+    def test_diagnose_rebuilds_the_journey_from_the_dump_alone(
+        self, tmp_path
+    ):
+        """note_hop records -> .prov dump -> torchft-diagnose names the
+        FIRST mismatch hop's source as poisoned_hop (downstream victims
+        are not culprits)."""
+        from torchft_tpu import diagnose
+
+        PROV.note_hold("weights/2", 9, digest="deadbeef", version_ms=10,
+                       role="publisher", publisher=True)
+        PROV.note_hop("weights/2", 9, "http://pub:1", "serving",
+                      verdict="ok", nbytes=100)
+        PROV.note_hop("weights/2", 9, "http://relay:2", "serving",
+                      verdict="mismatch", nbytes=100)
+        PROV.note_hop("weights/2", 9, "http://relay:2", "serving",
+                      verdict="mismatch", nbytes=100)
+        out = tmp_path / "x.prov"
+        PROV.dump("test", path=str(out))
+        entries, _skipped = diagnose.load_records([str(out)])
+        report = diagnose.analyze_fragment(entries, "weights/2")
+        assert report["hops"] == 3 and report["holds"] == 1
+        culprit = report["culprit"]
+        assert culprit is not None
+        assert culprit["signal"] == "poisoned_hop"
+        assert culprit["replica_id"] == "http://relay:2"
+        assert diagnose.render_fragment_text(report)
+
+
+def _frag_digest(host, nfrags=16, version=3, base_ms=1_000_000):
+    return {
+        "host": host,
+        "frags": [
+            {
+                "frag": f"weights/{j}", "version": version,
+                "digest8": f"{j:08x}", "version_ms": base_ms + j,
+                "held_ms": base_ms + j,
+            }
+            for j in range(nfrags)
+        ],
+    }
+
+
+class TestFleetMatrix:
+    def test_upsert_never_wipes_unreported_rows(self):
+        """Provenance digests are PARTIAL: a later report for one frag
+        must not drop the host's other rows (unlike the links wipe-all
+        fold)."""
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                c.heartbeat("r0", fragments={"host": "h0", "frags": [
+                    {"frag": "weights/0", "version": 1,
+                     "digest8": "a" * 8, "version_ms": 100},
+                ]})
+                c.heartbeat("r0", fragments={"host": "h0", "frags": [
+                    {"frag": "weights/1", "version": 2,
+                     "digest8": "b" * 8, "version_ms": 200},
+                ]})
+                doc = c.fragments()
+                frags = {r["frag"]: r for r in doc["rows"]}
+                assert set(frags) == {"weights/0", "weights/1"}
+                assert doc["reports_total"] == 2
+            finally:
+                c.close()
+
+    def test_version_regression_is_skipped(self):
+        """A late-restored digest can arrive out of order: an older
+        version never rolls a row backwards."""
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                c.heartbeat("r0", fragments={"host": "h0", "frags": [
+                    {"frag": "weights/0", "version": 5,
+                     "digest8": "new00000", "version_ms": 500},
+                ]})
+                c.heartbeat("r0", fragments={"host": "h0", "frags": [
+                    {"frag": "weights/0", "version": 3,
+                     "digest8": "old00000", "version_ms": 300},
+                ]})
+                (row,) = c.fragments()["rows"]
+                assert row["version"] == 5
+                assert row["digest8"] == "new00000"
+            finally:
+                c.close()
+
+    def test_staleness_is_skew_free_and_unknown_is_minus_one(self):
+        """staleness = latest publish stamp for that frag minus the held
+        stamp — two stamps from ONE clock.  A missing stamp reads -1 and
+        never joins the worst-K ranking."""
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                c.heartbeat("r0", fragments={"host": "pub", "frags": [
+                    {"frag": "weights/0", "version": 4,
+                     "digest8": "d" * 8, "version_ms": 10_000, "pub": True},
+                ]})
+                c.heartbeat("r1", fragments={"host": "lag", "frags": [
+                    {"frag": "weights/0", "version": 3,
+                     "digest8": "c" * 8, "version_ms": 7_500},
+                ]})
+                c.heartbeat("r2", fragments={"host": "mystery", "frags": [
+                    {"frag": "weights/0", "version": 3,
+                     "digest8": "c" * 8, "version_ms": 0},
+                ]})
+                doc = c.fragments()
+                rows = {r["host"]: r for r in doc["rows"]}
+                assert rows["pub"]["staleness_ms"] == 0
+                assert rows["lag"]["staleness_ms"] == 2_500
+                assert rows["mystery"]["staleness_ms"] == -1
+                stale_hosts = [s["host"] for s in doc["stalest"]]
+                assert "mystery" not in stale_hosts
+                assert stale_hosts[0] == "lag"
+            finally:
+                c.close()
+
+    def test_serving_heartbeat_carries_the_digest_too(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                c.serving_heartbeat(
+                    "srv0", "http://x:1", role="server", version=2,
+                    capacity=1,
+                    fragments={"host": "sh0", "frags": [
+                        {"frag": "weights/0", "version": 2,
+                         "digest8": "e" * 8, "version_ms": 100},
+                    ]},
+                )
+                doc = c.fragments()
+                assert doc["hosts"] == 1
+                assert doc["rows"][0]["host"] == "sh0"
+            finally:
+                c.close()
+
+    def test_matrix_version_is_monotone(self):
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                c.heartbeat("r0", fragments=_frag_digest("h0", nfrags=1))
+                v1 = c.fragments()["version"]
+                c.heartbeat("r0", fragments=_frag_digest(
+                    "h0", nfrags=1, version=4))
+                assert c.fragments()["version"] > v1
+            finally:
+                c.close()
+
+    def test_http_fragments_json_bounded_at_64_nodes(self):
+        """The acceptance bar: 64 hosts x 16 fragments each — the
+        default GET /fragments.json document stays under 16 KB while
+        every held fragment's staleness is reachable by paging."""
+        with LighthouseServer(min_replicas=1, join_timeout_ms=50) as srv:
+            c = LighthouseClient(srv.address())
+            try:
+                for i in range(64):
+                    c.heartbeat(f"r{i}", fragments=_frag_digest(
+                        f"h{i:02d}", nfrags=16))
+                raw = urllib.request.urlopen(
+                    f"http://{srv.address()}/fragments.json", timeout=5
+                ).read()
+                assert len(raw) < 16 * 1024, (
+                    f"/fragments.json default page is {len(raw)} B"
+                )
+                doc = json.loads(raw.decode())
+                assert doc["rows_total"] == 64 * 16
+                assert doc["hosts"] == 64
+                assert doc["pages"] * doc["per_page"] >= 64 * 16
+                # fleet truth survives pagination: walk every page via
+                # the RPC and find a staleness verdict per held fragment
+                seen = 0
+                page, version = 0, doc["version"]
+                while True:
+                    pg = c.fragments(page=page, per_page=256)
+                    assert pg["version"] == version
+                    if not pg["rows"]:
+                        break
+                    for row in pg["rows"]:
+                        assert "staleness_ms" in row
+                        assert row["staleness_ms"] >= 0  # all stamped
+                        seen += 1
+                    page += 1
+                assert seen == 64 * 16
+            finally:
+                c.close()
+
+
+class TestPoisonedHopChaos:
+    """ISSUE 18 acceptance: inject a digest mismatch at a mid-tree
+    serving relay (and a torn durable-store blob) — ``torchft-diagnose
+    --fragment`` names the injecting hop as ``poisoned_hop`` from the
+    serialized ``.prov`` dumps ALONE (the live registry is reset before
+    diagnosis)."""
+
+    def _diagnose(self, capsys, prov_path, fid):
+        from torchft_tpu import diagnose
+
+        rc = diagnose.main(["--fragment", fid, "--json", str(prov_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        return json.loads(out)
+
+    def test_mid_tree_relay_mismatch_named_from_dumps_alone(
+        self, tmp_path, capsys
+    ):
+        import numpy as np
+
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+        from torchft_tpu.serving import ServingReplica, encode_payload
+
+        rng = np.random.RandomState(0)
+        sd = {"w": rng.randn(8, 8).astype(np.float32)}
+        doc = encode_payload(sd, 1, fragments=2)
+        bad = dict(doc)
+        raw = bytearray(doc["frag:0"])
+        raw[-1] ^= 0xFF  # flip payload bytes, manifest digests untouched
+        bad["frag:0"] = bytes(raw)
+        poisoned = HTTPTransport(timeout=5.0)
+        poisoned.send_checkpoint([], 1, bad, timeout=5)
+        good = HTTPTransport(timeout=5.0)
+        good.send_checkpoint([], 1, doc, timeout=5)
+        lh = LighthouseServer(
+            min_replicas=1, heartbeat_timeout_ms=1500, quorum_tick_ms=50
+        )
+        rep = ServingReplica(
+            lh.address(), replica_id="victim", poll_interval=5.0,
+            fetch_timeout=8.0,
+        )
+        try:
+            # mid-tree: the victim relay's parent serves poisoned bytes;
+            # the pull fails over to the clean root and completes
+            rep._parent = poisoned.metadata()
+            rep._root_source = good.metadata()
+            rep._pull(1)
+            assert rep.version() == 1
+            prov_path = tmp_path / "flight.jsonl.prov"
+            assert PROV.dump("chaos", path=str(prov_path))
+        finally:
+            rep.shutdown()
+            poisoned.shutdown()
+            good.shutdown()
+            lh.shutdown()
+        PROV.reset()  # attribution must need nothing live
+        report = self._diagnose(capsys, prov_path, "weights/0")
+        culprit = report["culprit"]
+        assert culprit["signal"] == "poisoned_hop"
+        assert culprit["replica_id"] == poisoned.metadata()
+        assert culprit["verdict"] == "mismatch"
+        assert culprit["plane"] == "serving"
+        journey = report["fragment_journey"]
+        assert journey["poisoned_hop"]["source"] == poisoned.metadata()
+        # the clean root's ok hop is audited too but never blamed
+        sources = {h["fields"]["source"] for h in journey["journey"]
+                   if h["op"] == "fragment.hop"}
+        assert good.metadata() in sources
+
+    def test_torn_store_blob_named_from_dumps_alone(self, tmp_path,
+                                                    capsys):
+        import numpy as np
+
+        from torchft_tpu.checkpointing.store import FragmentStore
+
+        store = FragmentStore(str(tmp_path / "disk"), max_versions=0)
+        manifest = store.put_state(
+            3, {"w": np.arange(16, dtype=np.float32)}
+        )
+        name, digest = sorted(manifest["digests"].items())[0]
+        blob = store.blob_path(digest)
+        raw = bytearray(open(blob, "rb").read())
+        raw[0] ^= 0xFF  # tear the blob under its content address
+        with open(blob, "wb") as f:
+            f.write(bytes(raw))
+        assert store.fragment(3, name) is None  # torn: never served
+        prov_path = tmp_path / "x.prov"
+        assert PROV.dump("chaos", path=str(prov_path))
+        PROV.reset()
+        report = self._diagnose(capsys, prov_path, f"heal/{name}")
+        culprit = report["culprit"]
+        assert culprit["signal"] == "poisoned_hop"
+        assert culprit["replica_id"] == f"disk:{store.directory}"
+        assert culprit["verdict"] == "torn"
+        assert culprit["plane"] == "restore"
+
+
+class TestWiring:
+    def test_production_planes_feed_the_registry(self):
+        """Every fragment mover imports the provenance hooks — the
+        wiring the chaos/e2e suites then exercise live."""
+        import inspect
+
+        from torchft_tpu.checkpointing import fragments as frag_mod
+        from torchft_tpu.checkpointing import http_transport, store
+        from torchft_tpu.serving import client as sclient
+        from torchft_tpu.serving import publisher as spub
+        from torchft_tpu.serving import replica as sreplica
+
+        for mod in (frag_mod, http_transport, store, sclient, spub,
+                    sreplica):
+            src = inspect.getsource(mod)
+            assert "provenance" in src, mod.__name__
+
+    def test_module_shorthands_bind_the_global_registry(self):
+        assert provenance.note_hold.__self__ is PROV
+        assert provenance.note_hop.__self__ is PROV

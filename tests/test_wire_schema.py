@@ -90,6 +90,7 @@ class TestDriftGateClean:
         assert set(servers["lighthouse"]) == {
             "quorum", "heartbeat", "status", "timeline",
             "serving_heartbeat", "serving_plan", "lease", "links",
+            "fragments",
         }
         assert set(servers["manager"]) == {
             "quorum", "should_commit", "checkpoint_metadata", "kill",
@@ -249,6 +250,35 @@ class TestSeededDrift:
         drifted["lighthouse.cc"] = lh.replace(
             'out["reports_total"] = links_reports_total_;',
             'out["reportstotal"] = links_reports_total_;',
+        )
+        assert drifted["lighthouse.cc"] != lh
+        codes = self._codes(native=drifted)
+        assert "result-missing" in codes or "lock-drift" in codes
+
+    def test_python_fragments_param_rename_is_caught(self):
+        """Fragment provenance surface (ISSUE 18): renaming the
+        heartbeat's fragments piggyback key on the Python side means the
+        native aggregator never folds a digest again — the gate must
+        bite."""
+        py, *_ = _tree_inputs()
+        drifted = py.replace(
+            'params["fragments"] = fragments',
+            'params["frgs"] = fragments',
+        )
+        assert drifted != py
+        codes = self._codes(py=drifted)
+        assert {"param-dead", "param-missing"} <= codes
+
+    def test_native_fragments_result_rename_is_caught(self):
+        """Renaming a fragments-reply field natively drifts the locked
+        version-matrix document out from under /fragments.json
+        consumers."""
+        _py, native, *_ = _tree_inputs()
+        lh = native["lighthouse.cc"]
+        drifted = dict(native)
+        drifted["lighthouse.cc"] = lh.replace(
+            'out["reports_total"] = fragments_reports_total_;',
+            'out["reportstotal"] = fragments_reports_total_;',
         )
         assert drifted["lighthouse.cc"] != lh
         codes = self._codes(native=drifted)
@@ -421,6 +451,17 @@ class TestLiveConformance:
             lk = c.links()
             self._check_result("lighthouse", "links", lk)
             assert lk["rows_total"] == 1
+            c.heartbeat(
+                "live_0:a",
+                fragments={"host": "h0", "frags": [{
+                    "frag": "weights/0", "version": 3,
+                    "digest8": "aabbccdd", "version_ms": 1000,
+                    "held_ms": 900, "pub": True,
+                }]},
+            )
+            fr = c.fragments()
+            self._check_result("lighthouse", "fragments", fr)
+            assert fr["rows_total"] == 1
         finally:
             c.close()
             lh.shutdown()

@@ -173,6 +173,11 @@ def get_lib() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_char_p,
         ]
 
+        lib.tft_manager_report_fragments.restype = ctypes.c_int
+        lib.tft_manager_report_fragments.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
+        ]
+
         lib.tft_compute_quorum_results.restype = ctypes.c_void_p
         lib.tft_compute_quorum_results.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,

@@ -47,7 +47,8 @@ PASS_ID = "span-vocab"
 _MANAGER_FILE = "manager.py"
 
 #: documented span-name prefix families (docs/observability.md)
-SPAN_FAMILIES = ("quant.", "heal.", "rpc.", "serving.", "link.")
+SPAN_FAMILIES = ("quant.", "heal.", "rpc.", "serving.", "link.",
+                 "fragment.")
 
 #: allowed exact names beyond PROTOCOL_PHASES
 EXTRA_SPAN_NAMES = ("quorum_round",)
@@ -306,6 +307,7 @@ def step(tracer):
     tracer.export_span("quant.pipeline", "t", 0, 1)
     tracer.export_span("heal.send", "t", 0, 1)
     tracer.export_span("link.digest", "t", 0, 1)
+    tracer.export_span("fragment.hop", "t", 0, 1)
     tracer.export_span("quorum_round", "t", 0, 1)
 """
 
@@ -363,8 +365,8 @@ def selftest() -> None:
 PASS = LintPass(
     id=PASS_ID,
     doc="trace-span names come from PROTOCOL_PHASES / quant.* / heal.* / "
-    "rpc.* / serving.* / link.*; every span-emitting function also feeds "
-    "the flight recorder",
+    "rpc.* / serving.* / link.* / fragment.*; every span-emitting "
+    "function also feeds the flight recorder",
     run=run,
     selftest=selftest,
 )

@@ -75,6 +75,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from torchft_tpu.checkpointing import provenance as _prov
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.utils import faults as _faults
 from torchft_tpu.utils import flightrecorder as _flightrec
@@ -484,6 +485,14 @@ def stage_heal_checkpoint(
         step, f"frag:{MANIFEST_FRAG}", manifest, timeout=timeout
     )
     transport.finish_streamed_checkpoint(step, timeout=timeout)
+    # provenance: the heal source is these fragments' publisher — its
+    # manifest stamp is the reference clock fleet staleness compares on
+    v_ms = int(manifest["created_ns"] // 1_000_000)
+    for name, digest in digests.items():
+        _prov.note_hold(
+            _prov.frag_id("heal", name), step, digest,
+            version_ms=v_ms, role="source", publisher=True,
+        )
     return manifest
 
 
@@ -1004,9 +1013,15 @@ def striped_fetch(
     source_budget: "Optional[float]" = None,
     role: str = "heal",
     on_buf: "Optional[Callable[[str, np.ndarray, str], None]]" = None,
+    plane: str = "heal",
 ) -> "Dict[str, Any]":
     """Fetch ``names`` striped across ``sources`` in parallel with
     per-fragment failover.
+
+    ``plane`` is the provenance-plane identity of these transfers
+    (``heal`` for live heals, ``restore`` when the stripe sources are
+    durable-store disks) — every fragment that lands (or is rejected on
+    digest mismatch) appends a ``fragment.hop`` audit record.
 
     ``sources[0]`` is the PRIMARY (the quorum-assigned heal source —
     the one whose manifest defines truth); the rest are max-step quorum
@@ -1121,9 +1136,15 @@ def striped_fetch(
                     _fail_locked(stripe, name, e)
                 return
             sha = hashlib.sha256(memoryview(buf)).hexdigest()
+            fb_ms = getattr(_fb_local, "seconds", 0.0) * 1e3
             if digests is not None and digests.get(name, sha) != sha:
                 # poisoned/diverged source: its bytes must never land in
                 # the healed state — treat exactly like a dead source
+                _prov.note_hop(
+                    _prov.frag_id("heal", name), step, stripe.base, plane,
+                    verdict="mismatch", nbytes=buf.nbytes,
+                    first_byte_ms=fb_ms,
+                )
                 POOL.give(buf)
                 with cv:
                     _fail_locked(
@@ -1135,6 +1156,10 @@ def striped_fetch(
                         ),
                     )
                 return
+            _prov.note_hop(
+                _prov.frag_id("heal", name), step, stripe.base, plane,
+                verdict="ok", nbytes=buf.nbytes, first_byte_ms=fb_ms,
+            )
             with cv:
                 inflight -= 1
                 if stopped or name in done:

@@ -688,8 +688,13 @@ class HTTPTransport(CheckpointTransport[Any]):
         timeout: float,
         local_state_fn: "Optional[Callable[[], Any]]" = None,
         delta: "Optional[bool]" = None,
+        plane: str = "heal",
     ) -> "tuple[Any, dict]":
         """Striped multi-source heal receive (ISSUE 15).
+
+        ``plane`` names the provenance plane these transfers audit
+        under: ``heal`` for live heals, ``restore`` when the sources
+        are durable-store disks (the cold-start path).
 
         ``sources`` are transport base addresses in trust order —
         ``sources[0]`` is the quorum-assigned PRIMARY whose manifest
@@ -857,6 +862,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 digests=manifest.get("digests") if use_delta else None,
                 source_budget=failover_s,
                 on_buf=_decode,
+                plane=plane,
             )
             wire_bytes = stats["wire_bytes"]
             failovers = stats["failovers"]
@@ -897,6 +903,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 restats = frags.striped_fetch(
                     [primary], step, bad, deadline,
                     digests=digests, on_buf=_decode,
+                    plane=plane,
                 )
                 wire_bytes += restats["wire_bytes"]
                 sources_used |= set(restats["sources_used"])
@@ -924,6 +931,17 @@ class HTTPTransport(CheckpointTransport[Any]):
                 transport="http", direction="recv"
             ).observe(sum(phases.values()))
             state = frags.assemble(manifest, leaves)
+            # provenance: the heal destination now holds every fragment
+            # of this version (fetched AND delta-reused — reuse means
+            # the local bytes already hash to the source digest)
+            from torchft_tpu.checkpointing import provenance as _prov
+
+            h_ms = int(manifest.get("created_ns", 0) // 1_000_000)
+            for name in names:
+                _prov.note_hold(
+                    _prov.frag_id("heal", name), step,
+                    digests.get(name, ""), version_ms=h_ms, role="heal",
+                )
             info.update(
                 mode=mode,
                 fragments=len(names),

@@ -57,6 +57,7 @@ from ..utils import faults as _faults
 from ..utils import metrics as _metrics
 from ..utils.env import env_int, env_str
 from . import fragments as frags
+from . import provenance as _prov
 from . import serialization as ser
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -248,7 +249,23 @@ class FragmentStore:
         digest = (manifest.get("digests") or {}).get(name)
         if digest is None:
             return None
-        return self.read_blob(str(digest))
+        data = self.read_blob(str(digest))
+        if data is None and os.path.exists(self.blob_path(str(digest))):
+            # the blob exists but failed its content-address check:
+            # a torn/bit-rotted disk read IS a provenance hop verdict —
+            # diagnose --fragment names this disk as the poisoned source
+            _prov.note_hop(
+                _prov.frag_id(self._payload_family(manifest), name),
+                version, f"disk:{self._dir}", "restore", verdict="torn",
+            )
+        return data
+
+    @staticmethod
+    def _payload_family(manifest: Dict[str, Any]) -> str:
+        """Provenance payload family of a stored manifest: ``weights``
+        for serving documents spilled via :meth:`put_doc`, ``heal``
+        (the heal fragment layout) otherwise."""
+        return str(manifest.get("payload") or "heal")
 
     # ------------------------------------------------------------- spill
 
@@ -285,6 +302,12 @@ class FragmentStore:
         )
         if written:
             _metrics.STORE_SPILL_BYTES.inc(written)
+        v_ms = int(manifest["created_ns"] // 1_000_000)
+        for name, digest in digests.items():
+            _prov.note_hold(
+                _prov.frag_id("heal", name), version, digest,
+                version_ms=v_ms, role="store",
+            )
         if manifest_path is None and self._max_versions:
             self.retire()
         return manifest
@@ -308,9 +331,18 @@ class FragmentStore:
             written += self.write_blob(str(digest), raw)
         out = dict(manifest)
         out.setdefault(STORE_MARKER, STORE_FORMAT)
+        # serving documents keep their payload family on disk so torn
+        # reads audit under the same frag id the serving tier uses
+        out.setdefault("payload", "weights")
         _atomic_write(self._manifest_path(version), ser.serialize(out))
         if written:
             _metrics.STORE_SPILL_BYTES.inc(written)
+        v_ms = int(manifest.get("created_ns", 0) // 1_000_000)
+        for name in manifest["fragments"]:
+            _prov.note_hold(
+                _prov.frag_id("weights", name), version,
+                str(digests.get(name, "")), version_ms=v_ms, role="store",
+            )
         if self._max_versions:
             self.retire()
         return version

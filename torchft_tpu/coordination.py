@@ -880,6 +880,24 @@ class ManagerServer(_NativeServer):
         if rc != 0:
             raise RuntimeError(_native.last_error())
 
+    def report_fragments(self, fragments: "Dict[str, Any]") -> None:
+        """Record this replica's bounded fragment-provenance digest
+        (``ProvenanceRegistry.maybe_digest``: ``{"host", "frags"}``); the
+        next lighthouse heartbeat carries it exactly once
+        (consumed-on-send, restored on RPC failure — the links-digest
+        idiom), feeding the fleet per-(host, frag_id) version matrix
+        (``/fragments.json``)."""
+        if self._handle is None:
+            return
+        # chaos site: a dropped/raised fragment report degrades to stale
+        # matrix rows; it must never wedge the heartbeat loop
+        _faults.check("lighthouse.fragments")
+        rc = _native.get_lib().tft_manager_report_fragments(
+            self._handle, json.dumps(fragments).encode()
+        )
+        if rc != 0:
+            raise RuntimeError(_native.last_error())
+
 
 # ---------------------------------------------------------------------------
 # clients
@@ -951,6 +969,7 @@ class LighthouseClient:
         inflight_op: "Optional[str]" = None,
         summary: "Optional[Dict[str, Any]]" = None,
         links: "Optional[Dict[str, Any]]" = None,
+        fragments: "Optional[Dict[str, Any]]" = None,
     ) -> Dict[str, Any]:
         """Mark ``replica_id`` live; lighthouse expiry is heartbeat_timeout_ms.
 
@@ -987,6 +1006,12 @@ class LighthouseClient:
             # caller catches and re-queues (docs/robustness.md)
             _faults.check("lighthouse.links", replica=replica_id)
             params["links"] = links
+        if fragments is not None:
+            # chaos site: same degrade contract as ``links`` — a lost
+            # fragment digest leaves stale provenance rows, the caller
+            # restores the digest and re-sends next beat
+            _faults.check("lighthouse.fragments", replica=replica_id)
+            params["fragments"] = fragments
         return self._client.call("heartbeat", params, timeout)
 
     def status(
@@ -1024,6 +1049,7 @@ class LighthouseClient:
         capacity: int = 0,
         version_ms: int = 0,
         timeout: "float | timedelta" = 5.0,
+        fragments: "Optional[Dict[str, Any]]" = None,
     ) -> Dict[str, Any]:
         """Register/refresh a weight-serving member (docs/architecture.md
         "Weight-serving tier").  ``role`` is ``publisher`` (training-side
@@ -1035,10 +1061,14 @@ class LighthouseClient:
         wall-clock stamp (ms) of ``version`` — the publisher's clock,
         carried unmodified through the tree so the lighthouse can compute
         per-node serving staleness on a single clock (0 = unknown).
-        Expiry follows the lighthouse heartbeat timeout.  Returns
-        ``{"plan_epoch", "latest_version"}`` — a ``plan_epoch`` differing
-        from the adopted one means the tree re-formed and
-        :meth:`serving_plan` should be re-fetched."""
+        ``fragments`` is the member's bounded fragment-provenance digest
+        (``ProvenanceRegistry.maybe_digest``: ``{"host", "frags"}``)
+        folded into the fleet fragment-version matrix
+        (``/fragments.json``) — send each digest ONCE (consumed-on-send;
+        restore on failure).  Expiry follows the lighthouse heartbeat
+        timeout.  Returns ``{"plan_epoch", "latest_version"}`` — a
+        ``plan_epoch`` differing from the adopted one means the tree
+        re-formed and :meth:`serving_plan` should be re-fetched."""
         params: "Dict[str, Any]" = {
             "replica_id": replica_id,
             "address": address,
@@ -1047,6 +1077,11 @@ class LighthouseClient:
             "capacity": int(capacity),
             "version_ms": int(version_ms),
         }
+        if fragments is not None:
+            # chaos site: shared with the manager-heartbeat piggyback —
+            # the caller restores the digest and re-sends next beat
+            _faults.check("lighthouse.fragments", replica=replica_id)
+            params["fragments"] = fragments
         result = self._client.call("serving_heartbeat", params, timeout)
         return {
             "plan_epoch": result["plan_epoch"],
@@ -1135,6 +1170,33 @@ class LighthouseClient:
         if per_page is not None:
             params["per_page"] = int(per_page)
         return self._client.call("links", params, timeout)
+
+    def fragments(
+        self,
+        timeout: "float | timedelta" = 5.0,
+        page: "Optional[int]" = None,
+        per_page: "Optional[int]" = None,
+    ) -> Dict[str, Any]:
+        """The fleet fragment-version matrix (same document as
+        ``GET /fragments.json``): per-(holder host, fragment id) rows
+        aggregated from the heartbeat-piggybacked provenance digests —
+        version, digest8, publish stamp, staleness vs. the freshest
+        stamp any holder reports for that fragment (publisher's clock,
+        so the comparison is skew-free).  ``rows`` is paginated like
+        ``/links.json`` (``page``/``per_page``); fleet truth
+        (``rows_total``, ``pages``, ``version``, ``hosts``, ``frags``,
+        ``stalest``) is present on every page.  ``version`` is monotone
+        — equal versions mean an identical matrix.  See
+        docs/observability.md "Fragment provenance plane"."""
+        # chaos site: shared with the report path — a faulted fragments
+        # plane degrades reads the same way it degrades reports
+        _faults.check("lighthouse.fragments")
+        params: "Dict[str, Any]" = {}
+        if page is not None:
+            params["page"] = int(page)
+        if per_page is not None:
+            params["per_page"] = int(per_page)
+        return self._client.call("fragments", params, timeout)
 
     def close(self) -> None:
         """Close the underlying connection; the client is unusable after."""

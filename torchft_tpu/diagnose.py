@@ -37,6 +37,18 @@ same traffic) vs **excess** (the slow link's surcharge), so "wire ate
 the step" becomes "the wire was 4x slower than the fleet's, costing
 120ms/step".
 
+``--fragment <frag_id>`` (e.g. ``weights/0``) reconstructs one
+fragment's whole journey — publish, relay hops, serving clients, heal
+destinations, durable store — from the ``fragment.hold`` /
+``fragment.hop`` provenance records in the given dumps.  The provenance
+registry (checkpointing/provenance.py) dumps its hop ring to
+``TORCHFT_FLIGHT_FILE + ".prov"`` alongside every flight dump (same
+JSONL format, so ``.prov`` files are passed as ordinary positional
+dumps).  The first hop whose digest verdict is ``mismatch``/``torn`` is
+where bad bytes entered the plane; its source is named as the
+``poisoned_hop`` culprit — attribution from serialized dumps alone, no
+live fleet required.
+
 ``--trace <TORCHFT_TRACE_FILE>`` reads the distributed-tracing span sink
 (utils/tracing.py) and reconstructs the **cross-replica critical path**
 per step: trace ids are deterministic per step, every replica's
@@ -77,6 +89,7 @@ __all__ = [
     "analyze",
     "analyze_timeline",
     "analyze_links",
+    "analyze_fragment",
     "analyze_trace",
     "apply_wire_split",
     "ledger_categories",
@@ -84,6 +97,7 @@ __all__ = [
     "render_text",
     "render_timeline_text",
     "render_links_text",
+    "render_fragment_text",
     "render_trace_text",
     "selftest",
     "main",
@@ -753,6 +767,68 @@ def analyze_links(links: "Dict[str, Any]") -> "Dict[str, Any]":
     }
 
 
+def analyze_fragment(
+    entries: "List[Dict[str, Any]]", frag: str
+) -> "Dict[str, Any]":
+    """One fragment's journey + the ``poisoned_hop`` culprit signal.
+
+    Replays every ``fragment.hold`` / ``fragment.hop`` provenance record
+    for ``frag`` (frag_id ``"<payload>/<index>"``, e.g. ``weights/0``)
+    out of the already-merged dump timeline — the ``.prov`` companions
+    the provenance registry dumps alongside ``TORCHFT_FLIGHT_FILE`` use
+    the same JSONL format, so they load through :func:`load_records`
+    unchanged.  The journey is publish -> relay hops -> client / heal
+    destination / durable store, ordered by start time across every
+    process that dumped.  The FIRST hop whose digest verdict is
+    ``mismatch`` or ``torn`` is where bad bytes entered the plane: its
+    SOURCE is the culprit (every receiver downstream of it sees the same
+    mismatch and is a victim, not a cause) — attribution needs no live
+    fleet, only the serialized dumps."""
+    journey = [
+        e
+        for e in entries
+        if e.get("op") in ("fragment.hold", "fragment.hop")
+        and str((e.get("fields") or {}).get("frag", "")) == frag
+    ]
+    journey.sort(key=lambda e: e.get("start_ns") or e.get("t_ns") or 0)
+    hops = [e for e in journey if e["op"] == "fragment.hop"]
+    holds = [e for e in journey if e["op"] == "fragment.hold"]
+    poisoned: "Optional[Dict[str, Any]]" = None
+    for e in hops:
+        if str(e["fields"].get("verdict", "ok")) in ("mismatch", "torn"):
+            poisoned = e
+            break
+    culprit: "Optional[Dict[str, Any]]" = None
+    if poisoned is not None:
+        f = poisoned["fields"]
+        source = str(f.get("source", "?"))
+        holder = str(f.get("holder", "?"))
+        verdict = str(f.get("verdict", "?"))
+        culprit = {
+            "replica_id": source,
+            "reason": (
+                f"fragment {frag} v{f.get('version')} arrived '{verdict}' "
+                f"at {holder} over the {f.get('plane')} plane — {source} "
+                f"is the first hop where the digest broke ({len(hops)} "
+                f"hop(s) audited)"
+            ),
+            "frag": frag,
+            "version": f.get("version"),
+            "plane": f.get("plane"),
+            "verdict": verdict,
+            "holder": holder,
+            "signal": "poisoned_hop",
+        }
+    return {
+        "frag": frag,
+        "holds": len(holds),
+        "hops": len(hops),
+        "journey": journey,
+        "poisoned_hop": dict(poisoned["fields"]) if poisoned else None,
+        "culprit": culprit,
+    }
+
+
 def apply_wire_split(
     trace_report: "Dict[str, Any]", links_report: "Dict[str, Any]"
 ) -> None:
@@ -1122,6 +1198,56 @@ def render_links_text(
     return "\n".join(out)
 
 
+def render_fragment_text(
+    frag_report: "Dict[str, Any]", max_rows: int = 60
+) -> str:
+    """One fragment's journey as a text section: every hold and hop in
+    time order (holder, role, plane, digest verdict), the poisoned hop
+    called out when a mismatch/torn verdict entered the plane."""
+    out: "List[str]" = []
+    journey = frag_report.get("journey") or []
+    out.append(
+        f"fragment journey {frag_report['frag']} "
+        f"({frag_report.get('holds')} hold(s), "
+        f"{frag_report.get('hops')} hop(s)):"
+    )
+    if not journey:
+        out.append(
+            "  no provenance records for this fragment — pass the .prov "
+            "companion dumps written alongside TORCHFT_FLIGHT_FILE"
+        )
+        return "\n".join(out)
+    t0 = min(e.get("start_ns") or e.get("t_ns") or 0 for e in journey)
+    for e in journey[:max_rows]:
+        f = e.get("fields") or {}
+        t = _fmt_t(e.get("start_ns") or e.get("t_ns") or 0, t0)
+        if e["op"] == "fragment.hold":
+            out.append(
+                f"  {t}  HELD v{f.get('version')!s:<4} by "
+                f"{str(f.get('holder', '?'))[:28]:28s} "
+                f"[{f.get('role', 'holder')}] "
+                f"digest={f.get('digest8') or '-'}"
+            )
+        else:
+            verdict = str(f.get("verdict", "ok"))
+            out.append(
+                f"  {t}  HOP  v{f.get('version')!s:<4} "
+                f"{str(f.get('source', '?'))[:28]:28s} -> "
+                f"{str(f.get('holder', '?'))[:28]:28s} "
+                f"({f.get('plane')}) {verdict.upper()} "
+                f"{f.get('bytes', 0)}B fb={f.get('first_byte_ms', 0)}ms"
+            )
+    poisoned = frag_report.get("poisoned_hop")
+    if poisoned:
+        out.append(
+            f"  POISONED HOP: {poisoned.get('source')} -> "
+            f"{poisoned.get('holder')} ({poisoned.get('plane')}) verdict="
+            f"{poisoned.get('verdict')} at v{poisoned.get('version')} — "
+            f"first hop where the digest broke"
+        )
+    return "\n".join(out)
+
+
 def render_trace_text(trace_report: "Dict[str, Any]", max_rows: int = 30) -> str:
     """The per-step critical-path ledger as a text section: one row per
     step (wall, critical replica, dominant category, category split) plus
@@ -1236,14 +1362,60 @@ def _synthetic_dumps(tmpdir: str) -> "Tuple[str, str]":
     return write("replica_a.jsonl", a_records), write("replica_b.jsonl", b_records)
 
 
+def _synthetic_prov_dump(tmpdir: str) -> str:
+    """One ``.prov`` companion dump: fragment weights/0 publishes clean,
+    relay_mid serves poisoned bytes (the client's digest check fires),
+    and a downstream client sees the same mismatch — the exact trail the
+    provenance registry dumps."""
+    t0 = time.time_ns()
+    ms = 1_000_000  # 1ms in ns
+    records = [
+        {"flight": "rec", "op": "fragment.hold", "status": "ok",
+         "start_ns": t0, "end_ns": t0, "frag": "weights/0", "version": 7,
+         "digest8": "aaaaaaaa", "version_ms": 1000, "holder": "pub:1",
+         "role": "publisher"},
+        {"flight": "rec", "op": "fragment.hop", "status": "ok",
+         "start_ns": t0 + ms, "end_ns": t0 + 2 * ms, "frag": "weights/0",
+         "version": 7, "source": "http://pub:1", "plane": "serving",
+         "verdict": "ok", "bytes": 4096, "first_byte_ms": 0.4,
+         "holder": "relay_mid:2"},
+        {"flight": "rec", "op": "fragment.hop", "status": "error",
+         "start_ns": t0 + 3 * ms, "end_ns": t0 + 4 * ms,
+         "frag": "weights/0", "version": 7, "source": "http://relay_mid:2",
+         "plane": "serving", "verdict": "mismatch", "bytes": 4096,
+         "first_byte_ms": 0.6, "holder": "client:3"},
+        {"flight": "rec", "op": "fragment.hop", "status": "error",
+         "start_ns": t0 + 5 * ms, "end_ns": t0 + 6 * ms,
+         "frag": "weights/0", "version": 7, "source": "http://client:3",
+         "plane": "serving", "verdict": "mismatch", "bytes": 4096,
+         "first_byte_ms": 0.5, "holder": "leaf:4"},
+    ]
+    path = os.path.join(tmpdir, "flight.jsonl.prov")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({
+            "flight": "meta", "reason": "selftest", "trigger": "manual",
+            "ts": t0 / 1e9, "pid": 0, "records": len(records),
+        }) + "\n")
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
 def selftest(verbose: bool = True) -> bool:
     """Synthetic two-replica dump pair through the full pipeline; the
     culprit must be the silently-dead replica_b and the failed phase the
-    surviving replica's collective."""
+    surviving replica's collective.  A synthetic provenance dump then
+    checks ``--fragment`` attribution: the FIRST mismatching hop's
+    source (the mid-tree relay) must be the ``poisoned_hop`` culprit,
+    not the downstream victims."""
     with tempfile.TemporaryDirectory() as tmpdir:
         dump_a, dump_b = _synthetic_dumps(tmpdir)
         entries, warnings = load_records([dump_a, dump_b])
         report = analyze(entries)
+        prov_entries, prov_warnings = load_records(
+            [_synthetic_prov_dump(tmpdir)]
+        )
+        frag_report = analyze_fragment(prov_entries, "weights/0")
     ok = True
 
     def check(cond: bool, what: str) -> None:
@@ -1266,8 +1438,27 @@ def selftest(verbose: bool = True) -> bool:
         and report["failure"]["step"] == 3,
         f"failure {report['failure']} is not allreduce@3",
     )
+    check(not prov_warnings, f"prov warnings: {prov_warnings}")
+    check(
+        frag_report["hops"] == 3 and frag_report["holds"] == 1,
+        f"fragment journey miscounted: {frag_report['hops']} hops, "
+        f"{frag_report['holds']} holds",
+    )
+    check(
+        frag_report["culprit"] is not None
+        and frag_report["culprit"]["signal"] == "poisoned_hop"
+        and frag_report["culprit"]["replica_id"] == "http://relay_mid:2",
+        f"poisoned_hop culprit wrong: {frag_report['culprit']}",
+    )
+    check(
+        bool(render_fragment_text(frag_report)),
+        "fragment renderer produced nothing",
+    )
     if ok and verbose:
-        print("selftest OK: culprit=replica_b, failed phase=allreduce@3")
+        print(
+            "selftest OK: culprit=replica_b, failed phase=allreduce@3, "
+            "poisoned_hop=relay_mid"
+        )
     return ok
 
 
@@ -1301,6 +1492,14 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         "into the report — names a sustained slow host-pair link "
         "(signal slow_link) and, with --trace, splits the ledger's wire "
         "cost into expected vs excess against the fleet-median link",
+    )
+    parser.add_argument(
+        "--fragment", default=None, metavar="FRAG_ID",
+        help="reconstruct this fragment's journey (frag_id like "
+        "weights/0) from fragment.hold/fragment.hop provenance records "
+        "in the given dumps (pass the TORCHFT_FLIGHT_FILE.prov "
+        "companions as positional dumps) and name the hop where a "
+        "digest mismatch first entered (signal poisoned_hop)",
     )
     parser.add_argument(
         "--trace", default=None, metavar="TRACE_FILE",
@@ -1375,14 +1574,21 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         print("torchft-diagnose: no parseable records", file=sys.stderr)
         return 1
     report = analyze(entries)
+    frag_report: "Optional[Dict[str, Any]]" = None
+    if args.fragment:
+        frag_report = analyze_fragment(entries, args.fragment)
     if trace_report is not None and links_report is not None:
         apply_wire_split(trace_report, links_report)
-    # Culprit precedence: flight-record signals see INSIDE a replica and
-    # win when present; the trace ledger's ok=false spans are next (they
-    # also see inside, but dumps carry the fault tags); the lighthouse
-    # timeline sees the fleet from outside; the link matrix is last — a
-    # slow wire is a degradation, not a failure, so any failure
-    # signature outranks it.  All four join into one report.
+    # Culprit precedence: a poisoned fragment hop answers the question
+    # --fragment explicitly asked, so it overrides everything when found;
+    # otherwise flight-record signals see INSIDE a replica and win when
+    # present; the trace ledger's ok=false spans are next (they also see
+    # inside, but dumps carry the fault tags); the lighthouse timeline
+    # sees the fleet from outside; the link matrix is last — a slow wire
+    # is a degradation, not a failure, so any failure signature outranks
+    # it.  All inputs join into one report.
+    if frag_report is not None and frag_report["culprit"] is not None:
+        report["culprit"] = frag_report["culprit"]
     if report["culprit"] is None and trace_report is not None:
         report["culprit"] = trace_report["culprit"]
     if report["culprit"] is None and timeline_report is not None:
@@ -1393,6 +1599,8 @@ def main(argv: "Optional[List[str]]" = None) -> int:
         report["cluster_timeline"] = timeline_report
     if links_report is not None:
         report["link_matrix"] = links_report
+    if frag_report is not None:
+        report["fragment_journey"] = frag_report
     if trace_report is not None:
         report["trace_ledger"] = trace_report
     if args.json:
@@ -1406,6 +1614,8 @@ def main(argv: "Optional[List[str]]" = None) -> int:
             print(render_timeline_text(cluster_timeline))
         if links_doc is not None and links_report is not None:
             print(render_links_text(links_doc, links_report))
+        if frag_report is not None:
+            print(render_fragment_text(frag_report))
         if trace_report is not None:
             print(render_trace_text(trace_report))
     return 0

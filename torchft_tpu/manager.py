@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 import jax
 import numpy as np
 
+from torchft_tpu.checkpointing import provenance as provenance
 from torchft_tpu.checkpointing import store as fragment_store
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, StoreClient, StoreServer
@@ -1172,6 +1173,7 @@ class Manager:
                 step=version,
                 timeout=self._timeout,
                 local_state_fn=self._manager_state_dict,
+                plane="restore",
             )
             metrics.STORE_RESTORE_BYTES.labels(
                 mode=info.get("mode", "full")
@@ -1675,6 +1677,17 @@ class Manager:
                 server.report_links(digest)
         except Exception:  # noqa: BLE001 - telemetry must not fail the step
             logger.debug("link digest report failed", exc_info=True)
+        # Same piggyback channel for the fragment provenance digest
+        # (ISSUE 18): hand the bounded version-vector digest to the
+        # native heartbeat loop, which owns consumed-on-send/restore.
+        fdigest = None
+        try:
+            fdigest = provenance.PROV.maybe_digest(socket.gethostname())
+            if fdigest is not None:
+                server.report_fragments(fdigest)
+        except Exception:  # noqa: BLE001 - telemetry must not fail the step
+            provenance.PROV.restore_digest(fdigest)
+            logger.debug("fragment digest report failed", exc_info=True)
 
     def current_step(self) -> int:
         return self._step

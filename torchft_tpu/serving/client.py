@@ -23,6 +23,7 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from torchft_tpu.checkpointing import provenance as _prov
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.serving import fetcher as _fetcher
 from torchft_tpu.serving import payload as _payload
@@ -377,8 +378,20 @@ class ServingClient:
                 base, v, [f"frag_{n}" for n in names], deadline=t_end
             ):
                 name = res[len("frag_"):]
+                fid = _prov.frag_id("weights", name)
                 try:
-                    _payload.verify_fragment(name, buf, manifest)
+                    try:
+                        _payload.verify_fragment(name, buf, manifest)
+                    except ValueError:
+                        _prov.note_hop(
+                            fid, v, base, "serving",
+                            verdict="mismatch", nbytes=buf.nbytes,
+                        )
+                        raise
+                    _prov.note_hop(
+                        fid, v, base, "serving",
+                        verdict="ok", nbytes=buf.nbytes,
+                    )
                     leaves.update(_payload.decode_fragment(buf))
                 finally:
                     POOL.give(buf)
@@ -390,6 +403,15 @@ class ServingClient:
             raise RuntimeError(
                 f"serving fetch: wanted v{v}, source {base} served "
                 f"v{manifest['version']}"
+            )
+        # provenance: the client now holds every fragment of v (fetched
+        # and delta-reused alike)
+        c_ms = int(manifest.get("created_ns", 0) // 1_000_000)
+        c_digests = manifest.get("digests") or {}
+        for name in manifest.get("fragments") or ():
+            _prov.note_hold(
+                _prov.frag_id("weights", name), v,
+                c_digests.get(name, ""), version_ms=c_ms, role="client",
             )
         self._held = (manifest, leaves)
         self._held_version = v

@@ -13,10 +13,12 @@ serving replica learns new versions from its heartbeat replies.
 from __future__ import annotations
 
 import logging
+import socket
 import threading
 import time
 from typing import Any, Dict, Optional
 
+from torchft_tpu.checkpointing import provenance as _prov
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.serving import payload as _payload
 from torchft_tpu.utils import faults as _faults
@@ -142,6 +144,9 @@ class WeightPublisher:
         # interval; RPC failures are logged and the next beat retries
         # naturally.  Event.wait doubles as the shutdown latch.
         while not self._stop.is_set():
+            # provenance piggyback: consumed-on-send, handed back to the
+            # registry when the RPC fails so no vector change is lost
+            digest = _prov.PROV.maybe_digest(socket.gethostname())
             try:
                 reply = self._client.serving_heartbeat(
                     self._replica_id,
@@ -149,11 +154,13 @@ class WeightPublisher:
                     role="publisher",
                     version=self.latest_version(),
                     version_ms=self.latest_version_ms(),
+                    fragments=digest,
                 )
                 _metrics.SERVING_PLAN_EPOCH.labels(role="publisher").set(
                     reply["plan_epoch"]
                 )
             except Exception as e:  # noqa: BLE001 - keep beating
+                _prov.PROV.restore_digest(digest)
                 logger.warning("serving heartbeat failed: %s", e)
             self._nudge.wait(interval)
             self._nudge.clear()
@@ -205,6 +212,16 @@ class WeightPublisher:
             doc[f"frag:{_payload.MANIFEST_FRAG}"].get("created_ns", 0)
             // 1_000_000
         )
+        # provenance: the publisher is the origin holder — its manifest
+        # stamp is the reference clock every fleet staleness row uses
+        manifest = doc[f"frag:{_payload.MANIFEST_FRAG}"]
+        p_digests = manifest.get("digests") or {}
+        for name in manifest.get("fragments") or ():
+            _prov.note_hold(
+                _prov.frag_id("weights", name), v,
+                p_digests.get(name, ""), version_ms=v_ms,
+                role="publisher", publisher=True,
+            )
         with self._lock:
             if v > self._version:
                 self._version = v

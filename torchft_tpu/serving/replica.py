@@ -41,11 +41,13 @@ instead of depth×T_payload.  Three properties ride along:
 from __future__ import annotations
 
 import logging
+import socket
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from torchft_tpu.checkpointing import provenance as _prov
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.ops.codec_pool import merged_seconds
@@ -192,14 +194,23 @@ class ServingReplica:
     def _beat_once(self) -> None:
         with self._lock:
             held_v, held_ms = self._version, self._version_ms
-        reply = self._client.serving_heartbeat(
-            self._replica_id,
-            self.address(),
-            role="server",
-            version=held_v,
-            capacity=self._capacity,
-            version_ms=held_ms,
-        )
+        # provenance piggyback: consumed-on-send; a failed beat hands
+        # the digest back so no vector change is lost (the PR 16 links
+        # contract)
+        digest = _prov.PROV.maybe_digest(socket.gethostname())
+        try:
+            reply = self._client.serving_heartbeat(
+                self._replica_id,
+                self.address(),
+                role="server",
+                version=held_v,
+                capacity=self._capacity,
+                version_ms=held_ms,
+                fragments=digest,
+            )
+        except Exception:
+            _prov.PROV.restore_digest(digest)
+            raise
         if reply["plan_epoch"] != self.plan_epoch():
             self._adopt_plan()
         target = int(reply["latest_version"])
@@ -355,6 +366,20 @@ class ServingReplica:
         self._transport.send_checkpoint(
             [], target, doc, timeout=self._fetch_timeout
         )
+        manifest = doc.get(f"frag:{_payload.MANIFEST_FRAG}") or {}
+        m_ms = int(manifest.get("created_ns", 0) // 1_000_000)
+        m_digests = manifest.get("digests") or {}
+        for name in manifest.get("fragments") or ():
+            fid = _prov.frag_id("weights", name)
+            raw = _payload.fragment_wire(doc.get(f"frag:{name}"))
+            _prov.note_hop(
+                fid, target, src, "serving", verdict="ok",
+                nbytes=raw.nbytes if raw is not None else 0,
+            )
+            _prov.note_hold(
+                fid, target, m_digests.get(name, ""),
+                version_ms=m_ms, role="relay",
+            )
         with self._lock:
             self._held_manifest = doc.get(f"frag:{_payload.MANIFEST_FRAG}")
 
@@ -450,10 +475,32 @@ class ServingReplica:
                     name = res[len("frag_"):]
                     wire_spans.append(span)
                     t_proc = time.perf_counter()
+                    fid = _prov.frag_id("weights", name)
                     try:
-                        _payload.verify_fragment(name, buf, manifest)
+                        try:
+                            _payload.verify_fragment(name, buf, manifest)
+                        except ValueError:
+                            # provenance: THIS hop is where the poison
+                            # entered — diagnose --fragment names it
+                            _prov.note_hop(
+                                fid, target, src, "serving",
+                                verdict="mismatch", nbytes=buf.nbytes,
+                            )
+                            raise
+                        _prov.note_hop(
+                            fid, target, src, "serving",
+                            verdict="ok", nbytes=buf.nbytes,
+                        )
                         self._transport.stage_streamed_part(
                             target, f"frag:{name}", buf, pooled=True
+                        )
+                        _prov.note_hold(
+                            fid, target,
+                            (manifest.get("digests") or {}).get(name, ""),
+                            version_ms=int(
+                                manifest.get("created_ns", 0) // 1_000_000
+                            ),
+                            role="relay",
                         )
                     except BaseException:
                         # poisoned or unstageable bytes never serve

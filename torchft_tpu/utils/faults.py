@@ -31,6 +31,13 @@ site                  fires in
                       report_links`` handoff (a dropped report degrades
                       the fleet matrix to stale rows; the heartbeat
                       itself never carries the fault)
+``lighthouse.fragments``  fragment-provenance digest reporting — the
+                      Python ``LighthouseClient.heartbeat(fragments=
+                      ...)`` / ``serving_heartbeat(fragments=...)`` /
+                      ``fragments()`` readers and ``ManagerServer.
+                      report_fragments`` handoff (a dropped digest is
+                      restored and retried next beat; the version
+                      matrix degrades to older rows, never wedges)
 ``manager.quorum``    ``Manager._async_quorum`` before the quorum RPC
 ``manager.heal``      ``Manager._async_quorum`` heal send/recv branches
 ``pg.reconfigure``    ``ProcessGroupTCP.configure`` /
@@ -145,6 +152,7 @@ KNOWN_SITES: "Tuple[str, ...]" = (
     "lighthouse.heartbeat",
     "lighthouse.lease",
     "lighthouse.links",
+    "lighthouse.fragments",
     "manager.quorum",
     "manager.heal",
     "pg.reconfigure",

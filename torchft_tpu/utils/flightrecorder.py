@@ -72,6 +72,7 @@ __all__ = [
     "dump",
     "dump_path",
     "install_signal_hooks",
+    "register_companion_dump",
 ]
 
 _DEFAULT_RING = 512
@@ -305,7 +306,30 @@ class FlightRecorder:
             _metrics.FLIGHT_DUMPS.labels(trigger=trigger).inc()
         except Exception:  # noqa: BLE001 - accounting never masks the dump
             logger.exception("flight dump metric failed")
+        # Companion rings (e.g. the fragment-provenance hop ring) dump
+        # alongside every PROCESS-recorder dump so one trigger — signal,
+        # abort, manager error — leaves the whole postmortem evidence set
+        # next to each other on disk.  Private recorders don't cascade.
+        if self is RECORDER:
+            for fn in list(_companion_dumps):
+                try:
+                    fn(reason, trigger, blocking, target)
+                except Exception:  # noqa: BLE001 - companions never mask
+                    logger.exception("companion flight dump failed")
         return target
+
+
+#: Callables ``fn(reason, trigger, blocking, target)`` fired after every
+#: successful dump of the process-wide ``RECORDER`` (never of private
+#: rings) — subsystems with their own bounded rings register here so a
+#: crash dump carries their evidence too (checkpointing/provenance.py).
+_companion_dumps: "List[Any]" = []
+
+
+def register_companion_dump(fn: Any) -> None:
+    """Register a companion dump hook (idempotent)."""
+    if fn not in _companion_dumps:
+        _companion_dumps.append(fn)
 
 
 #: The process-wide recorder every production site feeds.

@@ -1187,6 +1187,36 @@ LINK_GOODPUT_MIN = gauge(
     "series at any fleet size — the aggregate under the worst-K tier)",
     (),
 )
+FRAG_HELD = gauge(
+    "torchft_frag_held",
+    "Fragments in this process's provenance version vector "
+    "(checkpointing/provenance.py) — every fragment this holder has "
+    "staged/verified/spilled, any payload family",
+    (),
+)
+FRAG_HOPS = counter(
+    "torchft_frag_hops_total",
+    "Fragment transfers audited by the provenance plane, by transfer "
+    "plane (serving/heal/restore) and digest verdict (ok / mismatch / "
+    "torn) — a nonzero mismatch or torn count is a poisoned-fragment "
+    "signal (triage with torchft-diagnose --fragment)",
+    ("plane", "verdict"),
+)
+FRAG_STAMP_AGE = gauge(
+    "torchft_frag_stamp_age_seconds",
+    "Publish-stamp age of a held fragment at digest-refresh time, by "
+    "frag id — worst-K stalest only (TORCHFT_FRAG_TOPK names + "
+    "'other'); fleet per-fragment staleness on one clock lives in the "
+    "lighthouse /fragments.json matrix",
+    ("frag",),
+)
+FRAG_STAMP_AGE_MAX = gauge(
+    "torchft_frag_stamp_age_max_seconds",
+    "Oldest publish stamp across the full local provenance vector (one "
+    "series at any fragment count — the aggregate under the worst-K "
+    "tier)",
+    (),
+)
 SERVING_STALENESS = histogram(
     "torchft_serving_staleness_seconds",
     "Serving staleness ledger: publish-stamp age of a weight version at "
