@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore fragments-smoke
+.PHONY: native verify lint typecheck plan-verify test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore fragments-smoke
 
 native:
 	$(MAKE) -C native
@@ -21,10 +21,17 @@ verify:
 lint:
 	$(PYTHON) -m torchft_tpu.analysis torchft_tpu/
 
+# The tft-plan gate alone (ISSUE 19): exhaustive small-world plan
+# enumeration on the reduction/serving/stripe planes + the seeded
+# plan-mutation catalog, each caught by its named invariant.  Also part
+# of the default `tft-verify` full gate (and therefore `make verify`).
+plan-verify:
+	$(PYTHON) -m torchft_tpu.analysis.verify_cli --scenario plan
+
 # mypy strict over the analysis + utils layers (mirrors the slow-marked
 # tests/test_typecheck.py gate); requires mypy on PATH.
 typecheck:
-	$(PYTHON) -m mypy --config-file mypy.ini torchft_tpu/analysis torchft_tpu/utils
+	$(PYTHON) -m mypy --config-file mypy.ini torchft_tpu/analysis torchft_tpu/utils torchft_tpu/ops/topology.py
 
 # tier-1: the default CI selection (ROADMAP.md).
 tier1:

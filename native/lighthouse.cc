@@ -1129,6 +1129,10 @@ Json LighthouseServer::rpc_serving_plan(const Json& params) {
     n["parent"] = parent[i];  // "" = root (pulls from root_source)
     n["depth"] = depth[i];
     n["children"] = children[i];
+    // Per-node slot budget the BFS consumed (0 = the plan-wide fanout):
+    // plan verifiers/adapters need the INPUT bound, not just the
+    // resulting child count, to check the fanout invariant.
+    n["capacity"] = servers[i]->capacity;
     n["version"] = servers[i]->version;
     // Staleness ledger: how far behind the newest PUBLISH this node's
     // held version is, in publish-clock ms (-1 = unknown — the node has

@@ -581,3 +581,63 @@ class TestDiagnoseLinks:
         p.write_text(json.dumps({"steps": []}))
         with pytest.raises(ValueError, match="links.json"):
             load_links(str(p))
+
+
+# ---------------------------------------------------------------------------
+# frozen snapshot contract (ISSUE 19 satellite): LinkMatrix.snapshot()
+# is the input surface the future plan synthesizer (ROADMAP item 4)
+# consumes, so its row schema is pinned in analysis/plan_ir.py the same
+# way the native RPC schemas are pinned in protocol.lock — a rename
+# breaks HERE, not in the synthesizer.
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotFrozenContract:
+    def _live_stat(self):
+        reg = linkstats.LinkRegistry()
+        reg.record("h1", "reduction", 10_000_000, 0.105, first_byte_s=0.005)
+        (stat,) = reg.snapshot().entries
+        return stat
+
+    def test_linkstat_fields_pinned(self):
+        import dataclasses as _dc
+
+        from torchft_tpu.analysis import plan_ir as pir
+
+        got = tuple(f.name for f in _dc.fields(linkstats.LinkStat))
+        assert got == pir.LINK_SNAPSHOT_FIELDS, (
+            "LinkStat changed shape; update plan_ir.LINK_SNAPSHOT_FIELDS "
+            "and the plan synthesizer's consumers TOGETHER"
+        )
+
+    def test_wire_row_keys_pinned(self):
+        from torchft_tpu.analysis import plan_ir as pir
+
+        row = self._live_stat().to_dict()
+        assert tuple(row) == pir.LINK_ROW_KEYS, (
+            "LinkStat.to_dict() changed the /links.json row schema; "
+            "update plan_ir.LINK_ROW_KEYS and every aggregator TOGETHER"
+        )
+        # the wire row round-trips through JSON without loss of keys
+        assert tuple(json.loads(json.dumps(row))) == pir.LINK_ROW_KEYS
+
+    def test_seeded_rename_is_caught(self):
+        """Drift-gate selfcheck, wire-drift style: seed a field rename
+        and prove the contract comparison actually fires for EVERY
+        pinned key (a vacuous gate is worse than none)."""
+        from torchft_tpu.analysis import plan_ir as pir
+
+        row = self._live_stat().to_dict()
+        for key in pir.LINK_ROW_KEYS:
+            mutated = dict(row)
+            mutated[f"{key}_v2"] = mutated.pop(key)
+            assert tuple(mutated) != pir.LINK_ROW_KEYS, key
+
+    def test_snapshot_values_survive_the_wire_row(self):
+        stat = self._live_stat()
+        row = stat.to_dict()
+        assert row["peer"] == stat.peer and row["plane"] == stat.plane
+        assert row["local"] is stat.local
+        assert row["samples"] == stat.samples
+        assert row["bytes"] == stat.bytes_total  # deliberate short name
+        assert row["rtt_ms"] == pytest.approx(stat.rtt_p50_ms, abs=1e-3)

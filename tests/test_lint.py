@@ -116,6 +116,19 @@ _SEEDED = {
             'faults.check("pg.not_a_site")\n'
         ),
     },
+    "plan-discipline": {
+        "pkg/bad.py": textwrap.dedent(
+            """
+            from torchft_tpu.ops import topology
+
+            def sneaky_side_channel(world):
+                # peer-communication structure built OUTSIDE the plan
+                # layer: invisible to the tft-plan verifier
+                topo = topology.parse_topology("hosts:2", world)
+                return topology.synthesize_plan(topo, 0)
+            """
+        ),
+    },
     "span-vocab": {
         "pkg/manager.py": 'PROTOCOL_PHASES = ("ring", "commit")\n',
         "pkg/bad.py": textwrap.dedent(

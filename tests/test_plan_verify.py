@@ -1,0 +1,633 @@
+"""tft-plan tests (ISSUE 19): the unified topology-plan IR + invariant
+verifier.
+
+Covers the tentpole surface end to end:
+
+- IR adapter units: reduction (synthesize_plan union), serving (native
+  BFS doc), stripe (first-K roster + round-robin leaf layout), plus the
+  malformed-IR guard rails;
+- the seeded plan-mutation catalog — every mutation caught by its NAMED
+  invariant as the first ordered violation, and every invariant
+  exercised by at least one mutation;
+- exhaustive small-world enumeration clean on all three planes
+  (worlds x topologies x churn x failover);
+- the stripe property tests (satellite: disjoint exhaustive ranges over
+  any roster/TORCHFT_HEAL_SOURCES/fragment-count, survives per-fragment
+  failover requeue) and the one-copy-of-math pin against manager.py;
+- cross-language serving-tree parity: the native lighthouse BFS and the
+  pure-Python reference produce the SAME tree (fanout, capacity
+  override, expiry) and the same IR;
+- the TORCHFT_PLAN_VERIFY runtime hook: accept/reject/error verdicts in
+  torchft_plan_verify_total, the plan.verify flight record,
+  torchft-diagnose naming a bad plan (signal ``bad_plan``), and the
+  observe-only guarantee (never raises into a committing path);
+- live integration: a real 2-group hierarchical allreduce and a real
+  publish->relay->fetch serving round under TORCHFT_PLAN_VERIFY=1 with
+  ZERO rejections (the suite-wide arming in conftest.py makes every
+  other integration test an implicit instance of this gate).
+"""
+
+import dataclasses
+import json
+import logging
+import random
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_process_group import make_group, run_parallel, store  # noqa: F401
+from torchft_tpu import diagnose
+from torchft_tpu.analysis import plan_ir as pir
+from torchft_tpu.analysis import plan_verify as pv
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+from torchft_tpu.ops import topology as T
+from torchft_tpu.ops.collectives import allreduce_quantized
+from torchft_tpu.parallel.process_group import REDUCE_SUM
+from torchft_tpu.serving import ServingClient, ServingReplica, WeightPublisher
+from torchft_tpu.utils import flightrecorder as fr
+from torchft_tpu.utils import metrics as _metrics
+
+
+def _count(plane, verdict):
+    return _metrics.PLAN_VERIFY_TOTAL.labels(plane=plane, verdict=verdict).get()
+
+
+def _wait_until(cond, timeout=15.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# IR adapters
+# ---------------------------------------------------------------------------
+
+
+class TestReductionIR:
+    def test_hosts2_world6_shape(self):
+        topo = T.parse_topology("hosts:2", 6)
+        ir = pir.reduction_ir(topo, wire="int8", slice_nbytes=64)
+        assert ir.plane == "reduction" and ir.unit == "slice"
+        assert ir.units == 3  # three groups -> three row-slices
+        assert {n.id for n in ir.nodes} == {f"r{i}" for i in range(6)}
+        assert ir.node("r0").role == "leader" and ir.node("r1").role == "member"
+        assert ir.node("r3").host == "g1"
+        # leaders are the requant boundaries; every rank is a consumer
+        assert ir.boundaries == ("r0", "r2", "r4")
+        assert ir.roots == ("r0",)
+        assert set(ir.consumers) == {n.id for n in ir.nodes}
+        hops = {e.hop for e in ir.edges}
+        assert hops == {
+            "intra.reduce", "inter.exchange", "inter.gather", "intra.bcast",
+        }
+        # only the broadcast leg is a distribution-tree edge
+        assert all(
+            e.tree == (e.hop == "intra.bcast") for e in ir.edges
+        )
+        # inter-leader legs move one slice; intra legs the whole bundle
+        for e in ir.edges:
+            if e.hop.startswith("inter."):
+                assert e.nbytes == 64
+            else:
+                assert e.nbytes == 64 * 3
+
+    def test_coverage_tiles_for_every_rank(self):
+        topo = T.parse_topology("hosts:2", 6)
+        ir = pir.reduction_ir(topo, slice_nbytes=64)
+        for rank in range(6):
+            spans = sorted(
+                (o.lo, o.hi) for o in ir.coverage if o.consumer == f"r{rank}"
+            )
+            covered = set()
+            for lo, hi in spans:
+                covered.update(range(lo, hi))
+            assert covered == set(range(ir.units)), f"r{rank}"
+
+    def test_verifies_clean_including_single_host(self):
+        for spec, world in (("hosts:2", 6), ("hosts:1", 5), ("hosts:4", 4),
+                            ("0,1;2,3,4", 5)):
+            topo = T.parse_topology(spec, world)
+            ir = pir.reduction_ir(topo, slice_nbytes=64)
+            assert pv.verify_plan(ir) == [], (spec, world)
+
+
+class TestServingIR:
+    def test_reference_doc_round_trips_to_ir(self):
+        ir = pv.base_serving_ir()
+        assert ir.plane == "serving" and ir.units == 1
+        assert ir.roots == ("pub:p0",)
+        assert ir.fanout == 2 and ir.epoch == 3
+        # s0 carries its capacity override into the node
+        assert ir.node("s0").capacity == 3
+        relays = [e for e in ir.edges if e.hop == "serving.relay"]
+        sources = [e for e in ir.edges if e.hop == "serving.source"]
+        assert len(relays) == 6 and len(sources) == 1
+        assert sources[0].src == "pub:p0" and sources[0].dst == "s0"
+        # capacity-3 root takes three children under fanout 2
+        assert sorted(e.dst for e in relays if e.src == "s0") == [
+            "s1", "s2", "s3",
+        ]
+        assert pv.verify_plan(ir) == []
+
+    def test_no_publisher_root_holds_local(self):
+        members = [
+            {"replica_id": f"s{i}", "address": f"http://s{i}:1",
+             "role": "server"}
+            for i in range(3)
+        ]
+        doc = pir.reference_serving_plan(members, fanout=2)
+        ir = pir.serving_ir(doc)
+        assert ir.roots == ("s0",)
+        (own,) = [o for o in ir.coverage if o.consumer == "s0"]
+        assert own.via == ""  # root serves whatever it already holds
+        assert pv.verify_plan(ir) == []
+
+    def test_empty_membership_is_a_valid_plan(self):
+        ir = pir.serving_ir(pir.reference_serving_plan([], fanout=2))
+        assert ir.nodes == () and pv.verify_plan(ir) == []
+
+
+class TestStripeIR:
+    def test_nominal_assignment_round_robin(self):
+        ir = pv.base_stripe_ir(num_fragments=6, num_leaves=17)
+        assert ir.plane == "stripe" and ir.unit == "leaf" and ir.units == 17
+        assert ir.node("http://src0:1").role == "primary"
+        assert ir.consumers == ("healer",)
+        # exactly one tree edge: the primary's (manifest-defining) leg
+        tree = [e for e in ir.edges if e.tree]
+        assert [e.src for e in tree] == ["http://src0:1"]
+        assert tree[0].hop == "heal.primary"
+        # fragment f's slots ride via sources[f % len(sources)]
+        for o in ir.coverage:
+            frag = o.lo % 6
+            assert o.via == f"http://src{frag % 4}:1"
+        assert pv.verify_plan(ir) == []
+
+    def test_empty_sources_rejected(self):
+        with pytest.raises(ValueError, match="no sources"):
+            pir.stripe_ir([], 2, 8)
+
+    def test_primary_cannot_fail_over(self):
+        ir = pv.base_stripe_ir()
+        with pytest.raises(ValueError, match="primary"):
+            pir.stripe_reassign(ir, "http://src0:1")
+
+
+class TestMalformedIR:
+    def test_dangling_edge_raises(self):
+        ir = pv.base_serving_ir()
+        bad = dataclasses.replace(ir, edges=ir.edges + (
+            pir.PlanEdge("s0", "ghost", "serving.relay"),
+        ))
+        with pytest.raises(ValueError, match="unknown node"):
+            pv.verify_plan(bad)
+
+    def test_out_of_range_ownership_raises(self):
+        ir = pv.base_stripe_ir()
+        bad = dataclasses.replace(ir, coverage=ir.coverage + (
+            pir.Ownership("healer", 0, ir.units + 1),
+        ))
+        with pytest.raises(ValueError, match="out of"):
+            pv.verify_plan(bad)
+
+    def test_node_lookup(self):
+        ir = pv.base_serving_ir()
+        assert ir.node("s3").id == "s3"
+        with pytest.raises(KeyError):
+            ir.node("nope")
+
+
+# ---------------------------------------------------------------------------
+# Seeded plan mutations: each caught by its NAMED invariant
+# ---------------------------------------------------------------------------
+
+
+class TestPlanMutations:
+    @pytest.mark.parametrize(
+        "mut", pv.PLAN_MUTATIONS, ids=[m.name for m in pv.PLAN_MUTATIONS]
+    )
+    def test_mutation_caught_by_named_invariant(self, mut):
+        violations = pv.check_plan_mutation(mut.name)
+        assert violations, f"{mut.name} slipped past the verifier"
+        assert violations[0].invariant == mut.catches, (
+            f"{mut.name}: first violation is {violations[0].invariant}, "
+            f"expected {mut.catches}"
+        )
+
+    def test_every_invariant_exercised(self):
+        assert {m.catches for m in pv.PLAN_MUTATIONS} == set(pv.INVARIANTS)
+
+    def test_unknown_mutation_rejected(self):
+        with pytest.raises(KeyError):
+            pv.check_plan_mutation("no_such_bug")
+
+    def test_base_plans_are_clean(self):
+        # the mutation catalog only proves anything if its bases verify
+        assert pv.verify_plan(pv.base_serving_ir()) == []
+        assert pv.verify_plan(pv.base_reduction_ir()) == []
+        assert pv.verify_plan(pv.base_stripe_ir()) == []
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive small-world enumeration + elastic stability
+# ---------------------------------------------------------------------------
+
+
+class TestEnumeration:
+    def test_all_small_world_plans_verify_clean(self):
+        result = pv.explore_plans()
+        assert result["violations"] == []
+        assert result["plans"] > 500  # the space must stay meaningfully big
+
+    def test_hosts_k_elastic_stability(self):
+        for k in range(1, 6):
+            assert pv.elastic_stability(f"hosts:{k}", range(1, 10)) == []
+
+    def test_drifting_assignment_is_flagged(self):
+        violations = pv.check_plan_mutation("rerank_drift")
+        assert violations and all(
+            v.invariant == "elastic-stability" for v in violations
+        )
+
+
+# ---------------------------------------------------------------------------
+# Stripe property tests (satellite: disjoint exhaustive ranges under any
+# roster x TORCHFT_HEAL_SOURCES x fragment-count, survives failover)
+# ---------------------------------------------------------------------------
+
+
+def _random_participants(rng, n, max_step):
+    out = []
+    for i in range(n):
+        step = max_step if rng.random() < 0.7 else max_step - rng.randint(1, 3)
+        p = {
+            "replica_id": f"rep{i}",
+            "address": f"http://rep{i}:8470" if rng.random() < 0.9 else "",
+            "step": step,
+        }
+        if rng.random() < 0.05:
+            p = "corrupt-entry"  # roster math must skip non-dict junk
+        out.append(p)
+    return out
+
+
+class TestStripeProperties:
+    def test_grid_disjoint_and_exhaustive(self):
+        for nsrc in range(1, 6):
+            sources = [f"http://s{i}:1" for i in range(nsrc)]
+            for nfrag in (1, 2, 3, 5, 8):
+                for leaves in (1, 2, 5, 13):
+                    ir = pir.stripe_ir(sources, nfrag, leaves)
+                    owned = []
+                    for o in ir.coverage:
+                        owned.extend(range(o.lo, o.hi))
+                    # disjoint AND exhaustive over the leaf space
+                    assert sorted(owned) == list(range(leaves)), (
+                        nsrc, nfrag, leaves,
+                    )
+                    assert pv.verify_plan(ir) == []
+
+    def test_random_rosters_and_failover_requeue(self):
+        rng = random.Random(19)
+        for _ in range(60):
+            n = rng.randint(1, 10)
+            max_step = rng.randint(5, 50)
+            parts = _random_participants(rng, n, max_step)
+            max_sources = rng.randint(1, 6)
+            primary_index = rng.randrange(n)
+            roster = pir.stripe_roster(
+                parts, max_step, primary_index, max_sources
+            )
+            # the bound check runs after the append (faithful port of the
+            # manager's historical loop), so max_sources=1 still admits
+            # one extra candidate
+            assert len(roster) <= max(1, max_sources - 1)
+            for addr in roster:
+                i = next(
+                    j for j, p in enumerate(parts)
+                    if isinstance(p, dict) and p.get("address") == addr
+                )
+                assert i != primary_index
+                assert parts[i]["step"] == max_step
+            primary = "http://primary:1"
+            sources = [primary] + roster
+            nfrag = rng.randint(1, 9)
+            leaves = rng.randint(1, 40)
+            ir = pir.stripe_ir(sources, nfrag, leaves, step=max_step)
+            assert pv.verify_plan(ir) == []
+            # every per-fragment failover requeue must still verify
+            for dead in sources[1:]:
+                assert pv.verify_plan(pir.stripe_reassign(ir, dead)) == []
+
+    def test_cohort_is_first_k_max_step(self):
+        parts = [
+            {"replica_id": "a", "step": 9},
+            {"replica_id": "b", "step": 8},
+            {"replica_id": "c", "step": 9},
+            "garbage",
+            {"replica_id": "d", "step": 9},
+        ]
+        assert pir.stripe_source_cohort(parts, 9, 2) == ["a", "c"]
+        assert pir.stripe_source_cohort(parts, 9, 10) == ["a", "c", "d"]
+
+    def test_manager_consumes_the_one_copy_of_the_math(self):
+        # the healer and the verifier share stripe_roster/_source_cohort;
+        # a reintroduced inline copy in manager.py is how they drift
+        import inspect
+
+        from torchft_tpu.manager import Manager
+
+        assert "stripe_roster" in inspect.getsource(
+            Manager._resolve_stripe_sources
+        )
+        assert "stripe_source_cohort" in inspect.getsource(
+            Manager._in_stripe_source_set
+        )
+
+
+# ---------------------------------------------------------------------------
+# Cross-language serving-tree parity (satellite: native BFS == reference)
+# ---------------------------------------------------------------------------
+
+
+def _native_members(plan):
+    """Reconstruct the membership the native BFS saw from its output."""
+    members = [
+        {"replica_id": n["replica_id"], "address": n["address"],
+         "role": "server", "capacity": n["capacity"],
+         "version": n["version"]}
+        for n in plan["nodes"]
+    ]
+    members.extend(
+        {"replica_id": p["replica_id"], "address": p["address"],
+         "role": "publisher", "version": p["version"],
+         "version_ms": p["version_ms"]}
+        for p in plan["publishers"]
+    )
+    return members
+
+
+def _assert_parity(plan):
+    ref = pir.reference_serving_plan(
+        _native_members(plan), plan["fanout"], epoch=plan["epoch"]
+    )
+    assert ref["root_source"] == plan["root_source"]
+    assert ref["depth"] == plan["depth"]
+    by_id = {n["replica_id"]: n for n in plan["nodes"]}
+    assert len(by_id) == len(ref["nodes"])
+    for rn in ref["nodes"]:
+        nn = by_id[rn["replica_id"]]
+        for key in ("parent", "depth", "children", "capacity"):
+            assert rn[key] == nn[key], (rn["replica_id"], key)
+    # and the two docs adapt to the SAME IR (edge-for-edge)
+    a, b = pir.serving_ir(plan), pir.serving_ir(ref)
+    assert set(a.edges) == set(b.edges)
+    assert set(a.coverage) == set(b.coverage)
+    assert a.roots == b.roots
+    assert pv.verify_plan(a) == []
+
+
+class TestServingTreeParity:
+    def test_fanout_tree_parity(self):
+        with LighthouseServer(min_replicas=1, serving_fanout=2) as server:
+            c = LighthouseClient(server.address())
+            c.serving_heartbeat("pub", "http://p:1", role="publisher",
+                                version=3)
+            for i in range(7):
+                c.serving_heartbeat(f"s{i}", f"http://s{i}:1", role="server")
+            _assert_parity(c.serving_plan())
+
+    def test_capacity_override_parity(self):
+        with LighthouseServer(min_replicas=1, serving_fanout=2) as server:
+            c = LighthouseClient(server.address())
+            c.serving_heartbeat("s0", "http://s0:1", role="server",
+                                capacity=4)
+            for i in range(1, 6):
+                c.serving_heartbeat(f"s{i}", f"http://s{i}:1", role="server")
+            plan = c.serving_plan()
+            _assert_parity(plan)
+            root = [n for n in plan["nodes"] if n["parent"] == ""][0]
+            assert root["children"] == 4  # capacity beat the fanout on BOTH sides
+
+    def test_expiry_parity(self):
+        with LighthouseServer(
+            min_replicas=1, heartbeat_timeout_ms=300, quorum_tick_ms=50
+        ) as server:
+            c = LighthouseClient(server.address())
+            c.serving_heartbeat("a", "http://a:1", role="server")
+            c.serving_heartbeat("b", "http://b:1", role="server")
+
+            def b_expired():
+                c.serving_heartbeat("a", "http://a:1", role="server")
+                plan = c.serving_plan()
+                return [n["replica_id"] for n in plan["nodes"]] == ["a"]
+
+            _wait_until(b_expired, msg="node b to expire from the tree")
+            _assert_parity(c.serving_plan())
+
+    def test_version_tie_first_in_order_wins(self):
+        # strict > in both implementations: equal versions keep the
+        # first publisher in replica_id order as root source
+        members = [
+            {"replica_id": "pz", "address": "http://z:1",
+             "role": "publisher", "version": 7},
+            {"replica_id": "pa", "address": "http://a:1",
+             "role": "publisher", "version": 7},
+        ]
+        ref = pir.reference_serving_plan(members, fanout=2)
+        assert ref["root_source"] == "http://a:1"
+
+
+# ---------------------------------------------------------------------------
+# Runtime hook: TORCHFT_PLAN_VERIFY
+# ---------------------------------------------------------------------------
+
+
+class TestLiveHook:
+    def test_enabled_reads_env(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_PLAN_VERIFY", raising=False)
+        assert not pv.enabled()
+        monkeypatch.setenv("TORCHFT_PLAN_VERIFY", "1")
+        assert pv.enabled()
+        monkeypatch.setenv("TORCHFT_PLAN_VERIFY", "0")
+        assert not pv.enabled()
+
+    def test_accept_counts_and_flight_record(self):
+        before = _count("serving", "accept")
+        assert pv.check_live(pv.base_serving_ir()) is None
+        assert _count("serving", "accept") == before + 1
+        recs = [
+            r for r in fr.RECORDER.snapshot()
+            if r["op"] == "plan.verify" and r.get("plane") == "serving"
+        ]
+        assert recs and recs[-1]["verdict"] == "accept"
+        assert recs[-1]["status"] == "ok" and recs[-1]["step"] == 3
+
+    def test_reject_counts_records_and_logs(self, caplog):
+        ir = pv.base_serving_ir()
+        bad = dataclasses.replace(ir, edges=tuple(
+            e for e in ir.edges if not (e.src == "s0" and e.dst == "s1")
+        ))
+        before = _count("serving", "reject")
+        with caplog.at_level(logging.ERROR, logger=pv.logger.name):
+            first = pv.check_live(bad)
+        assert first is not None
+        assert first.invariant == "root-reaches-all"
+        assert _count("serving", "reject") == before + 1
+        assert any(
+            "rejected live serving plan" in r.message for r in caplog.records
+        )
+        recs = [
+            r for r in fr.RECORDER.snapshot()
+            if r["op"] == "plan.verify" and r.get("verdict") == "reject"
+        ]
+        assert recs and recs[-1]["status"] == "error"
+        assert recs[-1]["invariant"] == "root-reaches-all"
+
+    def test_malformed_ir_never_raises_into_commit_path(self):
+        ir = pv.base_serving_ir()
+        bad = dataclasses.replace(ir, edges=ir.edges + (
+            pir.PlanEdge("s0", "ghost", "serving.relay"),
+        ))
+        before = _count("serving", "error")
+        assert pv.check_live(bad) is None  # observe-only: swallowed, counted
+        assert _count("serving", "error") == before + 1
+
+
+class TestDiagnoseBadPlan:
+    def _dump(self, tmp_path, recs):
+        path = tmp_path / "flight.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        entries, _ = diagnose.load_records([str(path)])
+        return diagnose.analyze(entries)
+
+    def test_rejected_plan_named_as_culprit(self, tmp_path):
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        report = self._dump(tmp_path, [
+            {"flight": "rec", "op": "quorum_rpc", "status": "ok",
+             "start_ns": t0, "end_ns": t0 + s, "replica_id": "a", "step": 4},
+            {"flight": "rec", "op": "plan.verify", "status": "error",
+             "start_ns": t0 + 2 * s, "end_ns": t0 + 2 * s,
+             "replica_id": "a", "step": 4, "plane": "serving",
+             "verdict": "reject", "invariant": "root-reaches-all",
+             "detail": "2 node(s) unreachable from roots"},
+        ])
+        culprit = report["culprit"]
+        assert culprit["signal"] == "bad_plan"
+        assert culprit["replica_id"] == "a"
+        assert "root-reaches-all" in culprit["reason"]
+        assert "serving" in culprit["reason"]
+
+    def test_injected_fault_still_outranks_bad_plan(self, tmp_path):
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        report = self._dump(tmp_path, [
+            {"flight": "rec", "op": "fault", "status": "fault",
+             "start_ns": t0, "end_ns": t0, "replica_id": "b", "step": 2,
+             "fault": "train.step:raise", "site": "train.step",
+             "action": "raise"},
+            {"flight": "rec", "op": "plan.verify", "status": "error",
+             "start_ns": t0 + s, "end_ns": t0 + s, "replica_id": "a",
+             "step": 2, "plane": "stripe", "verdict": "reject",
+             "invariant": "full-coverage", "detail": "gap"},
+            {"flight": "rec", "op": "allreduce", "status": "error",
+             "start_ns": t0 + 2 * s, "end_ns": t0 + 3 * s,
+             "replica_id": "a", "step": 2, "reason": "peer closed"},
+        ])
+        assert report["culprit"]["signal"] == "injected_fault"
+
+    def test_accepted_plans_never_name_a_culprit(self, tmp_path):
+        s = 1_000_000_000
+        t0 = 1_000 * s
+        report = self._dump(tmp_path, [
+            {"flight": "rec", "op": "plan.verify", "status": "ok",
+             "start_ns": t0, "end_ns": t0, "replica_id": "a", "step": 1,
+             "plane": "reduction", "verdict": "accept", "invariant": "",
+             "detail": ""},
+        ])
+        assert report["culprit"] is None
+
+
+# ---------------------------------------------------------------------------
+# Live integration: real plans, zero rejections (the tier-1 gate; the
+# conftest-wide TORCHFT_PLAN_VERIFY=1 arming makes the whole suite an
+# extended version of this test)
+# ---------------------------------------------------------------------------
+
+
+class TestLiveZeroRejections:
+    def test_hier_allreduce_plans_accepted(self, store, monkeypatch):  # noqa: F811
+        monkeypatch.setenv("TORCHFT_PLAN_VERIFY", "1")
+        accept0 = _count("reduction", "accept")
+        reject0 = _count("reduction", "reject")
+        world = 4
+        pgs = make_group(store, world, prefix="planverify")
+        try:
+            data = [
+                np.arange(24, dtype=np.float32).reshape(4, 6) + r
+                for r in range(world)
+            ]
+
+            def run(rank, _):
+                w = allreduce_quantized(
+                    data[rank], REDUCE_SUM, pgs[rank], topology="hosts:2"
+                )
+                return w.wait(timeout=60)
+
+            results = run_parallel(world, run)
+            assert len(results) == world
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+        # every rank validated its live reduction plan; none rejected
+        assert _count("reduction", "accept") - accept0 >= world
+        assert _count("reduction", "reject") == reject0
+
+    def test_serving_round_plans_accepted(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_PLAN_VERIFY", "1")
+        accept0 = _count("serving", "accept")
+        reject0 = _count("serving", "reject")
+        rng = np.random.RandomState(7)
+        sd = {"w": rng.randn(16, 32).astype(np.float32), "step": 1}
+        lh = LighthouseServer(
+            min_replicas=1, heartbeat_timeout_ms=1000, quorum_tick_ms=50,
+            serving_fanout=2,
+        )
+        pub = WeightPublisher(
+            lh.address(), wire="int8", fragments=2, heartbeat_interval=0.1
+        )
+        reps = [
+            ServingReplica(
+                lh.address(), replica_id=f"pv{i}", poll_interval=0.05,
+                fetch_timeout=10.0,
+            )
+            for i in range(2)
+        ]
+        client = ServingClient(lh.address(), plan_ttl=0.1)
+        try:
+            v = pub.publish(sd)
+            _state, got = client.fetch(timeout=20)
+            assert got == v
+            _wait_until(
+                lambda: all(r.version() == v for r in reps),
+                msg="relays converged",
+            )
+        finally:
+            client.close()
+            for r in reps:
+                r.shutdown()
+            pub.shutdown()
+            lh.shutdown()
+        # every tree_commit validated its live serving plan; none rejected
+        assert _count("serving", "accept") > accept0
+        assert _count("serving", "reject") == reject0
+
+    def test_no_stripe_rejections_so_far(self):
+        # heal integration tests run with the hook armed suite-wide;
+        # whatever has executed by now must not have rejected a plan
+        assert _count("stripe", "reject") == 0

@@ -30,6 +30,11 @@ file for grandfathered findings — all empty):
 ``span-vocab``            trace-span names from PROTOCOL_PHASES /
                           quant.* / heal.* / rpc.*; every span emitter
                           also feeds the flight recorder
+``plan-discipline``       peer-communication structure (reduction
+                          hierarchies, serving trees, stripe rosters)
+                          built only via the plan layer's primitives in
+                          bless-listed modules — plans stay verifiable
+                          data (tft-verify --scenario plan)
 ========================  ==================================================
 
 The runtime complement is ``utils/lockcheck.py`` (TORCHFT_LOCKCHECK=1
@@ -56,6 +61,7 @@ from torchft_tpu.analysis.env_hygiene import PASS as _env_hygiene
 from torchft_tpu.analysis.lock_discipline import PASS as _lock_discipline
 from torchft_tpu.analysis.metrics_cardinality import PASS as _metrics_cardinality
 from torchft_tpu.analysis.metrics_sync import PASS as _metrics_sync
+from torchft_tpu.analysis.plan_discipline import PASS as _plan_discipline
 from torchft_tpu.analysis.retry_ban import PASS as _retry_ban
 from torchft_tpu.analysis.span_vocab import PASS as _span_vocab
 from torchft_tpu.analysis.wire_schema import PASS as _wire_drift
@@ -70,6 +76,7 @@ PASSES = (
     _coverage,
     _wire_drift,
     _span_vocab,
+    _plan_discipline,
 )
 
 __all__ = [

@@ -28,6 +28,7 @@ import time
 from typing import List, Optional, Sequence
 
 from torchft_tpu.analysis import model_checker as mc
+from torchft_tpu.analysis import plan_verify as pv
 from torchft_tpu.analysis import wire_schema as ws
 from torchft_tpu.analysis.core import SelftestError
 from torchft_tpu.analysis.protocol_model import MUTATIONS
@@ -116,6 +117,37 @@ def run_mutation_gate(verbose: bool = False) -> int:
     return 1 if missed else 0
 
 
+def run_plan_gate(verbose: bool = False) -> int:
+    """The tft-plan scenario (ISSUE 19): exhaustive small-world plan
+    enumeration on all three planes must verify clean, and every seeded
+    plan mutation must be caught by its named invariant."""
+    bad = 0
+    t0 = time.monotonic()
+    r = pv.explore_plans()
+    violations = r["violations"]
+    print(f"{'plan':12s} {'ok' if not violations else 'VIOLATION':9s} "
+          f"plans={r['plans']} invariants={len(pv.INVARIANTS)} "
+          f"({time.monotonic() - t0:.1f}s)")
+    if violations:
+        bad += 1
+        for v in violations[: 20 if verbose else 5]:
+            print(f"  invariant {v.invariant} violated at {v.subject}: "
+                  f"{v.message}")
+    for m in pv.PLAN_MUTATIONS:
+        vs = pv.check_plan_mutation(m.name)
+        got = vs[0].invariant if vs else "clean"
+        caught = got == m.catches
+        print(f"plan mutation {m.name:18s} "
+              f"{'caught' if caught else 'MISSED'} "
+              f"(expect {m.catches}, got {got})")
+        if not caught:
+            bad += 1
+        elif verbose:
+            for v in vs[:3]:
+                print(f"    {v.invariant}: {v.message}")
+    return 1 if bad else 0
+
+
 def run_liveness(verbose: bool = False) -> int:
     stuck = 0
     for name, scenario, rotation in mc.LIVENESS_SCHEDULES:
@@ -197,6 +229,17 @@ def run_selftest() -> int:
     except SelftestError as e:
         print(f"selftest wire-drift: FAIL — {e}", file=sys.stderr)
         rc = 2
+    missed_plan = sum(
+        1
+        for m in pv.PLAN_MUTATIONS
+        if (lambda vs: not vs or vs[0].invariant != m.catches)(
+            pv.check_plan_mutation(m.name)
+        )
+    )
+    print(f"selftest plan mutations: "
+          f"{'ok' if not missed_plan else f'{missed_plan} MISSED'}")
+    if missed_plan:
+        rc = 2
     return 2 if rc else 0
 
 
@@ -240,8 +283,12 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
             print(f"scenario {name:12s} {ecfg}")
         for name, scfg in mc.RESTORE_SCENARIOS.items():
             print(f"scenario {name:12s} {scfg}")
+        print(f"scenario {'plan':12s} topology-plan IR enumeration + "
+              f"mutation gate (reduction/serving/stripe)")
         for m in MUTATIONS:
             print(f"mutation {m.name:26s} -> {m.catches}: {m.doc}")
+        for pm in pv.PLAN_MUTATIONS:
+            print(f"plan mutation {pm.name:21s} -> {pm.catches}: {pm.doc}")
         for name, scenario, rotation in mc.LIVENESS_SCHEDULES:
             print(f"schedule {name:12s} scenario={scenario} "
                   f"rotation={rotation}")
@@ -265,6 +312,8 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
                   f"(render: torchft-diagnose {args.dump})")
         return 1 if not r.ok else 0
     if args.scenario:
+        if args.scenario == "plan":
+            return run_plan_gate(args.verbose)
         if args.scenario in mc.RESIZE_SCENARIOS:
             r = mc.explore_resize(mc.RESIZE_SCENARIOS[args.scenario])
             _print_result(args.scenario, r, args.verbose)
@@ -285,10 +334,11 @@ def main(argv: "Optional[Sequence[str]]" = None) -> int:
         _print_result(args.scenario, r, args.verbose)
         return 0 if r.ok else 1
 
-    # the full gate: exploration + mutations + liveness + drift
+    # the full gate: exploration + mutations + liveness + plans + drift
     rc = run_explore_all(args.verbose)
     rc = run_mutation_gate(args.verbose) or rc
     rc = run_liveness(args.verbose) or rc
+    rc = run_plan_gate(args.verbose) or rc
     rc = run_drift(_detect_root(args.root)) or rc
     return rc
 

@@ -785,6 +785,20 @@ class HTTPTransport(CheckpointTransport[Any]):
             names = [str(n) for n in manifest["fragments"]]
             num_leaves = int(manifest["num_leaves"])
 
+            # TORCHFT_PLAN_VERIFY: the stripe assignment is a plan —
+            # validate its coverage (disjoint, exhaustive round-robin
+            # leaf ranges across the resolved sources) before any
+            # fragment goes on the wire.
+            from torchft_tpu.analysis import plan_verify as _pv
+
+            if _pv.enabled():
+                from torchft_tpu.analysis import plan_ir as _pir
+
+                _pv.check_live(
+                    _pir.stripe_ir(sources, len(names), num_leaves,
+                                   step=step)
+                )
+
             # -- diff phase: hash the local state into the source's
             # fragment layout; identical digests need no wire at all.
             t0 = time.perf_counter()

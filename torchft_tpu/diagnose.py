@@ -527,6 +527,28 @@ def analyze(entries: "List[Dict[str, Any]]") -> "Dict[str, Any]":
                     "signal": "injected_fault",
                 }
                 break
+    # 1b) rejected live plan: a ``plan.verify`` record with a reject
+    #     verdict (TORCHFT_PLAN_VERIFY) names the exact invariant a
+    #     synthesized topology plan violated at its commit point — far
+    #     more specific than any death/straggler inference, so it
+    #     outranks everything except an injected fault.
+    if culprit is None:
+        for e in reversed(entries):
+            if e["op"] != "plan.verify":
+                continue
+            if e["fields"].get("verdict") != "reject":
+                continue
+            culprit = {
+                "replica_id": e["replica_id"] or "(unknown)",
+                "reason": (
+                    f"rejected live {e['fields'].get('plane', '?')} plan "
+                    f"(epoch {e.get('step')}): invariant "
+                    f"{e['fields'].get('invariant', '?')} violated — "
+                    f"{e['fields'].get('detail', '')}"
+                ),
+                "signal": "bad_plan",
+            }
+            break
     # 2) silent death: a replica whose records stop earliest while peers
     #    kept producing evidence afterwards.  Only with a failure
     #    signature on the table — staggered shutdown of a HEALTHY run

@@ -1024,16 +1024,16 @@ class Manager:
         ``TORCHFT_HEAL_SOURCES`` max-step participants in roster order —
         the superset every healer's ``_resolve_stripe_sources`` pick
         (first ``max_sources - 1`` entries after excluding its primary)
-        can reach, computed from the same roster on every peer."""
+        can reach, computed from the same roster on every peer — via
+        the plan layer's one copy of the first-K math (ISSUE 19:
+        ``tft-verify --scenario plan`` checks the structure this
+        produces)."""
+        from torchft_tpu.analysis.plan_ir import stripe_source_cohort
+
         max_sources = env_int("TORCHFT_HEAL_SOURCES", 4, minimum=1)
-        pos = 0
-        for p in quorum.participants:
-            if not isinstance(p, dict) or p.get("step") != quorum.max_step:
-                continue
-            if p.get("replica_id") == self._replica_id:
-                return pos < max_sources
-            pos += 1
-        return False
+        return self._replica_id in stripe_source_cohort(
+            quorum.participants, quorum.max_step, max_sources
+        )
 
     def _resolve_stripe_sources(
         self, quorum: Any, primary_metadata: str
@@ -1049,21 +1049,19 @@ class Manager:
         ``checkpoint_metadata`` RPC (the same discovery heal and reshard
         use), in parallel and best-effort: an unreachable peer just
         shrinks the stripe.  Bounded by ``TORCHFT_HEAL_SOURCES``
-        (total sources including the primary)."""
+        (total sources including the primary).  The candidate pick is
+        the plan layer's :func:`~torchft_tpu.analysis.plan_ir.
+        stripe_roster` — the same math the tft-plan verifier and the
+        source-side cohort test consume."""
+        from torchft_tpu.analysis.plan_ir import stripe_roster
+
         max_sources = env_int("TORCHFT_HEAL_SOURCES", 4, minimum=1)
-        candidates: "List[str]" = []
-        for i, p in enumerate(quorum.participants):
-            if not isinstance(p, dict):
-                continue
-            if i == quorum.recover_src_replica_rank:
-                continue
-            if p.get("step", -1) != quorum.max_step:
-                continue
-            addr = p.get("address") or ""
-            if addr:
-                candidates.append(addr)
-            if len(candidates) >= max_sources - 1:
-                break
+        candidates = stripe_roster(
+            quorum.participants,
+            quorum.max_step,
+            quorum.recover_src_replica_rank,
+            max_sources,
+        )
         if not candidates:
             return []
 

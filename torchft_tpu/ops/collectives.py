@@ -1504,6 +1504,17 @@ def _allreduce_hier(
     slice_rows = rows // m
     chunks = _chunk_bounds(slice_rows, _resolve_chunk_rows(slice_rows, cols))
     plan = _topo.synthesize_plan(topo, rank)
+    # TORCHFT_PLAN_VERIFY: validate the fleet-wide plan this rank's
+    # schedule is a slice of, at the one build point every rank passes.
+    from torchft_tpu.analysis import plan_verify as _pv
+
+    if _pv.enabled():
+        from torchft_tpu.analysis import plan_ir as _pir
+
+        _pv.check_live(
+            _pir.reduction_ir(topo, wire=wire_dtype,
+                              slice_nbytes=slice_rows * cols)
+        )
     # The full output matrix escapes to the caller as views — never pooled.
     full_mat = np.empty((rows, cols), dtype=np.float32)
     pipe = _HierPipeline(

@@ -226,6 +226,14 @@ class ServingReplica:
         _faults.check(
             "serving.tree_commit", replica=self._replica_id, step=epoch
         )
+        # TORCHFT_PLAN_VERIFY: the lighthouse's BFS tree is a synthesized
+        # plan — validate it at the commit point before adopting.
+        from torchft_tpu.analysis import plan_verify as _pv
+
+        if _pv.enabled():
+            from torchft_tpu.analysis import plan_ir as _pir
+
+            _pv.check_live(_pir.serving_ir(plan))
         t0_ns = time.time_ns()
         me = None
         peers: "List[str]" = []

@@ -964,6 +964,14 @@ HEAL_CHANGED_FRAGMENTS = gauge(
     "diff vs the rejoiner's own state); equals the fragment count on "
     "a full heal",
 )
+PLAN_VERIFY_TOTAL = counter(
+    "torchft_plan_verify_total",
+    "Live topology plans validated at their commit point under "
+    "TORCHFT_PLAN_VERIFY, by plane (reduction/serving/stripe) and "
+    "verdict (accept/reject/error) — any reject is a synthesized plan "
+    "that violated a named invariant (see tft-verify --scenario plan)",
+    ("plane", "verdict"),
+)
 STORE_SPILL_BYTES = counter(
     "torchft_store_spill_bytes_total",
     "Fragment bytes newly written by the durable store spill path "
