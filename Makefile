@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: native verify lint typecheck plan-verify test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore fragments-smoke
+.PHONY: native verify lint typecheck plan-verify test tier1 bench-wan trace-smoke reshard-smoke serve-smoke bench-serving bench-serving-depth bench-serving-native serve-soak ha-smoke bench-ha heal-smoke bench-heal links-smoke cold-restore-smoke bench-cold-restore fragments-smoke
 
 native:
 	$(MAKE) -C native
@@ -81,6 +81,16 @@ bench-serving:
 # bench.
 bench-serving-depth:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving-depth
+
+# Native-vs-python fragment data plane (ISSUE 20): same shaped relay
+# chain as bench-serving-depth at depth {3,4} x RTT {0,10} ms, each
+# cell run once with TORCHFT_FRAG_NATIVE=0 (pure Python HTTP plane)
+# and once =1 (C++ writev serve / GIL-free receive), plus a striped
+# heal leg; reports per-plane publish->leaf p50/p99, bitwise payload
+# equality, native serve/fallback counters, and the p99 speedup
+# headline recorded in docs/benchmarks.md §9.
+bench-serving-native:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --serving-native
 
 # Coordination-plane HA round trip alone: 3 lighthouse subprocesses,
 # SIGKILL the active leader mid-quorum-round and mid-serving-fetch —

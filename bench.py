@@ -1999,24 +1999,58 @@ SERVING_DEPTH_PUBLISHES = 4     # measured publishes per config (+1 warm)
 SERVING_DEPTH_PARALLEL = 8      # in-flight frag window: overlap all RTTs
 
 
+def _staged_raw_frags(transport, step: int) -> "Dict[str, bytes]":
+    """Raw wire bytes of every ``frag:*`` payload staged at ``step`` —
+    the bitwise ground truth both data planes must serve verbatim."""
+    from torchft_tpu.checkpointing import serialization as _ser
+
+    out: "Dict[str, bytes]" = {}
+    with transport._staged_lock.r_lock(timeout=10.0):
+        rec = transport._staged.get(step)
+        sd = dict(rec.sd) if rec is not None else {}
+    for k, v in sd.items():
+        if isinstance(k, str) and k.startswith("frag:"):
+            mv = _ser.raw_view(v)
+            if mv is not None:
+                out[k] = bytes(mv)
+    return out
+
+
 def _serving_depth_trial(
-    base: "Dict[str, np.ndarray]", depth: int, stream: bool
+    base: "Dict[str, np.ndarray]", depth: int, stream: bool,
+    plane_info: "Optional[Dict[str, Any]]" = None,
+    warm_publishes: int = 1,
 ) -> "Tuple[List[float], List[float]]":
     """One (depth, mode) config: a fanout-1 CHAIN of ``depth`` relays;
     returns (full-change publish->leaf latencies, single-fragment delta
     latencies, publish-stamp staleness at leaf convergence) in seconds.
     publish->leaf = publish() call to the LEAF relay holding the
-    version complete."""
+    version complete.
+
+    When ``plane_info`` is a dict (the native data-plane comparison,
+    ISSUE 20), it is filled with acceptance evidence before teardown:
+    ``bitwise_payload`` (the leaf's staged fragment bytes == the
+    publisher's, byte for byte), ``digest_rejects`` (provenance
+    ``mismatch`` hops — a failed fetch the chain had to heal around),
+    ``native_fallbacks`` (raw fetches that fell off the native plane
+    mid-trial), and the chain-wide native ``serves``/``serve_copies``
+    counters proving which plane actually moved the bytes."""
     from torchft_tpu.checkpointing import provenance as _prov
     from torchft_tpu.serving import ServingReplica, WeightPublisher
+    from torchft_tpu.utils import flightrecorder as _flightrec
 
     _prov.PROV.reset()  # per-trial hop ring: versions restart at 1
+    fallbacks0 = sum(
+        1
+        for r in _flightrec.snapshot()
+        if r.get("op") == "fragment.native_fallback"
+    )
     lh = LighthouseServer(
         min_replicas=1, heartbeat_timeout_ms=3000, quorum_tick_ms=50,
         serving_fanout=1,
     )
     pub = WeightPublisher(
-        lh.address(), wire="f32", fragments=SERVING_DEPTH_LEAVES,
+        lh.address(), wire="f32", fragments=len(base),
         heartbeat_interval=0.05,
     )
     reps = [
@@ -2093,16 +2127,57 @@ def _serving_depth_trial(
                     )
             return dt
 
-        for t in range(SERVING_DEPTH_PUBLISHES + 1):
+        for t in range(SERVING_DEPTH_PUBLISHES + warm_publishes):
             # every leaf changes: the full payload moves each publish
             state = {k: a + np.float32(t + 1) for k, a in base.items()}
             dt = _publish_and_wait(state)
-            if t > 0:  # first publish warms the chain/tree
+            # warm publishes prime the chain/tree; callers measuring
+            # steady-state serving (the native data-plane comparison)
+            # warm a full version window so the one-time window-fill
+            # transient — fresh buffer allocation + first-touch page
+            # faults on every node, in BOTH planes — is excluded
+            if t >= warm_publishes:
                 full.append(dt)
         for t in range(2):
             # one leaf changes: the delta path moves ~1 fragment/hop
             state["layer0"] = base["layer0"] + np.float32(100 + t)
             delta.append(_publish_and_wait(dict(state)))
+        if plane_info is not None:
+            # acceptance evidence (ISSUE 20): compare the LEAF's staged
+            # fragment bytes against the publisher's for the final
+            # version — the relay chain re-serves wire bytes verbatim,
+            # so any divergence is a data-plane corruption
+            v = leaf.version()
+            want = _staged_raw_frags(pub._transport, v)
+            got = _staged_raw_frags(leaf._transport, v)
+            common = sorted(set(want) & set(got))
+            plane_info["bitwise_payload"] = bool(
+                len(common) >= len(base)
+                and set(want) == set(got)
+                and all(want[k] == got[k] for k in common)
+            )
+            plane_info["digest_rejects"] = sum(
+                1
+                for r in _prov.PROV.hop_records()
+                if r.get("verdict") == "mismatch"
+            )
+            plane_info["native_fallbacks"] = (
+                sum(
+                    1
+                    for r in _flightrec.snapshot()
+                    if r.get("op") == "fragment.native_fallback"
+                )
+                - fallbacks0
+            )
+            serves = copies = 0
+            for tr in [pub._transport] + [r._transport for r in reps]:
+                srv = getattr(tr, "_frag_native", None)
+                if srv is not None:
+                    c = srv.counters()
+                    serves += int(c.get("serves", 0))
+                    copies += int(c.get("serve_copies", 0))
+            plane_info["native_serves"] = serves
+            plane_info["native_serve_copies"] = copies
     finally:
         for r in reps:
             try:
@@ -2209,6 +2284,208 @@ def bench_serving_depth() -> "Dict[str, Any]":
             "stream"
             if (d3.get("stream_speedup_x") or 0) > 1.0
             else "flat"
+        )
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# native zero-copy fragment data plane (ISSUE 20): native vs python serve
+# ---------------------------------------------------------------------------
+
+SERVING_NATIVE_DEPTHS = (3, 4)
+SERVING_NATIVE_RTTS_MS = (0.0, 10.0)  # 0 ms = the headline cell; 10 ms
+#                                       shows where the WAN re-dominates
+SERVING_NATIVE_GBPS = 1.25      # 10 GbE-class uplink: the simulated wire
+#                                 is cheap+identical for both planes, so
+#                                 the real serve/receive cost shows
+SERVING_NATIVE_BURST_MB = 4.0
+SERVING_NATIVE_LEAVES = 128     # many small fragments: the per-request
+#                                 interpreter overhead the native plane
+#                                 eliminates dominates the payload move
+SERVING_NATIVE_LEAF_ELEMS = 64 * 1024  # 128 x 256 KB fp32 = 32 MB
+
+
+def bench_serving_native() -> "Dict[str, Any]":
+    """Native zero-copy fragment data plane vs pure-Python serving
+    (ISSUE 20): the SAME fanout-1 relay chain as the depth bench, every
+    fetch cut-through streamed, run twice per cell — once with
+    ``TORCHFT_FRAG_NATIVE=0`` (Python ``BaseHTTPRequestHandler`` serve +
+    ``urllib`` receive) and once armed (native writev serve out of
+    pooled buffers, GIL-free receive+sha256).  Uplinks are shaped at
+    10 GbE class so the (identical) simulated wire charge stays small
+    and the measured difference is the data plane itself.  Headline:
+    native publish->leaf p99 speedup at depth 3/4, 0 ms RTT — with
+    bitwise payload verification and zero failed fetches as hard
+    evidence rows, and a striped-heal leg on the same footing."""
+    import os as _os
+
+    from torchft_tpu.checkpointing import fragdata as _fragdata
+
+    rng = np.random.RandomState(31)
+    base = {
+        f"layer{i}": rng.randn(SERVING_NATIVE_LEAF_ELEMS).astype(np.float32)
+        for i in range(SERVING_NATIVE_LEAVES)
+    }
+    payload_bytes = sum(a.nbytes for a in base.values())
+    prior = {
+        k: _os.environ.get(k)
+        for k in ("TORCHFT_WIRE_RTT_MS", "TORCHFT_WIRE_GBPS",
+                  "TORCHFT_WIRE_BURST_MB", "TORCHFT_TOPOLOGY",
+                  "TORCHFT_SERVING_PARALLEL", "TORCHFT_HEAL_PARALLEL",
+                  "TORCHFT_FRAG_NATIVE")
+    }
+    _os.environ.pop("TORCHFT_TOPOLOGY", None)
+    _os.environ["TORCHFT_WIRE_GBPS"] = str(SERVING_NATIVE_GBPS)
+    _os.environ["TORCHFT_WIRE_BURST_MB"] = str(SERVING_NATIVE_BURST_MB)
+    _os.environ["TORCHFT_SERVING_PARALLEL"] = str(SERVING_DEPTH_PARALLEL)
+    _os.environ["TORCHFT_HEAL_PARALLEL"] = str(HEAL_PARALLEL)
+
+    def _pcts(lat: "List[float]") -> "Tuple[float, float]":
+        lat = sorted(lat)
+        return round(lat[len(lat) // 2] * 1e3, 1), round(lat[-1] * 1e3, 1)
+
+    out: "Dict[str, Any]" = {
+        "native_available": _fragdata.available(),
+        "payload_mb": round(payload_bytes / 2**20, 2),
+        "fragments": SERVING_NATIVE_LEAVES,
+        "gbps_per_uplink": SERVING_NATIVE_GBPS,
+        "publishes": SERVING_DEPTH_PUBLISHES,
+        "warm_publishes": 5,
+    }
+    if not _fragdata.available():
+        out["error"] = "native library unavailable: nothing to compare"
+        for k, v in prior.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        return out
+    try:
+        for rtt in SERVING_NATIVE_RTTS_MS:
+            _os.environ["TORCHFT_WIRE_RTT_MS"] = str(rtt)
+            leg: "Dict[str, Any]" = {}
+            for depth in SERVING_NATIVE_DEPTHS:
+                cell: "Dict[str, Any]" = {}
+                for plane in ("python", "native"):
+                    _os.environ["TORCHFT_FRAG_NATIVE"] = (
+                        "1" if plane == "native" else "0"
+                    )
+                    _fragdata.reset_port_cache()
+                    info: "Dict[str, Any]" = {}
+                    # warm a full staged-version window (4) + 1: the
+                    # window-fill transient (fresh buffer allocation +
+                    # first-touch faults on every node, both planes)
+                    # is a one-time cost, not the steady-state serving
+                    # regime this cell compares
+                    full, _, _, _ = _serving_depth_trial(
+                        base, depth, True, plane_info=info,
+                        warm_publishes=5,
+                    )
+                    p50, p99 = _pcts(full)
+                    cell[f"{plane}_p50_ms"] = p50
+                    cell[f"{plane}_p99_ms"] = p99
+                    cell[f"{plane}_bitwise_payload"] = info.get(
+                        "bitwise_payload"
+                    )
+                    # a failed fetch = a digest reject the chain healed
+                    # around; leaf convergence itself is the
+                    # zero-timeout proof (the trial raises otherwise)
+                    cell[f"{plane}_failed_fetches"] = info.get(
+                        "digest_rejects"
+                    )
+                    if plane == "native":
+                        cell["native_serves"] = info.get("native_serves")
+                        cell["native_serve_copies"] = info.get(
+                            "native_serve_copies"
+                        )
+                        cell["native_fallbacks"] = info.get(
+                            "native_fallbacks"
+                        )
+                cell["native_speedup_p99_x"] = round(
+                    cell["python_p99_ms"] / max(cell["native_p99_ms"], 1e-9),
+                    2,
+                )
+                cell["native_speedup_p50_x"] = round(
+                    cell["python_p50_ms"] / max(cell["native_p50_ms"], 1e-9),
+                    2,
+                )
+                leg[f"d{depth}"] = cell
+                log(
+                    f"serving native d={depth} rtt={int(rtt)}ms: python "
+                    f"p99 {cell['python_p99_ms']}ms native p99 "
+                    f"{cell['native_p99_ms']}ms "
+                    f"({cell['native_speedup_p99_x']}x, serves="
+                    f"{cell['native_serves']}, copies="
+                    f"{cell['native_serve_copies']})"
+                )
+            out[f"rtt_{int(rtt)}ms"] = leg
+
+        # striped-heal leg on the same footing: one healer pulls the
+        # 8 MB heal state striped across 4 sources at 0 ms / 10 GbE,
+        # python vs native receive path
+        _os.environ["TORCHFT_WIRE_RTT_MS"] = "0"
+        rng2 = np.random.RandomState(37)
+        heal_state = {
+            "user": {
+                f"w{i}": rng2.randn(HEAL_LEAF_ELEMS).astype(np.float32)
+                for i in range(HEAL_STATE_LEAVES)
+            },
+            "torchft": {"step": 5, "batches_committed": 10},
+        }
+        heal_leg: "Dict[str, Any]" = {}
+        for plane in ("python", "native"):
+            _os.environ["TORCHFT_FRAG_NATIVE"] = (
+                "1" if plane == "native" else "0"
+            )
+            _fragdata.reset_port_cache()
+            walls: "List[float]" = []
+            for _t in range(HEAL_TRIALS):
+                wall, _info = _heal_trial(heal_state, max(HEAL_SOURCES))
+                walls.append(wall)
+            walls.sort()
+            heal_leg[f"{plane}_wall_p50_s"] = round(
+                walls[len(walls) // 2], 3
+            )
+        heal_leg["native_speedup_x"] = round(
+            heal_leg["python_wall_p50_s"]
+            / max(heal_leg["native_wall_p50_s"], 1e-9),
+            2,
+        )
+        out["heal_stripe"] = heal_leg
+        log(
+            f"serving native heal stripe: python p50 "
+            f"{heal_leg['python_wall_p50_s']}s native p50 "
+            f"{heal_leg['native_wall_p50_s']}s "
+            f"({heal_leg['native_speedup_x']}x)"
+        )
+
+        # headline: the 0 ms cells the acceptance judges
+        r0 = out.get("rtt_0ms", {})
+        for depth in SERVING_NATIVE_DEPTHS:
+            d = r0.get(f"d{depth}", {})
+            out[f"d{depth}_rtt0_speedup_p99_x"] = d.get(
+                "native_speedup_p99_x"
+            )
+        d3 = r0.get("d3", {})
+        out["bitwise"] = bool(
+            d3.get("native_bitwise_payload")
+            and d3.get("python_bitwise_payload")
+        )
+        out["failed_fetches"] = (
+            (d3.get("native_failed_fetches") or 0)
+            + (d3.get("python_failed_fetches") or 0)
+        )
+        out["heal_speedup_x"] = heal_leg.get("native_speedup_x")
+        out["winner"] = (
+            "native"
+            if (out.get("d3_rtt0_speedup_p99_x") or 0) > 1.0
+            else "python"
         )
     finally:
         for k, v in prior.items():
@@ -2769,6 +3046,21 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         )
         if sdepth.get(k) is not None
     } or None
+    # native data-plane headline (ISSUE 20): native-vs-python p99
+    # speedup at the 0 ms cells + the bitwise / failed-fetch evidence
+    snative = result.get("serving_native") or {}
+    native_compact = {
+        k: snative.get(k)
+        for k in (
+            "d3_rtt0_speedup_p99_x",
+            "d4_rtt0_speedup_p99_x",
+            "heal_speedup_x",
+            "bitwise",
+            "failed_fetches",
+            "winner",
+        )
+        if snative.get(k) is not None
+    } or None
     # fragment-provenance headline (ISSUE 18): per-fragment staleness
     # spread at the deepest WAN leg of the streaming-relay bench
     fragments_compact = {
@@ -2826,6 +3118,9 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         # streaming-relay headline (ISSUE 14): publish->leaf at depth 3 /
         # 50 ms RTT, cut-through vs store-and-forward + the delta row
         "serving_depth": serving_depth_compact,
+        # native data-plane headline (ISSUE 20): zero-copy serve +
+        # GIL-free receive vs the pure-Python path on the same chain
+        "native": native_compact,
         # coordination-plane HA headline (ISSUE 13): leader-kill -> next
         # formed quorum latency + the monotonicity verdicts
         "ha": ha_compact,
@@ -2872,7 +3167,7 @@ def compact_summary(result: "Dict[str, Any]") -> "Dict[str, Any]":
         "switch", "diloco_winners", "dominant", "crosscheck",
         "recovery_phases_ms_top", "recovery_cycles_s", "wan",
         "links", "staleness", "fragments", "ha", "serving",
-        "serving_depth", "heal", "cold_restore",
+        "serving_depth", "native", "heal", "cold_restore",
     ]
     while (
         len(json.dumps(out).encode()) > COMPACT_SUMMARY_MAX_BYTES and droppable
@@ -2926,6 +3221,21 @@ def main() -> None:
         result = {
             "metric": "serving_publish_to_leaf_latency",
             "serving_depth": sdepth,
+            "links": links_summary(),
+        }
+        print(json.dumps(result), flush=True)
+        print(json.dumps(compact_summary(result)), flush=True)
+        return
+    if "--serving-native" in sys.argv:
+        # `make bench-serving-native`: the native-vs-python fragment
+        # data-plane comparison alone (zero-copy serve + GIL-free
+        # receive vs pure Python on the same cut-through chain, plus
+        # the striped-heal leg), with the compact tail (same last-line
+        # contract as the full run)
+        snative = bench_serving_native()
+        result = {
+            "metric": "native_data_plane_speedup",
+            "serving_native": snative,
             "links": links_summary(),
         }
         print(json.dumps(result), flush=True)
@@ -3062,6 +3372,13 @@ def main() -> None:
         log(f"serving depth bench failed: {e!r}")
         serving_depth = {"error": repr(e)}
     try:
+        # native data-plane comparison (ISSUE 20): zero-copy serve +
+        # GIL-free receive vs the pure-Python path on the same chain
+        serving_native = bench_serving_native()
+    except Exception as e:  # noqa: BLE001
+        log(f"serving native bench failed: {e!r}")
+        serving_native = {"error": repr(e)}
+    try:
         # coordination-plane HA: leader-kill -> next-quorum latency over
         # a replicated lighthouse (ISSUE 13)
         ha = bench_ha()
@@ -3088,6 +3405,7 @@ def main() -> None:
         "switch": switch,
         "serving": serving,
         "serving_depth": serving_depth,
+        "serving_native": serving_native,
         "ha": ha,
         "heal": heal,
         # passive link-state registry distilled (ISSUE 16): fills as a

@@ -6,12 +6,14 @@
 // protocol (framed JSON over TCP) directly; the C API only manages server
 // lifecycles plus a pure-function entry for quorum-result math so tests can
 // exercise it natively.
+#include <cstdint>
 #include <cstring>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <string>
 
+#include "fragserver.h"
 #include "lighthouse.h"
 #include "manager.h"
 #include "store.h"
@@ -27,7 +29,7 @@ char* dup_string(const std::string& s) {
 }
 
 struct ServerHandle {
-  enum class Kind { Lighthouse, Manager, Store } kind;
+  enum class Kind { Lighthouse, Manager, Store, Frag } kind;
   std::unique_ptr<tft::RpcServer> server;
 };
 
@@ -277,6 +279,109 @@ int tft_manager_report_fragments(int64_t h, const char* fragments_json) {
     g_last_error = e.what();
     return -1;
   }
+  return 0;
+}
+
+// ---- native zero-copy fragment data plane (fragserver.{h,cc}) ----------
+// Server lifecycle + staging mirror: Python's HTTPTransport keeps the
+// control plane (plans, manifests, digests, version advertisement) and
+// hands raw fragment payload bytes down here at stage time; every
+// subsequent serve is a writev out of the pooled buffer with zero
+// user-space copies.
+
+static tft::FragServer* find_frag(int64_t h) {
+  auto* s = dynamic_cast<tft::FragServer*>(find_server(h));
+  if (s == nullptr) g_last_error = "bad fragserver handle";
+  return s;
+}
+
+int64_t tft_frag_server_create(const char* bind_host, int port) {
+  try {
+    auto server = std::make_unique<tft::FragServer>(
+        bind_host ? bind_host : "", port);
+    return register_server({ServerHandle::Kind::Frag, std::move(server)});
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int tft_frag_server_port(int64_t h) {
+  tft::FragServer* s = find_frag(h);
+  return s == nullptr ? -1 : s->port();
+}
+
+int tft_frag_begin(int64_t h, int64_t step) {
+  tft::FragServer* s = find_frag(h);
+  return s == nullptr ? -1 : s->begin(step);
+}
+
+int tft_frag_stage(int64_t h, int64_t step, const char* resource,
+                   const uint8_t* data, int64_t len) {
+  tft::FragServer* s = find_frag(h);
+  if (s == nullptr || resource == nullptr || len < 0) return -1;
+  return s->stage(step, resource, data, static_cast<size_t>(len));
+}
+
+int tft_frag_finish(int64_t h, int64_t step) {
+  tft::FragServer* s = find_frag(h);
+  return s == nullptr ? -1 : s->finish(step);
+}
+
+int tft_frag_retire(int64_t h, int64_t step) {
+  tft::FragServer* s = find_frag(h);
+  return s == nullptr ? -1 : s->retire(step);
+}
+
+char* tft_frag_counters(int64_t h) {
+  tft::FragServer* s = find_frag(h);
+  if (s == nullptr) return nullptr;
+  return dup_string(s->counters_json().dump());
+}
+
+// Chaos-test fault injection on the data server: the next `count`
+// requests drop (close mid-exchange) or delay `param_ms` before the
+// body.  mode: "off" | "drop" | "delay".
+int tft_frag_inject(int64_t h, const char* mode, int64_t param_ms,
+                    int64_t count) {
+  tft::FragServer* s = find_frag(h);
+  if (s == nullptr || mode == nullptr) return -1;
+  return s->inject(mode, param_ms, count);
+}
+
+// Two-phase GIL-free fetch client (per-thread persistent connections —
+// ctypes releases the GIL around both calls, so the byte-moving +
+// digest phase never touches the interpreter).  begin returns the HTTP
+// status (200/404/503) or -1 on transport error (tft_frag_client_error).
+int tft_frag_fetch_begin(const char* addr, int64_t step,
+                         const char* resource, int64_t timeout_ms,
+                         int64_t* content_len, double* first_byte_s) {
+  if (addr == nullptr || resource == nullptr) return -1;
+  return tft::frag_fetch_begin(addr, step, resource, timeout_ms,
+                               content_len, first_byte_s);
+}
+
+int tft_frag_fetch_body(uint8_t* buf, int64_t cap, char* sha_hex_out,
+                        int64_t timeout_ms) {
+  if (buf == nullptr) return -1;
+  return tft::frag_fetch_body(buf, cap, sha_hex_out, timeout_ms);
+}
+
+void tft_frag_fetch_abort() { tft::frag_fetch_abort(); }
+
+void tft_frag_client_close() { tft::frag_client_close(); }
+
+const char* tft_frag_client_error() {
+  thread_local std::string err;
+  err = tft::frag_client_error();
+  return err.c_str();
+}
+
+// Native SHA-256 over one buffer (lowercase hex into out65) — exposed so
+// tests can cross-check the wire digest against hashlib.
+int tft_sha256_hex(const uint8_t* data, int64_t len, char* out65) {
+  if ((data == nullptr && len > 0) || len < 0 || out65 == nullptr) return -1;
+  tft::sha256_hex(data, static_cast<size_t>(len), out65);
   return 0;
 }
 

@@ -1,0 +1,140 @@
+// Native zero-copy fragment data plane (ROADMAP item 3).
+//
+// The fragment hot path — serving relay pulls, striped heal, cold
+// restore — used to cross the Python HTTP handlers byte by byte.  This
+// server owns ONLY the data plane: Python stages raw wire-byte fragment
+// payloads down at stage time (one copy into a pooled registered
+// buffer), and every subsequent serve is a writev straight out of that
+// buffer — zero user-space copies steady-state, no GIL anywhere.
+// Python keeps all control: plans, manifests, digests-of-record,
+// staging lifecycle, version advertisement.
+//
+// Semantics mirror the Python fragment plane exactly so the client can
+// fall back per-fetch:
+//   * streaming (begun, unfinished) version + missing fragment -> the
+//     request PARKS on a condvar up to the long-poll window, then
+//     answers 503 retryable-busy (the cut-through contract);
+//   * complete version + missing fragment -> 404 (the fragment was
+//     never raw-staged natively; Python owns it);
+//   * unknown/retired version -> 404 (Python decides: store-serve,
+//     legacy encode, or a real miss).
+// All responses are keep-alive: the client pipelines fetches over one
+// persistent connection per (thread, endpoint).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "net.h"
+
+namespace tft {
+
+// One staged fragment payload in a pool-recycled buffer.  `refs` counts
+// in-flight serves (guarded by the server mutex); a retire that lands
+// while a serve holds a ref marks the buffer zombie and the LAST deref
+// recycles it — retire never blocks on the wire.
+struct FragBuf {
+  std::vector<uint8_t> data;  // capacity-pooled backing store
+  size_t len = 0;             // staged payload length (<= data.size())
+  int refs = 0;
+  bool retired = false;
+};
+
+struct FragCounters {
+  int64_t pool_hits = 0;
+  int64_t pool_misses = 0;
+  int64_t stage_copy_bytes = 0;  // the ONE copy: Python buffer -> pool
+  int64_t serve_copies = 0;      // must stay 0: serve is pure writev
+  int64_t serve_bytes = 0;
+  int64_t serves = 0;
+  int64_t parked_waits = 0;  // long-polls that actually waited
+  int64_t busy_replies = 0;  // 503 retryable-busy answers
+  int64_t miss_replies = 0;  // 404 fall-back-to-Python answers
+  int64_t injected_drops = 0;
+  int64_t injected_delays = 0;
+};
+
+class FragServer : public RpcServer {
+ public:
+  // bind_host may be "" (all interfaces); port 0 picks a free port.
+  FragServer(const std::string& bind_host, int port);
+  ~FragServer() override;
+
+  // Staging lifecycle (driven by HTTPTransport's control plane).  All
+  // return 0 on success, -1 on unknown/retired step (mirror of the
+  // Python staging KeyError — callers treat it as "not mirrored").
+  int begin(int64_t step);
+  int stage(int64_t step, const std::string& resource, const uint8_t* data,
+            size_t len);
+  int finish(int64_t step);
+  int retire(int64_t step);
+
+  FragCounters counters() const;
+  Json counters_json() const;
+
+  // Fault injection for chaos tests: the next `count` data requests
+  // either drop (close mid-exchange) or delay `param_ms` before the
+  // body.  mode: "off" | "drop" | "delay".
+  int inject(const std::string& mode, int64_t param_ms, int64_t count);
+
+ protected:
+  Json handle(const std::string& method, const Json& params,
+              int64_t timeout_ms) override;
+  const char* server_kind() const override { return "fragserver"; }
+  bool handle_http_keepalive(int fd, const std::string& request_head) override;
+  void wake_blocked() override;
+
+ private:
+  struct Version {
+    bool complete = false;
+    std::map<std::string, std::shared_ptr<FragBuf>> frags;  // by resource
+  };
+
+  std::shared_ptr<FragBuf> pool_take(size_t len);
+  void pool_give_locked(FragBuf& buf);
+  void deref(const std::shared_ptr<FragBuf>& buf);
+  bool reply_simple(int fd, int status, const std::string& body);
+  bool serve_frag(int fd, const std::shared_ptr<FragBuf>& buf);
+
+  mutable std::mutex mu_;
+  CondVar cv_;  // fragment-landed / shutdown wakeups for parked readers
+  std::map<int64_t, Version> versions_;
+  // Free-list keyed by exact capacity: fragment sizes repeat across
+  // publishes, so steady-state stage traffic is all pool hits (the
+  // bufpool miss-flat idiom, natively).
+  std::map<size_t, std::vector<std::vector<uint8_t>>> pool_;
+  FragCounters counters_;
+  // injection state (guarded by mu_)
+  int inject_mode_ = 0;  // 0 off, 1 drop, 2 delay
+  int64_t inject_param_ms_ = 0;
+  int64_t inject_count_ = 0;
+};
+
+// ---- native fragment client ---------------------------------------------
+// Two-phase fetch so Python can own buffer allocation (its bufpool)
+// while the byte-moving phase runs without the GIL (ctypes releases it
+// around every call):
+//   frag_fetch_begin  -> request on a per-(thread, endpoint) persistent
+//                        connection; parses the response head; returns
+//                        the HTTP status (200/404/503) or -1 transport
+//                        error, with content length out.
+//   frag_fetch_body   -> drains the body straight into the caller's
+//                        buffer and computes sha256 over it in-place.
+// A begin that returned 200 MUST be followed by exactly one body/abort.
+
+int frag_fetch_begin(const std::string& addr, int64_t step,
+                     const std::string& resource, int64_t timeout_ms,
+                     int64_t* content_len, double* first_byte_s);
+int frag_fetch_body(uint8_t* buf, int64_t cap, char* sha_hex_out /*65B*/,
+                    int64_t timeout_ms);
+void frag_fetch_abort();
+void frag_client_close();
+const std::string& frag_client_error();
+
+// Streaming SHA-256 over one buffer, lowercase hex into out[64] + NUL.
+void sha256_hex(const uint8_t* data, size_t len, char* out_hex65);
+
+}  // namespace tft

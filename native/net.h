@@ -154,6 +154,9 @@ bool recv_frame(int fd, std::string* payload, int64_t deadline_ms,
                 std::string* err = nullptr, int64_t body_timeout_ms = 0);
 // Peek up to n bytes without consuming (used to sniff HTTP vs framed proto).
 bool peek_bytes(int fd, char* buf, size_t n, int64_t deadline_ms);
+// Read one HTTP request/response head (through the blank line) without
+// consuming any following bytes: MSG_PEEK windows + exact consume.
+bool read_http_head(int fd, std::string* head, int64_t deadline_ms);
 bool read_exact(int fd, char* buf, size_t n, int64_t deadline_ms,
                 std::string* err = nullptr);
 bool write_all(int fd, const char* buf, size_t n, int64_t deadline_ms,
@@ -270,6 +273,15 @@ class RpcServer {
   // "store") so the trace ledger can attribute server time.
   virtual const char* server_kind() const { return "server"; }
   virtual void handle_http(int fd, const std::string& request_head);
+  // Keep-alive HTTP hook (the fragment data plane's persistent
+  // connections): return true to hold the connection open and read the
+  // next request head, false to close after this reply.  The default
+  // delegates to the one-shot handle_http above and closes — existing
+  // HTTP servers (lighthouse dashboard) are untouched.
+  virtual bool handle_http_keepalive(int fd, const std::string& request_head) {
+    handle_http(fd, request_head);
+    return false;
+  }
   // Called during shutdown after stopping_ is set and connection fds are
   // closed, before joining connection threads: wake any handler blocked on
   // an internal condition variable.

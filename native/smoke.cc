@@ -30,6 +30,7 @@
 #include <thread>
 #include <vector>
 
+#include "fragserver.h"
 #include "lighthouse.h"
 #include "manager.h"
 #include "net.h"
@@ -329,6 +330,158 @@ int election_round() {
   return failures;
 }
 
+// Fragment data-plane round: concurrent stagers race long-poll readers
+// on the zero-copy fragment server while a retirer drops the PREVIOUS
+// version mid-stream — the refcounted serve-vs-retire race, the condvar
+// park/wake path, the buffer pool recycle, and the per-thread persistent
+// client connections all run together under the sanitizer.  Readers of
+// the live version assert bitwise payloads + sha; readers of the retired
+// version tolerate any outcome (that race is exactly the point) but must
+// keep the begin/body protocol balanced.
+int fragment_round() {
+  constexpr int kStagers = 3;
+  constexpr int kReaders = 3;
+  constexpr int kFragsPerStager = 4;
+  constexpr int kVersions = 3;
+  constexpr size_t kFragBytes = 64 * 1024;
+
+  tft::FragServer server("127.0.0.1", 0);  // ctor starts the accept loop
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+
+  auto frag_name = [](int s, int i) {
+    return "frag_w" + std::to_string(s) + "_" + std::to_string(i);
+  };
+  auto payload_for = [](int v, int s, int i) {
+    std::vector<uint8_t> p(kFragBytes);
+    for (size_t j = 0; j < kFragBytes; ++j) {
+      p[j] = static_cast<uint8_t>((v * 131 + s * 31 + i * 7 + j) & 0xff);
+    }
+    return p;
+  };
+
+  std::atomic<int> failures{0};
+  for (int v = 0; v < kVersions && !failures.load(); ++v) {
+    server.begin(v);
+    std::vector<std::thread> threads;
+    // stagers: disjoint fragment names, jittered so readers park first
+    for (int s = 0; s < kStagers; ++s) {
+      threads.emplace_back([&, s, v] {
+        for (int i = 0; i < kFragsPerStager; ++i) {
+          usleep(1000 * ((s + i) % 3));
+          auto p = payload_for(v, s, i);
+          if (server.stage(v, frag_name(s, i), p.data(), p.size()) != 0) {
+            fprintf(stderr, "smoke: frag stage %s failed\n",
+                    frag_name(s, i).c_str());
+            failures = 1;
+            return;
+          }
+        }
+      });
+    }
+    // readers: long-poll every fragment of the LIVE version to bitwise
+    // equality (503 = parked-then-busy, retry; anything else is a bug)
+    for (int r = 0; r < kReaders; ++r) {
+      threads.emplace_back([&, v] {
+        for (int s = 0; s < kStagers; ++s) {
+          for (int i = 0; i < kFragsPerStager; ++i) {
+            const auto expect = payload_for(v, s, i);
+            bool got_it = false;
+            for (int attempt = 0; attempt < 2000 && !failures.load();
+                 ++attempt) {
+              int64_t n = 0;
+              double fb = 0;
+              int rc = tft::frag_fetch_begin(addr, v, frag_name(s, i), 5000,
+                                             &n, &fb);
+              if (rc == 503) {
+                continue;  // parked server-side already; re-poll
+              }
+              if (rc != 200 || n != static_cast<int64_t>(expect.size())) {
+                fprintf(stderr, "smoke: frag fetch %s rc=%d n=%lld\n",
+                        frag_name(s, i).c_str(), rc,
+                        static_cast<long long>(n));
+                failures = 1;
+                break;
+              }
+              std::vector<uint8_t> got(expect.size());
+              char sha[65];
+              if (tft::frag_fetch_body(got.data(),
+                                       static_cast<int64_t>(got.size()), sha,
+                                       5000) != 0) {
+                fprintf(stderr, "smoke: frag body %s failed: %s\n",
+                        frag_name(s, i).c_str(),
+                        tft::frag_client_error().c_str());
+                failures = 1;
+                break;
+              }
+              char want[65];
+              tft::sha256_hex(expect.data(), expect.size(), want);
+              if (got != expect || std::string(sha) != want) {
+                fprintf(stderr, "smoke: frag %s payload/sha mismatch\n",
+                        frag_name(s, i).c_str());
+                failures = 1;
+                break;
+              }
+              got_it = true;
+              break;
+            }
+            if (!got_it && !failures.load()) {
+              fprintf(stderr, "smoke: frag %s never landed\n",
+                      frag_name(s, i).c_str());
+              failures = 1;
+            }
+            if (failures.load()) break;
+          }
+          if (failures.load()) break;
+        }
+        tft::frag_client_close();
+      });
+    }
+    if (v > 0) {
+      // retirer: drop the previous version while old-readers still pull
+      // it — exercises retire racing in-flight serves (last-deref
+      // recycle) and retire racing parked long-polls
+      threads.emplace_back([&, v] {
+        usleep(500);
+        server.retire(v - 1);
+      });
+      threads.emplace_back([&, v] {
+        for (int i = 0; i < 10; ++i) {
+          int64_t n = 0;
+          double fb = 0;
+          int rc = tft::frag_fetch_begin(addr, v - 1, frag_name(i % kStagers, 0),
+                                         1000, &n, &fb);
+          if (rc == 200) {
+            std::vector<uint8_t> scratch(static_cast<size_t>(n));
+            char sha[65];
+            tft::frag_fetch_body(scratch.data(), n, sha, 5000);
+          }
+          // 404/503/-1 are all legal outcomes of the retire race
+        }
+        tft::frag_client_close();
+      });
+    }
+    for (auto& th : threads) th.join();
+    server.finish(v);
+  }
+
+  const tft::FragCounters c = server.counters();
+  if (!failures.load() && c.serve_copies != 0) {
+    fprintf(stderr, "smoke: serve_copies=%lld (zero-copy broken)\n",
+            static_cast<long long>(c.serve_copies));
+    failures = 1;
+  }
+  const int64_t expect_serves =
+      static_cast<int64_t>(kReaders) * kStagers * kFragsPerStager * kVersions;
+  if (!failures.load() && c.serves < expect_serves) {
+    fprintf(stderr, "smoke: serves=%lld < %lld\n",
+            static_cast<long long>(c.serves),
+            static_cast<long long>(expect_serves));
+    failures = 1;
+  }
+  server.shutdown();
+  return failures.load();
+}
+
 int drive_round(const std::string& manager_addr, int round) {
   tft::Json params = tft::Json::object();
   params["group_rank"] = static_cast<int64_t>(0);
@@ -382,6 +535,12 @@ int main() {
     return 1;
   }
   printf("ELECTION OK\n");
+
+  if (fragment_round()) {
+    printf("SMOKE FAIL\n");
+    return 1;
+  }
+  printf("FRAGMENT OK\n");
 
   tft::LighthouseOpt lopt;
   lopt.bind_host = "127.0.0.1";

@@ -218,12 +218,14 @@ class TestStripedHeal:
     ):
         state, transports = sources
         # bitwise-corrupt one fragment's staged bytes on a NON-primary
-        # source: its sha256 no longer matches the primary's manifest
+        # source: its sha256 no longer matches the primary's manifest.
+        # Restage through the transport API so the poison lands in BOTH
+        # data planes (the Python slot and the native zero-copy mirror).
         victim = transports[1]
-        with victim._staged_lock.w_lock():
+        with victim._staged_lock.r_lock():
             raw = bytearray(victim._staged[5].sd["frag:2"])
-            raw[len(raw) // 2] ^= 0xFF
-            victim._staged[5].sd["frag:2"] = bytes(raw)
+        raw[len(raw) // 2] ^= 0xFF
+        victim.stage_streamed_part(5, "frag:2", bytes(raw))
         local = clone_state(state)
         for v in local["user"].values():
             v[:] = 0.0
@@ -254,9 +256,9 @@ class TestStripedHeal:
         # poisoned value — whatever the dynamic stripe routes to the
         # victim decodes fine but fails the slot-layout check
         forged = ser.serialize({"0": np.full(3, -777.0, dtype=np.float32)})
-        with victim._staged_lock.w_lock():
-            for i in range(6):
-                victim._staged[5].sd[f"frag:{i}"] = forged
+        for i in range(6):
+            # transport API restage: forges Python slot + native mirror
+            victim.stage_streamed_part(5, f"frag:{i}", forged)
         # pace fetches so every worker pops before any completes: the
         # victim's workers are guaranteed to hold (forged) fragments
         faults.FAULTS.configure(
@@ -283,10 +285,10 @@ class TestStripedHeal:
     def test_poisoned_primary_fragment_heals_from_peers(self, sources):
         state, transports = sources
         primary = transports[0]
-        with primary._staged_lock.w_lock():
+        with primary._staged_lock.r_lock():
             raw = bytearray(primary._staged[5].sd["frag:1"])
-            raw[0] ^= 0xFF
-            primary._staged[5].sd["frag:1"] = bytes(raw)
+        raw[0] ^= 0xFF
+        primary.stage_streamed_part(5, "frag:1", bytes(raw))
         local = clone_state(state)
         healer = HTTPTransport(timeout=10.0)
         try:

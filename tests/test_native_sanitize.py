@@ -61,6 +61,9 @@ class TestTsanQuorumSmoke:
         # the threaded-codec leg runs first: 4 threads over disjoint row
         # ranges of shared buffers (the codec_pool access pattern)
         assert "CODEC OK" in run.stdout, run.stdout + run.stderr
+        # fragment data-plane leg: concurrent stagers vs long-poll
+        # readers vs a mid-stream retire on the zero-copy server
+        assert "FRAGMENT OK" in run.stdout, run.stdout + run.stderr
         assert "SMOKE OK" in run.stdout, run.stdout + run.stderr
         assert "WARNING: ThreadSanitizer" not in run.stderr, run.stderr
         assert run.returncode == 0, f"exit={run.returncode}\n{run.stderr}"

@@ -566,6 +566,12 @@ class TestBandwidthShaper:
         assert 0.15 <= elapsed <= 1.0, elapsed
 
     def test_shaped_allreduce_measures_rate(self, store):
+        """Asserts on the token bucket's OWN ledger (bytes debited,
+        seconds slept serving debt) rather than comparing wall-clock
+        legs: a loaded CI box can stretch the unshaped leg past the
+        shaped one, but it cannot make the shaper's accounting lie."""
+        from torchft_tpu.parallel.process_group import _TokenBucket
+
         world = 2
         pgs = [ProcessGroupTCP(timeout=60.0) for _ in range(world)]
 
@@ -575,23 +581,32 @@ class TestBandwidthShaper:
             )
 
         run_parallel(world, configure)
+        # 50 MB/s with a 1 MB burst: a ring allreduce of 8 MB at w=2
+        # moves ~8 MB per rank, so every sender runs well past its burst
+        # and MUST serve debt (sleep) in its own bucket
         for pg in pgs:
-            pg.set_bandwidth(0.05)  # 50 MB/s
-        # ring allreduce of 8 MB at w=2 sends ~8 MB per rank -> >= ~0.14 s
-        # after the 4 MB default burst; unshaped loopback does it in < 30 ms
+            pg._bucket = _TokenBucket(50e6, burst=1 << 20)
         data = np.ones(2 << 20, dtype=np.float32)
 
         def run(rank, _):
-            t0 = time.monotonic()
             pgs[rank].allreduce([data.copy()], REDUCE_SUM).wait(timeout=60)
-            return time.monotonic() - t0
 
-        elapsed = max(run_parallel(world, run))
-        assert elapsed >= 0.06, elapsed
+        run_parallel(world, run)
+        for pg in pgs:
+            bucket = pg._bucket
+            assert bucket is not None
+            # each rank's egress (reduce-scatter + allgather halves) ran
+            # through its bucket: at least half the payload was debited
+            assert bucket.consumed_bytes >= data.nbytes // 2, (
+                bucket.consumed_bytes
+            )
+            # debt beyond the burst was actually paced off
+            assert bucket.slept_s > 0.0
         for pg in pgs:
             pg.set_bandwidth(None)
-        elapsed_unshaped = max(run_parallel(world, run))
-        assert elapsed_unshaped < elapsed
+            assert pg._bucket is None
+        # unshaped leg still reduces correctly with shaping removed
+        run_parallel(world, run)
         for pg in pgs:
             pg.shutdown()
 

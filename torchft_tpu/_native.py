@@ -228,6 +228,48 @@ def get_lib() -> ctypes.CDLL:
         ]
         lib.tft_div_f32_rows.restype = None
         lib.tft_div_f32_rows.argtypes = [_f32p, _i64, _i64, _i64, ctypes.c_float]
+
+        # Native zero-copy fragment data plane (native/fragserver.{h,cc}).
+        # Server lifecycle + the staging mirror HTTPTransport drives, and
+        # the two-phase GIL-free fetch client fragments.py dispatches to
+        # behind the TORCHFT_FRAG_NATIVE gate.
+        lib.tft_frag_server_create.restype = ctypes.c_int64
+        lib.tft_frag_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tft_frag_server_port.restype = ctypes.c_int
+        lib.tft_frag_server_port.argtypes = [ctypes.c_int64]
+        lib.tft_frag_begin.restype = ctypes.c_int
+        lib.tft_frag_begin.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.tft_frag_stage.restype = ctypes.c_int
+        lib.tft_frag_stage.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p, _u8p, _i64,
+        ]
+        lib.tft_frag_finish.restype = ctypes.c_int
+        lib.tft_frag_finish.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.tft_frag_retire.restype = ctypes.c_int
+        lib.tft_frag_retire.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.tft_frag_counters.restype = ctypes.c_void_p
+        lib.tft_frag_counters.argtypes = [ctypes.c_int64]
+        lib.tft_frag_inject.restype = ctypes.c_int
+        lib.tft_frag_inject.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, _i64, _i64,
+        ]
+        lib.tft_frag_fetch_begin.restype = ctypes.c_int
+        lib.tft_frag_fetch_begin.argtypes = [
+            ctypes.c_char_p, _i64, ctypes.c_char_p, _i64,
+            ctypes.POINTER(_i64), ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.tft_frag_fetch_body.restype = ctypes.c_int
+        lib.tft_frag_fetch_body.argtypes = [
+            _u8p, _i64, ctypes.c_char_p, _i64,
+        ]
+        lib.tft_frag_fetch_abort.restype = None
+        lib.tft_frag_fetch_abort.argtypes = []
+        lib.tft_frag_client_close.restype = None
+        lib.tft_frag_client_close.argtypes = []
+        lib.tft_frag_client_error.restype = ctypes.c_char_p
+        lib.tft_frag_client_error.argtypes = []
+        lib.tft_sha256_hex.restype = ctypes.c_int
+        lib.tft_sha256_hex.argtypes = [_u8p, _i64, ctypes.c_char_p]
         _lib = lib
         return _lib
 

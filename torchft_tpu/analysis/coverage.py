@@ -58,6 +58,10 @@ _FLIGHT_ANCHORS: "Tuple[Tuple[str, str], ...]" = (
     # stripes — plus the striped heal receive must stay
     # post-mortem-visible
     ("checkpointing/fragments.py", "fetch_raw"),
+    # the native-vs-python dispatch point of the zero-copy data plane:
+    # a fetch that falls back to Python must stay post-mortem-visible
+    # (`fragment.native_fallback`)
+    ("checkpointing/fragments.py", "_raw_data_plane"),
     ("checkpointing/fragments.py", "fetch_serialized"),
     ("checkpointing/http_transport.py", "recv_checkpoint_striped"),
     ("serving/replica.py", "_pull"),

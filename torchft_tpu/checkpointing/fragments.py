@@ -75,6 +75,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from torchft_tpu.checkpointing import fragdata as _fragdata
 from torchft_tpu.checkpointing import provenance as _prov
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.utils import faults as _faults
@@ -213,7 +214,10 @@ def verify_fragment(name: str, frag: Any, manifest: "Dict[str, Any]") -> None:
     want = (manifest.get("digests") or {}).get(name)
     if want is None:
         return
-    got = hashlib.sha256(raw).hexdigest()
+    # wire_digest (not hashlib directly): when the native data plane
+    # landed this buffer it already digested it GIL-free — re-hashing
+    # every fragment on every hop would throw that work away
+    got = wire_digest(frag)
     if got != want:
         raise ValueError(
             f"serving fragment {name!r} v{manifest.get('version')}: digest "
@@ -687,7 +691,8 @@ def close_connections() -> None:
 
 
 def _request_once(
-    base: str, path: str, timeout: float
+    base: str, path: str, timeout: float,
+    extra_headers: "Optional[Dict[str, str]]" = None,
 ) -> http.client.HTTPResponse:
     """One GET over the cached keep-alive connection; returns the live
     200 response (the caller consumes the body).  Raises
@@ -695,7 +700,7 @@ def _request_once(
     not-yet-staged, drained so the connection stays reusable) and
     ``ConnectionError`` / ``OSError`` on transport failure."""
     conn = _conn_for(base, timeout)
-    headers = {}
+    headers = dict(extra_headers) if extra_headers else {}
     traceparent = _tracing.current_traceparent()
     if traceparent:
         headers["traceparent"] = traceparent
@@ -727,9 +732,12 @@ def _request_once(
         raise ConnectionError(f"http fetch {base}{path}: {e}") from e
 
 
-def _get_raw_once(base: str, path: str, timeout: float) -> np.ndarray:
+def _get_raw_once(
+    base: str, path: str, timeout: float,
+    extra_headers: "Optional[Dict[str, str]]" = None,
+) -> np.ndarray:
     """One GET returning a POOLED uint8 buffer the caller owns."""
-    resp = _request_once(base, path, timeout)
+    resp = _request_once(base, path, timeout, extra_headers)
     try:
         n = int(resp.headers.get("Content-Length") or 0)
         buf = POOL.take(n, np.uint8)
@@ -755,6 +763,75 @@ def _get_raw_once(base: str, path: str, timeout: float) -> np.ndarray:
         if isinstance(e, OSError):
             raise
         raise ConnectionError(f"http fetch {base}{path}: {e}") from e
+
+
+_digest_local = threading.local()
+
+
+def _note_native_digest(buf: np.ndarray, sha_hex: str) -> None:
+    """Remember the digest the native receive path already computed
+    GIL-free over this exact buffer (one-shot, consumed by
+    :func:`wire_digest` on the same thread)."""
+    _digest_local.entry = (id(buf), sha_hex)
+
+
+def _consume_native_digest(buf) -> "Optional[str]":
+    """Pop this thread's native-computed digest for ``buf`` (or None) —
+    used to HAND the digest across a thread boundary: the pipelined
+    fetcher's worker consumes it here and re-notes it on the consumer
+    thread so verify still skips the re-hash."""
+    entry = getattr(_digest_local, "entry", None)
+    if entry is not None and entry[0] == id(buf):
+        _digest_local.entry = None
+        return entry[1]
+    return None
+
+
+def wire_digest(buf) -> str:
+    """sha256 hex of one wire buffer.  Reuses the digest the native
+    data plane computed over this buffer as it landed (same thread, same
+    object — consumed one-shot so a pool-recycled buffer can never
+    inherit a stale digest); otherwise hashes here."""
+    entry = getattr(_digest_local, "entry", None)
+    if entry is not None and entry[0] == id(buf):
+        _digest_local.entry = None
+        return entry[1]
+    return hashlib.sha256(memoryview(buf)).hexdigest()
+
+
+def _raw_data_plane(
+    base: str, path: str, version: int, resource: str, timeout: float
+) -> np.ndarray:
+    """Route one raw fragment GET: native data plane when armed
+    (``TORCHFT_FRAG_NATIVE``), Python HTTP otherwise and on any native
+    miss.  The miss fallback is what keeps Mock transports, gated-off
+    peers, and non-mirrored resources (manifests, legacy docs) working
+    unchanged — and it is recorded so a fleet silently running the slow
+    path shows up in the flight recorder."""
+    headers: "Optional[Dict[str, str]]" = None
+    if resource.startswith("frag_"):
+        # Client-driven cut-through park (X-TFT-Poll-Ms): ask the server
+        # to hold a not-yet-staged fragment as long as our own budget
+        # allows (bounded) — parking on the server's staging wake beats
+        # a 503 + retry-ladder cycle that duplicates request load.  The
+        # margin keeps the park ending before our socket deadline.
+        poll_ms = int(min(max(timeout * 1000 - 150, 0), 5000))
+        if poll_ms > 0:
+            headers = {"X-TFT-Poll-Ms": str(poll_ms)}
+        if _fragdata.enabled():
+            got = _fragdata.fetch_native(base, version, resource, timeout)
+            if got is not None:
+                buf, sha_hex, first_byte_s = got
+                _fb_local.seconds = first_byte_s
+                _note_native_digest(buf, sha_hex)
+                return buf
+            _flightrec.record(
+                "fragment.native_fallback",
+                step=version,
+                resource=resource,
+                source=base,
+            )
+    return _get_raw_once(base, path, timeout, headers)
 
 
 def fetch_raw(
@@ -787,7 +864,7 @@ def fetch_raw(
             step=frag_index if frag_index is not None else version,
         )
         t = max(budget if budget is not None else 0.001, 0.001)
-        return _get_raw_once(base, path, t)
+        return _raw_data_plane(base, path, version, resource, t)
 
     t0p = time.perf_counter()
     buf = policy.run(attempt, timeout=max(timeout, 0.001), op=site)
@@ -929,14 +1006,17 @@ class FragmentFetcher:
 
         def _timed(
             res: str, idx: int
-        ) -> "Tuple[np.ndarray, Tuple[float, float]]":
+        ) -> "Tuple[np.ndarray, Tuple[float, float], Optional[str]]":
             t0 = time.perf_counter()
             buf = fetch_raw(
                 base, version, res,
                 timeout=max(deadline - time.monotonic(), 0.001),
                 role=self._role, frag_index=idx,
             )
-            return buf, (t0, time.perf_counter())
+            # the native digest is noted thread-locally on THIS worker;
+            # carry it to the consumer thread so verify can reuse it
+            sha = _consume_native_digest(buf)
+            return buf, (t0, time.perf_counter()), sha
 
         def _submit_next() -> bool:
             try:
@@ -950,7 +1030,7 @@ class FragmentFetcher:
             while pending:
                 _res, fut = pending.popleft()
                 try:
-                    buf, _ = fut.result()
+                    buf, _, _ = fut.result()
                 except BaseException:  # noqa: BLE001 - already failing
                     continue
                 POOL.give(buf)
@@ -962,11 +1042,13 @@ class FragmentFetcher:
             while pending:
                 res, fut = pending.popleft()
                 try:
-                    buf, span = fut.result()
+                    buf, span, sha = fut.result()
                 except BaseException:
                     _drain_pending()
                     raise
                 _submit_next()
+                if sha is not None:
+                    _note_native_digest(buf, sha)
                 yield res, buf, span
         except GeneratorExit:
             # consumer abandoned the stream mid-flight (failover after a
@@ -1135,7 +1217,7 @@ def striped_fetch(
                 with cv:
                     _fail_locked(stripe, name, e)
                 return
-            sha = hashlib.sha256(memoryview(buf)).hexdigest()
+            sha = wire_digest(buf)
             fb_ms = getattr(_fb_local, "seconds", 0.0) * 1e3
             if digests is not None and digests.get(name, sha) != sha:
                 # poisoned/diverged source: its bytes must never land in

@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, List, Optional
 
+from torchft_tpu.checkpointing import fragdata as _fragdata
 from torchft_tpu.checkpointing import serialization as ser
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.utils import faults as _faults
@@ -243,6 +244,17 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["store", "versions"]:
             self._serve_store_catalog(transport)
             return
+        # /nativeport — native fragment data-plane discovery: 200 + port
+        # when this node mirrors frag_* payloads into the C++ server,
+        # 404 = python-only node.  Clients cache either definitive
+        # answer (checkpointing/fragdata.py _resolve_port).
+        if parts == ["nativeport"]:
+            native = transport._frag_native
+            if native is None:
+                self.send_error(404, "no native data plane")
+            else:
+                self._send_bytes(str(native.port).encode(), "text/plain")
+            return
         # /checkpoint/{step}/{what}
         if len(parts) != 3 or parts[0] != "checkpoint":
             self.send_error(404, "unknown path")
@@ -263,9 +275,21 @@ class _Handler(BaseHTTPRequestHandler):
             # the plain 404/503 paths below).  Its read-lock timeout
             # maps to the same retryable busy-503 every other lock
             # timeout in this request takes, never an unhandled raise.
+            # Client-driven park window (X-TFT-Poll-Ms): a cut-through
+            # chain's child would rather wait here — woken the moment
+            # the fragment stages — than eat a 503 + retry-ladder cycle
+            # that duplicates request load exactly when the parent is
+            # busiest.  Absent/garbage header keeps the 250 ms default.
+            try:
+                poll_ms = float(
+                    self.headers.get("X-TFT-Poll-Ms") or 250.0
+                )
+            except (TypeError, ValueError):
+                poll_ms = 250.0
+            max_wait = min(max(poll_ms, 0.0), 5000.0) / 1e3
             try:
                 transport.await_streamed_part(
-                    step, f"frag:{what[len('frag_'):]}", max_wait=0.25
+                    step, f"frag:{what[len('frag_'):]}", max_wait=max_wait
                 )
             except TimeoutError:
                 self.send_error(503, "checkpoint busy")
@@ -446,6 +470,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         num_chunks: int = 0,
         state_dict_fn: "Optional[Callable[[], Any]]" = None,
         max_staged: int = _MAX_STAGED,
+        native: "Optional[bool]" = None,
     ) -> None:
         self._lock_timeout = timeout
         self._num_chunks = num_chunks
@@ -487,6 +512,25 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._thread.start()
         host = socket.gethostname()
         self._address = f"http://{host}:{self._server.server_address[1]}"
+        # Native zero-copy fragment DATA plane: raw ``frag:*`` staging is
+        # mirrored into a C++ sidecar server (native/fragserver.cc) that
+        # serves payload bytes via writev out of pooled buffers, GIL-free.
+        # Python keeps every control decision — plans, manifests, staging
+        # lifecycle, telemetry — and advertises the data port at
+        # ``/nativeport``.  ``native=None`` follows the
+        # TORCHFT_FRAG_NATIVE gate; any create failure degrades this node
+        # to python-only serving (the mirror is an accelerator, never a
+        # correctness dependency).
+        self._frag_native: "Optional[_fragdata.FragDataServer]" = None
+        if _fragdata.enabled() if native is None else bool(native):
+            try:
+                self._frag_native = _fragdata.FragDataServer()
+            except Exception:
+                logger.warning(
+                    "native fragment data plane unavailable; "
+                    "serving fragments from Python",
+                    exc_info=True,
+                )
 
     def metadata(self) -> str:
         return self._address
@@ -513,6 +557,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         )
         with self._staged_lock.w_lock(timeout=timeout):
             self._put_locked(step, _Staged(host_sd, max(self._num_chunks, 1)))
+        self._native_mirror_complete(step, host_sd)
         self._wake_stream_waiters()
         _flightrec.record(
             "checkpoint.http.stage", start_ns=t0_ns, step=step,
@@ -523,9 +568,78 @@ class HTTPTransport(CheckpointTransport[Any]):
         old = self._staged.pop(step, None)
         if old is not None:
             old.release()
+            self._native_retire(step)
         self._staged[step] = staged
         while len(self._staged) > self._max_staged:
-            self._staged.pop(next(iter(self._staged))).release()
+            evicted = next(iter(self._staged))
+            self._staged.pop(evicted).release()
+            self._native_retire(evicted)
+
+    # -- native data-plane mirror -------------------------------------
+    #
+    # Every mirror call is best-effort: the native server accelerates
+    # raw frag_* serves, but the Python slot remains the source of truth
+    # — on any mirror failure peers transparently fall back to the
+    # Python data path (fragments._raw_data_plane), so these helpers
+    # swallow rather than surface errors.  ``retire`` is non-blocking
+    # native-side (in-flight serves recycle their buffer on last deref),
+    # so calling it under the staged write lock is safe.
+
+    def _native_retire(self, step: int) -> None:
+        if self._frag_native is not None:
+            try:
+                self._frag_native.retire(step)
+            except Exception:
+                logger.debug("native frag retire failed", exc_info=True)
+
+    def _native_begin(self, step: int) -> None:
+        if self._frag_native is not None:
+            try:
+                self._frag_native.begin(step)
+            except Exception:
+                logger.debug("native frag begin failed", exc_info=True)
+
+    def _native_stage(self, step: int, key: Any, value: Any) -> None:
+        srv = self._frag_native
+        if (
+            srv is None
+            or not isinstance(key, str)
+            or not key.startswith("frag:")
+        ):
+            return
+        raw = ser.raw_view(value)
+        if raw is None:
+            return  # control parts (header/manifest dicts) stay Python
+        try:
+            srv.stage(step, "frag_" + key[len("frag:"):], raw)
+        except Exception:
+            logger.debug("native frag stage failed", exc_info=True)
+
+    def _native_finish(self, step: int) -> None:
+        if self._frag_native is not None:
+            try:
+                self._frag_native.finish(step)
+            except Exception:
+                logger.debug("native frag finish failed", exc_info=True)
+
+    def _native_mirror_complete(self, step: int, sd: Any) -> None:
+        """Mirror the raw ``frag:*`` parts of a COMPLETE document in one
+        begin/stage*/finish stroke (the ``send_checkpoint`` path — e.g. a
+        pre-serialized fragment document staged whole)."""
+        if self._frag_native is None or not isinstance(sd, dict):
+            return
+        raws = [
+            (k, ser.raw_view(v))
+            for k, v in sd.items()
+            if isinstance(k, str) and k.startswith("frag:")
+        ]
+        raws = [(k, r) for k, r in raws if r is not None]
+        if not raws:
+            return
+        self._native_begin(step)
+        for k, raw in raws:
+            self._native_stage(step, k, raw)
+        self._native_finish(step)
 
     # -- per-fragment (cut-through) staging ---------------------------------
     #
@@ -576,6 +690,9 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._put_locked(
                 step, _Staged(dict(state_dict), 1, complete=False, grace=grace)
             )
+        self._native_begin(step)
+        for k, v in dict(state_dict).items():
+            self._native_stage(step, k, v)
         self._wake_stream_waiters()
 
     def stage_streamed_part(
@@ -600,6 +717,7 @@ class HTTPTransport(CheckpointTransport[Any]):
             staged.sd[key] = value
             if pooled:
                 staged.pooled.append(value)
+        self._native_stage(step, key, value)
         self._wake_stream_waiters()
 
     def finish_streamed_checkpoint(
@@ -613,6 +731,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     f"streamed staging slot for step {step} was evicted"
                 )
             staged.complete = True
+        self._native_finish(step)
         self._wake_stream_waiters()
 
     def streamed_parts(self, step: int) -> "Optional[set]":
@@ -1109,6 +1228,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         with remaining ``grace`` survive (they hold immutable serialized
         bytes, not aliases of the live state — see ``_Staged``); each
         call burns one grace round so nothing lingers unbounded."""
+        retired: "List[int]" = []
         with self._staged_lock.w_lock(timeout=self._lock_timeout):
             for k in [k for k in self._staged if k >= 0]:
                 staged = self._staged[k]
@@ -1116,6 +1236,9 @@ class HTTPTransport(CheckpointTransport[Any]):
                     staged.grace -= 1
                     continue
                 self._staged.pop(k).release()
+                retired.append(k)
+        for k in retired:
+            self._native_retire(k)
         self._wake_stream_waiters()
 
     def retire_checkpoint(self, step: int) -> None:
@@ -1125,6 +1248,7 @@ class HTTPTransport(CheckpointTransport[Any]):
             staged = self._staged.pop(step, None)
             if staged is not None:
                 staged.release()
+        self._native_retire(step)
         self._wake_stream_waiters()
 
     def staged_steps(self) -> "List[int]":
@@ -1135,6 +1259,11 @@ class HTTPTransport(CheckpointTransport[Any]):
             return list(self._staged)
 
     def shutdown(self, wait: bool = True) -> None:
+        if self._frag_native is not None:
+            try:
+                self._frag_native.shutdown()
+            except Exception:
+                pass
         self._server.shutdown()
         self._server.server_close()
         if wait:

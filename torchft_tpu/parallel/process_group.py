@@ -323,6 +323,11 @@ class _TokenBucket:
         self._tokens = self.burst
         self._t = time.monotonic()
         self._lock = _lockcheck.lock("pg.token_bucket")
+        # Own ledger (bytes debited / seconds slept serving debt): tests
+        # assert pacing on these instead of wall-clock deltas, which CI
+        # scheduler noise can invert.
+        self.consumed_bytes = 0
+        self.slept_s = 0.0
 
     def consume(self, nbytes: int) -> float:
         """Debit ``nbytes``; returns the seconds slept serving the debt
@@ -334,10 +339,13 @@ class _TokenBucket:
             )
             self._t = now
             self._tokens -= nbytes
+            self.consumed_bytes += int(nbytes)
             debt = -self._tokens
         if debt > 0:
             wait = debt / self.rate
             time.sleep(wait)
+            with self._lock:
+                self.slept_s += wait
             return wait
         return 0.0
 
